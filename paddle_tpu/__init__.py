@@ -1,0 +1,58 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the reference framework's
+capability surface (see /root/repo/SURVEY.md): dual-mode execution (eager
+"dygraph" + traced/compiled "static"), an nn.Layer system, optimizers, AMP,
+data loading, and first-class SPMD distribution (DP/ZeRO/TP/PP/SP) over
+``jax.sharding.Mesh``.
+
+Public API mirrors the reference's ``paddle.*`` 2.0 surface so users can
+switch with minimal changes; internals are idiomatic JAX, not a port.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import (Parameter, Tensor, enable_grad, get_default_dtype,  # noqa
+                   get_flags, get_rng_state, grad, no_grad, seed,
+                   set_default_dtype, set_flags, set_rng_state, to_tensor)
+from .core.dtype import (bfloat16, bool_, complex64, complex128,  # noqa
+                         float16, float32, float64, int8, int16, int32,
+                         int64, uint8)
+
+from . import ops  # noqa: E402
+ops.monkey_patch_tensor()
+
+# creation / random / manipulation / math / logic op surface at top level
+from .ops import *  # noqa: F401,F403,E402
+from .ops import linalg  # noqa: E402
+from .ops.creation import to_tensor  # noqa: E402,F811
+
+from .device import (device_count, get_device, is_compiled_with_cuda,  # noqa
+                     is_compiled_with_tpu, is_compiled_with_xpu, set_device)
+from .framework_io import load, save  # noqa: E402
+
+CPUPlace = "cpu"
+TPUPlace = "tpu"
+
+_static_mode = False
+
+
+def disable_static(place=None):
+    """Dygraph is the default mode; kept for API parity."""
+    global _static_mode
+    _static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def is_grad_enabled():
+    from .core import autograd
+    return autograd.grad_enabled()

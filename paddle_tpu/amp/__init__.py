@@ -1,0 +1,1 @@
+"""Placeholder — populated in subsequent milestones."""

@@ -1,0 +1,8 @@
+"""paddle_tpu.core — substrate: dtype policy, flags, errors, RNG, Tensor,
+autograd tape, and the shared op-dispatch point (SURVEY §7 step 1-2)."""
+from . import autograd, dispatch, dtype, enforce, flags, rng  # noqa: F401
+from .autograd import enable_grad, grad, no_grad  # noqa: F401
+from .dtype import (get_default_dtype, set_default_dtype)  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+from .rng import get_rng_state, seed, set_rng_state  # noqa: F401
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

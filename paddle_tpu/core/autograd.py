@@ -1,0 +1,237 @@
+"""Eager-mode autograd: a tensor-anchored gradient graph.
+
+TPU-native replacement for the reference's dygraph engine: where the reference
+records per-op ``GradOpNode``s during ``Tracer::TraceOp`` (reference:
+paddle/fluid/imperative/tracer.cc:204-205) and sweeps them with dependency
+counting in ``BasicEngine`` (reference: imperative/basic_engine.cc:39,154-235),
+we record one :class:`Node` per traced op holding the ``jax.vjp`` closure.
+
+Nodes are anchored to their *output tensors* (``Tensor._node``) and hold
+references to their input tensors' producer nodes — so a graph lives exactly
+as long as some tensor that can reach it, and dies with ordinary Python GC
+(matching the reference, where the grad graph is freed when its VarBases
+die).  ``backward`` collects the reachable subgraph and sweeps it in
+descending record order (a valid reverse-topological order by construction).
+Gradient accumulation (basic_engine.cc:154-216's EagerGradientAccumulator) is
+plain cotangent summation keyed by snapshotted tensor ids.
+
+The jit/``to_static`` path does NOT use this machinery — it differentiates
+pure functions with ``jax.grad`` directly, mirroring how both of the
+reference's execution modes share one kernel library (SURVEY §1).
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .enforce import UnimplementedError
+
+_seq = itertools.count(1)
+
+
+class Node:
+    """One recorded op: inputs, output metadata, and the vjp closure.
+
+    Input ids / leaf-ness / producer nodes are SNAPSHOTTED at record time:
+    in-place-style APIs (``Tensor._rebind`` via ``__setitem__``) re-point a
+    Python identity at a new autograd position, so reading ``t._bw_id`` at
+    backward time would mis-route cotangents (the reference instead bumps an
+    inplace version counter, tensor.h:77-87, and errors on misuse).
+
+    ``vjp_fn`` is dropped after a non-retaining backward, freeing residuals
+    and making a second backward raise — paddle's retain_graph semantics.
+    """
+
+    __slots__ = ("seq", "inputs", "in_ids", "in_leaf", "in_nodes", "vjp_fn",
+                 "out_ids", "out_avals", "n_outs", "__weakref__")
+
+    def __init__(self, inputs, vjp_fn, out_ids, out_avals):
+        self.seq = next(_seq)
+        self.inputs = inputs            # strong refs: leaves need .grad deposit
+        self.in_ids = [t._bw_id for t in inputs]
+        self.in_leaf = [t.is_leaf for t in inputs]
+        self.in_nodes = [t._node for t in inputs]
+        self.vjp_fn = vjp_fn
+        self.out_ids = out_ids          # bw_id per output
+        self.out_avals = out_avals      # (shape, dtype) per output
+        self.n_outs = len(out_ids)
+
+
+_tls = threading.local()
+
+
+def grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+@contextlib.contextmanager
+def no_grad():
+    """paddle.no_grad parity."""
+    prev = grad_enabled()
+    _tls.grad_enabled = False
+    try:
+        yield
+    finally:
+        _tls.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = grad_enabled()
+    _tls.grad_enabled = True
+    try:
+        yield
+    finally:
+        _tls.grad_enabled = prev
+
+
+def _zero_cotangent(shape, dtype):
+    d = np.dtype(dtype)
+    if not (np.issubdtype(d, np.floating) or np.issubdtype(d, np.complexfloating)):
+        return np.zeros(shape, jax.dtypes.float0)
+    return np.zeros(shape, d)
+
+
+def _collect(roots) -> List[Node]:
+    """Reachable subgraph from root nodes, sorted in reverse record order."""
+    seen: Dict[int, Node] = {}
+    stack = [r for r in roots if r is not None]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen[id(n)] = n
+        for p in n.in_nodes:
+            if p is not None and id(p) not in seen:
+                stack.append(p)
+    return sorted(seen.values(), key=lambda n: -n.seq)
+
+
+def _sweep(nodes, cot, retain_graph, want=None, results=None,
+           deposit_leaf_grad=False):
+    """Shared reverse sweep for backward() and grad()."""
+    from .tensor import Tensor
+
+    for node in nodes:
+        if not any(oid in cot for oid in node.out_ids):
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to run backward through a graph that has already "
+                "been freed; pass retain_graph=True to the first backward "
+                "if you need to backward twice.")
+        if want is not None:
+            for oid in node.out_ids:
+                if oid in want and oid in cot:
+                    i = want[oid]
+                    results[i] = cot[oid] if results[i] is None else (
+                        results[i] + cot[oid])
+        out_cots = tuple(
+            cot.pop(oid) if oid in cot else _zero_cotangent(*aval)
+            for oid, aval in zip(node.out_ids, node.out_avals))
+        in_cots = (node.vjp_fn(out_cots[0]) if node.n_outs == 1
+                   else node.vjp_fn(out_cots))
+        if not retain_graph:
+            node.vjp_fn = None
+        for tin, bid, leaf, g in zip(node.inputs, node.in_ids,
+                                     node.in_leaf, in_cots):
+            if g is None or tin is None:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            for hook in tin._backward_hooks:
+                r = hook(Tensor(g, stop_gradient=True))
+                if r is not None:
+                    g = r.data if isinstance(r, Tensor) else r
+            if leaf and deposit_leaf_grad:
+                if tin._grad_data is None:
+                    tin._grad_data = g
+                else:
+                    tin._grad_data = tin._grad_data + g
+            if not leaf or want is not None:
+                cot[bid] = (cot[bid] + g) if bid in cot else g
+
+
+def backward(tensor, grad=None, retain_graph: bool = False):
+    """Reverse sweep from ``tensor`` (paddle ``Tensor.backward`` parity).
+
+    Reference analog: ``core.dygraph_run_backward`` → BasicEngine::Execute
+    (pybind/imperative.cc:1542-1549; basic_engine.cc).
+    """
+    import jax.numpy as jnp
+    from .tensor import Tensor
+
+    if grad is None:
+        if tensor.size != 1:
+            raise RuntimeError(
+                "grad must be provided for non-scalar tensor.backward()")
+        g0 = jnp.ones(tensor.shape_tuple, tensor.dtype)
+    else:
+        g0 = grad.data if isinstance(grad, Tensor) else jnp.asarray(grad)
+
+    if tensor._node is None:
+        return
+    nodes = _collect([tensor._node])
+    cot: Dict[int, Any] = {tensor._bw_id: g0}
+    with no_grad():
+        _sweep(nodes, cot, retain_graph, deposit_leaf_grad=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad parity (reference: imperative/partial_grad_engine.cc).
+
+    Computes grads of ``outputs`` w.r.t. ``inputs`` without touching ``.grad``.
+    """
+    from .tensor import Tensor
+    import jax.numpy as jnp
+
+    if create_graph:
+        raise UnimplementedError(
+            "create_graph=True (double backward) is not supported by the "
+            "eager tape; use the functional jit path (paddle_tpu.jit) with "
+            "jax.grad composition for higher-order derivatives.")
+
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gouts = grad_outputs if isinstance(grad_outputs, (list, tuple)) else (
+        [grad_outputs] * len(outs))
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    cot: Dict[int, Any] = {}
+    for o, go in zip(outs, gouts):
+        g = (jnp.ones(o.shape_tuple, o.dtype) if go is None
+             else (go.data if isinstance(go, Tensor) else jnp.asarray(go)))
+        cot[o._bw_id] = cot[o._bw_id] + g if o._bw_id in cot else g
+
+    skip_ids = {t._bw_id for t in (no_grad_vars or [])}
+    want = {t._bw_id: i for i, t in enumerate(ins)}
+    results: List[Optional[Any]] = [None] * len(ins)
+
+    nodes = _collect([o._node for o in outs])
+    with no_grad():
+        _sweep(nodes, cot, retain_graph, want=want, results=results)
+
+    # leaves (and any wanted id whose cotangent is still pending)
+    for bid, i in want.items():
+        if bid in cot and results[i] is None:
+            results[i] = cot[bid]
+
+    out_tensors: List[Optional[Tensor]] = [
+        None if (r is None or ins[i]._bw_id in skip_ids)
+        else Tensor(r, stop_gradient=True)
+        for i, r in enumerate(results)]
+    if not allow_unused:
+        for i, r in enumerate(out_tensors):
+            if r is None:
+                raise RuntimeError(
+                    f"Input {i} is unreachable from outputs; pass "
+                    f"allow_unused=True to get None instead.")
+    return out_tensors if isinstance(inputs, (list, tuple)) else out_tensors[0]

@@ -1,0 +1,102 @@
+"""Single op-dispatch point shared by eager and traced execution.
+
+This is the analog of the reference's shared OpKernel dispatch — both dygraph
+``Tracer::TraceOp`` (reference: imperative/tracer.cc:132) and the static
+``Executor`` hot loop (reference: framework/executor.cc:460-466) funnel into
+one kernel registry (operator.h:474).  Here every public op calls
+:func:`apply` with a *pure jnp function*; the same pure function is used
+eagerly (with tape recording) and under ``jax.jit`` tracing (tape off, jax
+transforms handle differentiation).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from .enforce import with_op_hint
+from .flags import get_flag
+
+
+def _is_tensor(x) -> bool:
+    from .tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+def as_array(x):
+    """Tensor → jax array; pass scalars/arrays through."""
+    from .tensor import Tensor
+    if isinstance(x, Tensor):
+        return x.data
+    return x
+
+
+def _check_nan_inf(op_name, arrays):
+    """FLAGS_check_nan_inf mode (reference: details/nan_inf_utils.h:28-33)."""
+    for a in arrays:
+        if hasattr(a, "dtype") and np.issubdtype(np.dtype(a.dtype), np.floating):
+            if bool(jnp.any(~jnp.isfinite(a))):
+                raise FloatingPointError(
+                    f"NaN or Inf found in output of operator < {op_name} >")
+
+
+def apply(fn: Callable, *inputs, op_name: str | None = None,
+          nondiff: bool = False, **kw):
+    """Run a pure op function over Tensor/array inputs.
+
+    - Eager + grad needed: runs through ``jax.vjp`` and records a tape Node.
+    - Otherwise: plain call (also the path taken under jit tracing, where the
+      surrounding ``jax.grad`` owns differentiation).
+    Returns Tensor or tuple of Tensors mirroring ``fn``'s output structure.
+    """
+    from .tensor import Tensor
+
+    name = op_name or getattr(fn, "__name__", "op").lstrip("_")
+    arrays = [as_array(x) for x in inputs]
+
+    diff_idx = []
+    if autograd.grad_enabled() and not nondiff:
+        for i, x in enumerate(inputs):
+            if _is_tensor(x) and not x.stop_gradient and jnp.issubdtype(
+                    np.dtype(x.data.dtype), np.inexact):
+                diff_idx.append(i)
+
+    try:
+        if diff_idx:
+            def f(*diff_args):
+                full = list(arrays)
+                for j, a in zip(diff_idx, diff_args):
+                    full[j] = a
+                return fn(*full, **kw)
+
+            outs, vjp_fn = jax.vjp(f, *(arrays[i] for i in diff_idx))
+        else:
+            outs = fn(*arrays, **kw)
+    except Exception as e:  # attach op attribution like AppendErrorOpHint
+        raise with_op_hint(e, name)
+
+    multi = isinstance(outs, (tuple, list))
+    out_seq = list(outs) if multi else [outs]
+
+    if get_flag("check_nan_inf"):
+        _check_nan_inf(name, out_seq)
+
+    sg = not diff_idx
+    out_tensors = [Tensor(o, stop_gradient=sg, _produced=not sg) for o in out_seq]
+
+    if diff_idx:
+        node = autograd.Node(
+            inputs=[inputs[i] for i in diff_idx],
+            vjp_fn=vjp_fn,
+            out_ids=[t._bw_id for t in out_tensors],
+            out_avals=[(t.shape_tuple, np.dtype(t.data.dtype)) for t in out_tensors],
+        )
+        for t in out_tensors:
+            t._node = node
+
+    if multi:
+        return tuple(out_tensors)
+    return out_tensors[0]

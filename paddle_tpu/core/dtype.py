@@ -1,0 +1,86 @@
+"""Dtype policy for paddle_tpu.
+
+The reference framework carries dtype as `proto::VarType::Type` on every tensor
+(reference: paddle/fluid/framework/framework.proto:91-117) and converts through
+`framework::TransDataType`.  Here dtypes are plain numpy/jax dtypes with string
+aliases matching the reference's public names (``'float32'``, ``'bfloat16'`` ...).
+
+TPU-first policy: bfloat16 is a first-class compute dtype (MXU-native); float64
+is supported but discouraged (TPU emulates it slowly).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Public alias table: paddle name -> jnp dtype
+_ALIASES = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+# Reverse map (canonical name for a dtype)
+_NAMES = {np.dtype(v): k for k, v in _ALIASES.items()}
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype parity (reference: python/paddle/framework/framework.py)."""
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(d):
+    """Normalise a string / numpy / jnp dtype spec to a jnp dtype."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        name = d.replace("paddle.", "")
+        if name not in _ALIASES:
+            raise TypeError(f"Unknown dtype alias: {d!r}")
+        return _ALIASES[name]
+    try:
+        return np.dtype(d).type if not hasattr(d, "dtype") else d
+    except TypeError:
+        raise TypeError(f"Cannot interpret {d!r} as a dtype")
+
+
+def dtype_name(d) -> str:
+    """Canonical paddle-style name for a dtype."""
+    return _NAMES.get(np.dtype(d), str(np.dtype(d)))
+
+
+def is_floating(d) -> bool:
+    return jnp.issubdtype(np.dtype(d), jnp.floating)
+
+
+def is_integer(d) -> bool:
+    return jnp.issubdtype(np.dtype(d), jnp.integer)

@@ -1,0 +1,92 @@
+"""Global flags registry.
+
+TPU-native equivalent of the reference's gflags tier (reference:
+paddle/fluid/platform/flags.cc:33-577, surfaced to Python through
+pybind/global_value_getter_setter.cc as ``core.globals()`` and
+``paddle.set_flags``).  Flags may also be seeded from the environment with the
+``FLAGS_`` prefix, matching the reference's env passthrough.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _FlagDef:
+    name: str
+    default: Any
+    help: str
+    parser: Callable[[str], Any]
+
+
+_registry: Dict[str, _FlagDef] = {}
+_values: Dict[str, Any] = {}
+_lock = threading.Lock()
+
+
+def _parse_bool(s: str) -> bool:
+    return s.lower() in ("1", "true", "yes", "on")
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    if isinstance(default, bool):
+        parser: Callable[[str], Any] = _parse_bool
+    elif isinstance(default, int):
+        parser = int
+    elif isinstance(default, float):
+        parser = float
+    else:
+        parser = str
+    with _lock:
+        _registry[name] = _FlagDef(name, default, help, parser)
+        env = os.environ.get(f"FLAGS_{name}")
+        if env is not None:
+            _values[name] = parser(env)
+        else:
+            _values.setdefault(name, default)
+
+
+def get_flag(name: str) -> Any:
+    if name not in _registry:
+        raise KeyError(f"Unknown flag: {name}")
+    return _values[name]
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """paddle.set_flags parity."""
+    for k, v in flags.items():
+        k = k.replace("FLAGS_", "")
+        if k not in _registry:
+            raise KeyError(f"Unknown flag: {k}")
+        with _lock:
+            _values[k] = v
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    if names is None:
+        return dict(_values)
+    if isinstance(names, str):
+        names = [names]
+    return {n.replace("FLAGS_", ""): get_flag(n.replace("FLAGS_", "")) for n in names}
+
+
+# ---------------------------------------------------------------------------
+# Core flag set (subset of reference platform/flags.cc relevant on TPU)
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "Scan every op output for NaN/Inf (reference: flags.cc:44).")
+define_flag("benchmark", False,
+            "Synchronise after each op and log timings (reference: flags.cc:38).")
+define_flag("eager_delete_tensor_gb", 0.0,
+            "Accepted for parity; XLA owns buffer lifetimes on TPU.")
+define_flag("use_pallas_kernels", True,
+            "Use Pallas fused kernels (flash attention etc.) when on TPU.")
+define_flag("matmul_precision", "default",
+            "jax matmul precision: default | float32 | tensorfloat32 | highest.")
+define_flag("allocator_strategy", "xla",
+            "Accepted for parity; XLA/TPU runtime owns allocation.")
+define_flag("profile_dir", "",
+            "If set, profiler traces are written here.")

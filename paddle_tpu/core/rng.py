@@ -1,0 +1,95 @@
+"""RNG / seeding.
+
+TPU-native equivalent of the reference's global + per-device ``Generator``
+(reference: paddle/fluid/framework/generator.h, generator.cc; pybind
+generator_py.cc; ``paddle.seed``).
+
+Design: JAX threaded-key PRNG instead of stateful Philox.  The global
+``Generator`` owns a base key and a monotonically increasing counter; every
+consumer calls :func:`next_key` which folds the counter into the base key.
+
+Trace-safety: inside ``jit``/``to_static`` tracing, a *traced* base key can be
+pushed with :func:`seed_scope` so random ops (dropout etc.) fold their
+trace-time counter into a runtime key argument — every execution of the
+compiled function can then use fresh randomness, unlike naive key capture.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class Generator:
+    """Stateful key source (reference: framework/generator.h)."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int):
+        with self._lock:
+            self._seed = seed
+            self._key = jax.random.key(seed)
+            self._counter = 0
+        return self
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._counter += 1
+            c = self._counter
+        return jax.random.fold_in(self._key, c)
+
+
+_global_generator = Generator(0)
+_tls = threading.local()
+
+
+def default_generator() -> Generator:
+    return _global_generator
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed parity."""
+    return _global_generator.manual_seed(int(s))
+
+
+def get_rng_state():
+    return (_global_generator._seed, _global_generator._counter)
+
+
+def set_rng_state(state):
+    s, c = state
+    _global_generator.manual_seed(s)
+    _global_generator._counter = c
+
+
+@contextlib.contextmanager
+def seed_scope(key):
+    """Route :func:`next_key` through ``key`` (a possibly-traced jax PRNG key).
+
+    Used by the jit path so compiled programs take randomness as an input
+    rather than baking trace-time keys in as constants.
+    """
+    prev = getattr(_tls, "scope", None)
+    _tls.scope = [key, 0]
+    try:
+        yield
+    finally:
+        _tls.scope = prev
+
+
+def next_key():
+    scope = getattr(_tls, "scope", None)
+    if scope is not None:
+        scope[1] += 1
+        return jax.random.fold_in(scope[0], scope[1])
+    return _global_generator.next_key()

@@ -1,0 +1,257 @@
+"""The Tensor type.
+
+TPU-native analog of the reference's ``framework::Tensor`` + dygraph
+``VarBase`` (reference: paddle/fluid/framework/tensor.h:89,
+imperative/layer.cc).  A Tensor is a thin named wrapper over a ``jax.Array``
+(or a jax tracer during ``to_static`` tracing) carrying autograd metadata:
+
+- ``stop_gradient`` (paddle semantics: default True; Parameters default False)
+- ``grad`` — accumulated leaf gradient deposited by the tape sweep
+- ``_bw_id`` — unique id keying cotangent accumulation during backward
+
+There is no LoD: variable-length sequences are handled by padding/masking and
+ragged Pallas kernels (SURVEY §7 hard-parts), which is the honest TPU design —
+XLA requires static shapes.
+
+Most math/manipulation methods are monkey-patched from ``paddle_tpu.ops``
+(mirroring how the reference patches methods onto VarBase in
+python/paddle/fluid/dygraph/varbase_patch_methods.py).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from .dtype import convert_dtype, dtype_name, get_default_dtype
+
+_bw_counter = itertools.count(1)
+_name_counter = itertools.count(0)
+
+
+class Tensor:
+    __slots__ = ("data", "stop_gradient", "name", "persistable", "_bw_id",
+                 "_produced", "_node", "_grad_data", "_backward_hooks",
+                 "trainable", "__weakref__")
+
+    def __init__(self, data, stop_gradient: bool = True, name: str | None = None,
+                 persistable: bool = False, _produced: bool = False):
+        if isinstance(data, Tensor):
+            data = data.data
+        if not isinstance(data, (jax.Array,)) and not hasattr(data, "aval"):
+            data = jnp.asarray(data)
+        self.data = data
+        self.stop_gradient = stop_gradient
+        self.name = name if name is not None else f"tensor_{next(_name_counter)}"
+        self.persistable = persistable
+        self._bw_id = next(_bw_counter)
+        self._produced = _produced
+        self._node = None
+        self._grad_data = None
+        self._backward_hooks: List = []
+        self.trainable = not stop_gradient
+
+    # -- basic metadata ----------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self.data.shape)
+
+    @property
+    def shape_tuple(self):
+        return tuple(self.data.shape)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self.data.ndim
+
+    rank = ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.data.shape)) if self.data.shape else 1
+
+    @property
+    def place(self) -> str:
+        try:
+            devs = self.data.devices()
+            return str(next(iter(devs)))
+        except Exception:
+            return "traced"
+
+    @property
+    def is_leaf(self) -> bool:
+        return not self._produced
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.data.shape[0]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            body = np.array2string(np.asarray(self.data), precision=8,
+                                   separator=", ")
+        except Exception:
+            body = f"<traced {self.data}>"
+        return (f"Tensor(shape={self.shape}, dtype={dtype_name(self.dtype)}, "
+                f"stop_gradient={sg},\n       {body})")
+
+    # -- host interop ------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.data)
+
+    def item(self, *args):
+        return np.asarray(self.data).item(*args)
+
+    def tolist(self):
+        return np.asarray(self.data).tolist()
+
+    def __float__(self):
+        return float(np.asarray(self.data))
+
+    def __int__(self):
+        return int(np.asarray(self.data))
+
+    def __bool__(self):
+        return bool(np.asarray(self.data))
+
+    def __index__(self):
+        return int(np.asarray(self.data))
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.data)
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- autograd ----------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        if self._grad_data is None:
+            return None
+        return Tensor(self._grad_data, stop_gradient=True,
+                      name=self.name + "@GRAD")
+
+    @grad.setter
+    def grad(self, value):
+        self._grad_data = None if value is None else (
+            value.data if isinstance(value, Tensor) else jnp.asarray(value))
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        autograd.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self._grad_data = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Run ``hook(grad)`` when this tensor's gradient flows (dygraph)."""
+        self._backward_hooks.append(hook)
+
+        class _Handle:
+            def remove(h):
+                try:
+                    self._backward_hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        return Tensor(self.data, stop_gradient=True, name=self.name + ".detach")
+
+    def clone(self) -> "Tensor":
+        from .dispatch import apply
+        return apply(jnp.copy, self, op_name="clone")
+
+    # -- in-place-style helpers (functional under the hood) ---------------
+    def _rebind(self, other: "Tensor"):
+        """Make self an alias of ``other``'s value+autograd position.
+
+        Used by __setitem__ and in-place APIs: XLA is functional, so "in
+        place" means producing a new value and re-pointing this Python
+        identity at it (reference keeps inplace version counters instead,
+        tensor.h:77-87).
+        """
+        self.data = other.data
+        self._bw_id = other._bw_id
+        self._produced = other._produced
+        self._node = other._node
+        self.stop_gradient = other.stop_gradient
+
+    def set_value(self, value):
+        v = value.data if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(v.shape) != self.shape_tuple:
+            raise ValueError(
+                f"set_value shape mismatch: {list(v.shape)} vs {self.shape}")
+        self.data = v.astype(self.data.dtype)
+        return self
+
+    def zero_(self):
+        self.data = jnp.zeros_like(self.data)
+        return self
+
+    def fill_(self, value):
+        self.data = jnp.full_like(self.data, value)
+        return self
+
+    # -- dtype/shape fundamentals (more patched in from ops) ---------------
+    def astype(self, dtype) -> "Tensor":
+        from .dispatch import apply
+        d = convert_dtype(dtype)
+        return apply(lambda x: x.astype(d), self, op_name="cast")
+
+    cast = astype
+
+    def cpu(self):
+        return Tensor(jax.device_put(self.data, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient, name=self.name)
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):  # parity shim: "accelerator" means TPU here
+        return self
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: python/paddle/fluid/framework.py Parameter)."""
+    __slots__ = ("regularizer", "need_clip", "optimize_attr", "is_distributed")
+
+    def __init__(self, data, name=None, trainable=True, regularizer=None,
+                 need_clip=True):
+        super().__init__(data, stop_gradient=not trainable, name=name,
+                         persistable=True)
+        self.trainable = trainable
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.is_distributed = False
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor parity."""
+    if isinstance(data, Tensor):
+        d = data.data
+    else:
+        d = data
+    dt = convert_dtype(dtype)
+    if dt is None and not hasattr(d, "dtype"):
+        # python scalars/lists: follow default dtype for floats
+        a = np.asarray(d)
+        if a.dtype == np.float64:
+            dt = get_default_dtype()
+        elif a.dtype == np.int64:
+            dt = jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32
+    arr = jnp.asarray(d, dtype=dt) if dt is not None else jnp.asarray(d)
+    return Tensor(arr, stop_gradient=stop_gradient)

@@ -1,0 +1,86 @@
+"""paddle.save / paddle.load parity.
+
+The reference pickles ``state_dict`` (reference:
+python/paddle/framework/io.py:202,292).  We serialise nested containers of
+Tensors/ndarrays to a single file: an ``npz`` payload for array data plus a
+pickled structure skeleton — no pickled code objects, loadable anywhere.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+
+_MAGIC = b"PDTPU001"
+
+
+def _flatten(obj, prefix, arrays, skeleton):
+    if isinstance(obj, Tensor):
+        arrays[prefix] = np.asarray(obj.data)
+        return ("__tensor__", prefix)
+    if isinstance(obj, np.ndarray):
+        arrays[prefix] = obj
+        return ("__ndarray__", prefix)
+    if hasattr(obj, "dtype") and hasattr(obj, "shape"):  # jax array
+        arrays[prefix] = np.asarray(obj)
+        return ("__ndarray__", prefix)
+    if isinstance(obj, dict):
+        return {k: _flatten(v, f"{prefix}.{k}", arrays, skeleton)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_flatten(v, f"{prefix}[{i}]", arrays, skeleton)
+             for i, v in enumerate(obj)]
+        return tuple(t) if isinstance(obj, tuple) else t
+    return ("__leaf__", obj)
+
+
+def _unflatten(spec, arrays, to_tensor_cls):
+    if isinstance(spec, dict):
+        return {k: _unflatten(v, arrays, to_tensor_cls) for k, v in spec.items()}
+    if isinstance(spec, list):
+        return [_unflatten(v, arrays, to_tensor_cls) for v in spec]
+    if isinstance(spec, tuple):
+        if len(spec) == 2 and spec[0] == "__tensor__":
+            return Tensor(jnp.asarray(arrays[spec[1]]))
+        if len(spec) == 2 and spec[0] == "__ndarray__":
+            return arrays[spec[1]]
+        if len(spec) == 2 and spec[0] == "__leaf__":
+            return spec[1]
+        return tuple(_unflatten(v, arrays, to_tensor_cls) for v in spec)
+    return spec
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    """paddle.save parity: state_dicts, nested dict/list of tensors, scalars."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    arrays: dict = {}
+    skeleton = _flatten(obj, "r", arrays, None)
+    buf = _io.BytesIO()
+    np.savez(buf, **{k: v for k, v in arrays.items()})
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        sk = pickle.dumps(skeleton, protocol=protocol)
+        f.write(len(sk).to_bytes(8, "little"))
+        f.write(sk)
+        f.write(buf.getvalue())
+
+
+def load(path: str, **configs) -> Any:
+    with open(path, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            # fall back: plain pickle (reference-compatible style)
+            f.seek(0)
+            return pickle.load(f)
+        n = int.from_bytes(f.read(8), "little")
+        skeleton = pickle.loads(f.read(n))
+        arrays = dict(np.load(_io.BytesIO(f.read()), allow_pickle=False))
+    return _unflatten(skeleton, arrays, Tensor)

@@ -1,0 +1,138 @@
+"""paddle_tpu.ops — the op library.
+
+One pure-functional op set shared by eager ("dygraph") execution and
+``to_static``/jit tracing, mirroring how the reference's dygraph tracer and
+static executor dispatch into one OpKernel registry (SURVEY §1, reference:
+framework/operator.h:474).
+
+``monkey_patch_tensor`` injects the op surface as Tensor methods and dunders,
+the analog of the reference's varbase_patch_methods.py / math_op_patch.py.
+"""
+from __future__ import annotations
+
+from .creation import *  # noqa: F401,F403
+from .creation import to_tensor  # noqa: F401
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from . import linalg  # noqa: F401
+from .linalg import norm, dist  # noqa: F401
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply as _apply
+
+from . import math as _math
+from . import reduction as _red
+from . import manipulation as _man
+from . import logic as _logic
+from . import creation as _cre
+
+
+def _flip_args(fn):
+    def flipped(x, y, name=None):
+        return fn(y, x)
+    return flipped
+
+
+def monkey_patch_tensor():
+    T = Tensor
+    # arithmetic dunders
+    T.__add__ = _math.add
+    T.__radd__ = _flip_args(_math.add)
+    T.__sub__ = _math.subtract
+    T.__rsub__ = _flip_args(_math.subtract)
+    T.__mul__ = _math.multiply
+    T.__rmul__ = _flip_args(_math.multiply)
+    T.__truediv__ = _math.divide
+    T.__rtruediv__ = _flip_args(_math.divide)
+    T.__floordiv__ = _math.floor_divide
+    T.__rfloordiv__ = _flip_args(_math.floor_divide)
+    T.__mod__ = _math.remainder
+    T.__rmod__ = _flip_args(_math.remainder)
+    T.__pow__ = _math.pow
+    T.__rpow__ = _flip_args(_math.pow)
+    T.__neg__ = _math.neg
+    T.__abs__ = _math.abs
+    T.__matmul__ = _math.matmul
+    T.__rmatmul__ = _flip_args(_math.matmul)
+    # comparisons
+    T.__eq__ = _logic.equal
+    T.__ne__ = _logic.not_equal
+    T.__lt__ = _logic.less_than
+    T.__le__ = _logic.less_equal
+    T.__gt__ = _logic.greater_than
+    T.__ge__ = _logic.greater_equal
+    T.__hash__ = lambda self: id(self)
+    # logical
+    T.__and__ = _logic.bitwise_and
+    T.__or__ = _logic.bitwise_or
+    T.__xor__ = _logic.bitwise_xor
+    T.__invert__ = _logic.bitwise_not
+    # indexing
+    T.__getitem__ = _man.getitem
+    T.__setitem__ = _man.setitem
+
+    methods = dict(
+        # math
+        add=_math.add, subtract=_math.subtract, multiply=_math.multiply,
+        divide=_math.divide, floor_divide=_math.floor_divide,
+        remainder=_math.remainder, mod=_math.remainder, pow=_math.pow,
+        matmul=_math.matmul, mm=_math.mm, bmm=_math.bmm, dot=_math.dot,
+        maximum=_math.maximum, minimum=_math.minimum,
+        exp=_math.exp, log=_math.log, log2=_math.log2, log10=_math.log10,
+        log1p=_math.log1p, sqrt=_math.sqrt, rsqrt=_math.rsqrt,
+        square=_math.square, abs=_math.abs, sign=_math.sign,
+        neg=_math.neg, floor=_math.floor, ceil=_math.ceil,
+        round=_math.round, trunc=_math.trunc,
+        sin=_math.sin, cos=_math.cos, tan=_math.tan, asin=_math.asin,
+        acos=_math.acos, atan=_math.atan, sinh=_math.sinh, cosh=_math.cosh,
+        tanh=_math.tanh, erf=_math.erf, sigmoid=_math.sigmoid,
+        reciprocal=_math.reciprocal, scale=_math.scale, clip=_math.clip,
+        lerp=_math.lerp, cumsum=_math.cumsum, cumprod=_math.cumprod,
+        isnan=_math.isnan, isinf=_math.isinf, isfinite=_math.isfinite,
+        trace=_math.trace, kron=_math.kron, outer=_math.outer,
+        inner=_math.inner, cross=_math.cross, addmm=_math.addmm,
+        nan_to_num=_math.nan_to_num, logaddexp=_math.logaddexp,
+        # reduction
+        sum=_red.sum, mean=_red.mean, max=_red.max, min=_red.min,
+        amax=_red.amax, amin=_red.amin, prod=_red.prod,
+        argmax=_red.argmax, argmin=_red.argmin, all=_red.all, any=_red.any,
+        logsumexp=_red.logsumexp, std=_red.std, var=_red.var,
+        median=_red.median, nanmean=_red.nanmean, nansum=_red.nansum,
+        count_nonzero=_red.count_nonzero,
+        # manipulation
+        reshape=_man.reshape, reshape_=_man.reshape_,
+        transpose=_man.transpose, t=_man.t, moveaxis=_man.moveaxis,
+        swapaxes=_man.swapaxes, flatten=_man.flatten, squeeze=_man.squeeze,
+        unsqueeze=_man.unsqueeze, split=_man.split, chunk=_man.chunk,
+        unbind=_man.unbind, tile=_man.tile, expand=_man.expand,
+        expand_as=_man.expand_as, broadcast_to=_man.broadcast_to,
+        flip=_man.flip, roll=_man.roll,
+        repeat_interleave=_man.repeat_interleave, gather=_man.gather,
+        gather_nd=_man.gather_nd, take_along_axis=_man.take_along_axis,
+        put_along_axis=_man.put_along_axis, scatter=_man.scatter,
+        scatter_nd_add=_man.scatter_nd_add, index_select=_man.index_select,
+        index_sample=_man.index_sample, masked_select=_man.masked_select,
+        masked_fill=_man.masked_fill, where=_man.where,
+        nonzero=_man.nonzero, sort=_man.sort, argsort=_man.argsort,
+        topk=_man.topk, kthvalue=_man.kthvalue, unique=_man.unique,
+        pad=_man.pad, diff=_man.diff, one_hot=_man.one_hot,
+        # logic
+        equal=_logic.equal, not_equal=_logic.not_equal,
+        greater_than=_logic.greater_than,
+        greater_equal=_logic.greater_equal, less_than=_logic.less_than,
+        less_equal=_logic.less_equal, logical_and=_logic.logical_and,
+        logical_or=_logic.logical_or, logical_xor=_logic.logical_xor,
+        logical_not=_logic.logical_not, isclose=_logic.isclose,
+        allclose=_logic.allclose, equal_all=_logic.equal_all,
+        isin=_logic.isin,
+        # linalg
+        norm=linalg.norm, dist=linalg.dist, cholesky=linalg.cholesky,
+        inverse=linalg.inverse, matrix_power=linalg.matrix_power,
+        # creation-ish
+        tril=_cre.tril, triu=_cre.triu, diag=_cre.diag,
+    )
+    for name, fn in methods.items():
+        setattr(T, name, fn)

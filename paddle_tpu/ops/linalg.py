@@ -1,0 +1,145 @@
+"""Linear-algebra ops (reference: python/paddle/tensor/linalg.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def _norm(a):
+        if axis is None and p in ("fro", 2):
+            return jnp.sqrt(jnp.sum(jnp.square(a)))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax,
+                           keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax,
+                       keepdims=keepdim) ** (1.0 / p)
+    return apply(_norm, x, op_name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    def _dist(a, b):
+        d = a - b
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype)).astype(d.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply(_dist, x, y, op_name="dist")
+
+
+def cholesky(x, upper=False, name=None):
+    def _chol(a):
+        out = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(out, -1, -2) if upper else out
+    return apply(_chol, x, op_name="cholesky")
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, x, op_name="inverse")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                           hermitian=hermitian),
+                 x, op_name="pinv")
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), x,
+                 op_name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.matrix_rank(a, tol=tol), x,
+                 op_name="matrix_rank", nondiff=True)
+
+
+def slogdet(x, name=None):
+    return apply(lambda a: tuple(jnp.linalg.slogdet(a)), x,
+                 op_name="slogdet")
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, op_name="det")
+
+
+def qr(x, mode="reduced", name=None):
+    return apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x,
+                 op_name="qr")
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(lambda a: tuple(jnp.linalg.svd(
+        a, full_matrices=full_matrices)), x, op_name="svd")
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x,
+                 op_name="eigh")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x,
+                 op_name="eigvalsh")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    import jax
+    def _tri(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(_tri, x, y, op_name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def _lstsq(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return apply(_lstsq, x, y, op_name="lstsq")
+
+
+def matmul_transpose(x, y):
+    return apply(lambda a, b: jnp.matmul(a, jnp.swapaxes(b, -1, -2)), x, y,
+                 op_name="matmul_transpose")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar,
+                                   ddof=1 if ddof else 0),
+                 x, op_name="cov")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x,
+                 op_name="corrcoef")
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):
+    def _hist(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+        return h
+    return apply(_hist, x, op_name="histogram", nondiff=True)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    def _bc(a):
+        return jnp.bincount(a, length=None if minlength == 0 else minlength)
+    return apply(_bc, x, op_name="bincount", nondiff=True)

@@ -1,0 +1,71 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py,
+operators/controlflow/compare_op.cc, logical_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, as_array
+from ..core.tensor import Tensor
+
+
+def _cmp(jfn, name):
+    def op(x, y, name=None):
+        return apply(jfn, x, y, op_name=name, nondiff=True)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x, name=None):
+    return apply(jnp.logical_not, x, op_name="logical_not", nondiff=True)
+
+
+def bitwise_not(x, name=None):
+    return apply(jnp.bitwise_not, x, op_name="bitwise_not", nondiff=True)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan),
+                 x, y, op_name="isclose", nondiff=True)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan),
+                 x, y, op_name="allclose", nondiff=True)
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), x, y,
+                 op_name="equal_all", nondiff=True)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(as_array(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def in1d(x, test, name=None):
+    return apply(lambda a, b: jnp.isin(a, b), x, test, op_name="isin",
+                 nondiff=True)
+
+
+isin = in1d

@@ -1,0 +1,431 @@
+"""Shape / layout / indexing manipulation ops
+(reference: python/paddle/tensor/manipulation.py, operators/reshape_op.cc,
+transpose_op.cc, concat_op.cc, gather_op.*, scatter_op.*)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, as_array
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+
+def cast(x, dtype):
+    d = convert_dtype(dtype)
+    return apply(lambda a: a.astype(d), x, op_name="cast")
+
+
+def reshape(x, shape, name=None):
+    shape = tuple(int(s) if not hasattr(s, "item") else int(s.item())
+                  for s in shape)
+    return apply(lambda a: jnp.reshape(a, shape), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._rebind(out)
+    return x
+
+
+def transpose(x, perm, name=None):
+    perm = tuple(int(p) for p in perm)
+    return apply(lambda a: jnp.transpose(a, perm), x, op_name="transpose")
+
+
+def t(x, name=None):
+    return apply(lambda a: a.T, x, op_name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x,
+                 op_name="moveaxis")
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis1, axis2), x,
+                 op_name="swapaxes")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def _flatten(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply(_flatten, x, op_name="flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    def _squeeze(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply(_squeeze, x, op_name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    def _unsqueeze(a):
+        out = a
+        for ax in sorted(int(v) for v in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply(_unsqueeze, x, op_name="unsqueeze")
+
+
+def concat(x, axis=0, name=None):
+    axis = int(as_array(axis)) if not isinstance(axis, int) else axis
+    tensors = list(x)
+    return apply(lambda *xs: jnp.concatenate(xs, axis=axis), *tensors,
+                 op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply(lambda *xs: jnp.stack(xs, axis=axis), *tensors,
+                 op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis)
+    def _split(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        sections = list(num_or_sections)
+        total = a.shape[axis]
+        known = [s for s in sections if s != -1]
+        if len(known) < len(sections):
+            fill = total - int(np.sum(known))
+            sections = [fill if s == -1 else s for s in sections]
+        idx = np.cumsum(sections)[:-1].tolist()
+        return tuple(jnp.split(a, idx, axis=axis))
+    return apply(_split, x, op_name="split")
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = as_array(x).shape[axis]
+    def _unbind(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+    return apply(_unbind, x, op_name="unbind")
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    reps = tuple(int(r) for r in repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), x, op_name="tile")
+
+
+def expand(x, shape, name=None):
+    shape = tuple(int(s) for s in shape)
+    def _expand(a):
+        tgt = tuple(a.shape[i - (len(shape) - a.ndim)] if s == -1 else s
+                    for i, s in enumerate(shape))
+        return jnp.broadcast_to(a, tgt)
+    return apply(_expand, x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    tgt = tuple(as_array(y).shape)
+    return apply(lambda a: jnp.broadcast_to(a, tgt), x, op_name="expand_as")
+
+
+def broadcast_to(x, shape, name=None):
+    return apply(lambda a: jnp.broadcast_to(a, tuple(shape)), x,
+                 op_name="broadcast_to")
+
+
+def broadcast_tensors(inputs, name=None):
+    return apply(lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *inputs,
+                 op_name="broadcast_tensors")
+
+
+def flip(x, axis, name=None):
+    axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply(lambda a: jnp.flip(a, axis=axes), x, op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x,
+                 op_name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda a: jnp.roll(a, shifts, axis=axis), x, op_name="roll")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = as_array(repeats)
+    return apply(lambda a: jnp.repeat(a, r, axis=axis), x,
+                 op_name="repeat_interleave")
+
+
+# -- gather / scatter family ----------------------------------------------
+
+def gather(x, index, axis=0, name=None):
+    axis = int(as_array(axis)) if not isinstance(axis, int) else axis
+    return apply(lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i,
+                                       axis=axis),
+                 x, index, op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def _gather_nd(a, idx):
+        k = idx.shape[-1]
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+    return apply(_gather_nd, x, index, op_name="gather_nd")
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+                 arr, indices, op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def _put(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        mode = {"add": "add", "mul": "multiply", "multiply": "multiply"}[reduce]
+        dims = list(range(a.ndim))
+        # scatter via explicit index grid
+        idx_grids = jnp.meshgrid(*[jnp.arange(s) for s in i.shape],
+                                 indexing="ij")
+        full_idx = list(idx_grids)
+        full_idx[axis] = i
+        if mode == "add":
+            return a.at[tuple(full_idx)].add(v)
+        return a.at[tuple(full_idx)].multiply(v)
+    return apply(_put, arr, indices, values, op_name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """reference: operators/scatter_op.cc (1-D index into dim 0)."""
+    def _scatter(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        # paddle overwrite=False: zero target rows then accumulate
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+    return apply(_scatter, x, index, updates, op_name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def _snd(a, i, u):
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply(_snd, x, index, updates, op_name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    z = Tensor(jnp.zeros(tuple(shape), as_array(updates).dtype))
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda a, i: jnp.take(a, i, axis=axis), x, index,
+                 op_name="index_select")
+
+
+def index_sample(x, index, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index,
+                 op_name="index_sample")
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only op (documented XLA limitation)
+    a = np.asarray(as_array(x))
+    m = np.asarray(as_array(mask))
+    return Tensor(jnp.asarray(a[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply(lambda a, m, v: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                 x, mask, value, op_name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y,
+                 op_name="where")
+
+
+def nonzero(x, as_tuple=False):
+    a = np.asarray(as_array(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v)) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    def _pad(a):
+        p = list(pad)
+        if len(p) == a.ndim * 2:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(a.ndim)]
+        else:
+            # paddle short form: [left, right, top, bottom, front, back] —
+            # the j-th pair pads the j-th spatial dim counted from the LAST
+            # (W first, then H, then D), per data_format
+            width = [(0, 0)] * a.ndim
+            n = len(p) // 2
+            if data_format.startswith("NC"):      # NCL/NCHW/NCDHW
+                dims = [a.ndim - 1 - j for j in range(n)]
+            else:                                  # NLC/NHWC/NDHWC
+                dims = [a.ndim - 2 - j for j in range(n)]
+            for j, d in enumerate(dims):
+                width[d] = (p[2 * j], p[2 * j + 1])
+        if mode == "constant":
+            return jnp.pad(a, width, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        return jnp.pad(a, width, mode=jmode)
+    return apply(_pad, x, op_name="pad")
+
+
+# -- sort / search ---------------------------------------------------------
+
+def sort(x, axis=-1, descending=False, name=None):
+    def _sort(a):
+        out = jnp.sort(a, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+    return apply(_sort, x, op_name="sort")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def _argsort(a):
+        out = jnp.argsort(a, axis=axis, descending=descending)
+        return out.astype(jnp.int32)
+    return apply(_argsort, x, op_name="argsort", nondiff=True)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(as_array(k))
+    def _topk(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(moved, k)
+        else:
+            v, i = jax.lax.top_k(-moved, k)
+            v = -v
+        return (jnp.moveaxis(v, -1, ax),
+                jnp.moveaxis(i.astype(jnp.int32), -1, ax))
+    return apply(_topk, x, op_name="topk")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _kth(a):
+        v = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis).astype(jnp.int32)
+        vv = jnp.take(v, k - 1, axis=axis)
+        ii = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            vv = jnp.expand_dims(vv, axis)
+            ii = jnp.expand_dims(ii, axis)
+        return vv, ii
+    return apply(_kth, x, op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def _mode(a):
+        ax = axis % a.ndim
+        srt = jnp.sort(a, axis=ax)
+        idx = jnp.argsort(a, axis=ax).astype(jnp.int32)
+        pos = jnp.broadcast_to(
+            jnp.arange(a.shape[ax]).reshape(
+                [-1 if i == ax else 1 for i in range(a.ndim)]), a.shape)
+        changed = jnp.concatenate(
+            [jnp.ones_like(jnp.take(srt, jnp.asarray([0]), axis=ax),
+                           dtype=bool),
+             jnp.diff(srt, axis=ax) != 0], axis=ax)
+        # run start index via cumulative max (associative), run len = pos-start
+        start = jnp.where(changed, pos, 0)
+        run_start = jax.lax.cummax(start, axis=ax)
+        runs = pos - run_start
+        # last index of the longest run (paddle returns the last occurrence)
+        best = jnp.argmax(runs, axis=ax)
+        vals = jnp.take_along_axis(srt, jnp.expand_dims(best, axis), axis=axis)
+        inds = jnp.take_along_axis(idx, jnp.expand_dims(best, axis), axis=axis)
+        if not keepdim:
+            vals = jnp.squeeze(vals, axis)
+            inds = jnp.squeeze(inds, axis)
+        return vals, inds
+    return apply(_mode, x, op_name="mode")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = np.asarray(as_array(x))
+    res = np.unique(a, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    return apply(lambda s, v: jnp.searchsorted(s, v, side=side).astype(
+        jnp.int32 if out_int32 else jnp.int64),
+        sorted_sequence, values, op_name="searchsorted", nondiff=True)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda a: jax.nn.one_hot(a, num_classes), x,
+                 op_name="one_hot", nondiff=True)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return apply(lambda a: jnp.diff(a, n=n, axis=axis), x, op_name="diff")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    raise NotImplementedError(
+        "as_strided has no XLA analog; use reshape/slice instead")
+
+
+# -- tensor indexing (__getitem__/__setitem__ backends) --------------------
+
+def _norm_index(idx):
+    """Convert Tensors inside an index expression to arrays."""
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    if isinstance(idx, Tensor):
+        return idx.data
+    return idx
+
+
+def getitem(x, idx):
+    nidx = _norm_index(idx)
+    return apply(lambda a: a[nidx], x, op_name="slice")
+
+
+def setitem(x, idx, value):
+    nidx = _norm_index(idx)
+    def _set(a, v):
+        return a.at[nidx].set(v.astype(a.dtype) if hasattr(v, "astype") else v)
+    out = apply(_set, x, value, op_name="set_value")
+    x._rebind(out)
+    return x

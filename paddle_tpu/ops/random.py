@@ -1,0 +1,116 @@
+"""Random ops drawing from the global Generator
+(reference: python/paddle/tensor/random.py, operators/uniform_random_op.cc,
+gaussian_random_op.cc; generator state in framework/generator.h)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import as_array
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.rng import next_key
+from ..core.tensor import Tensor
+
+
+def _dt(dtype):
+    d = convert_dtype(dtype)
+    return get_default_dtype() if d is None else d
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), tuple(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), tuple(shape), _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, tuple(shape), _dt(dtype),
+                                     minval=float(as_array(min)),
+                                     maxval=float(as_array(max))))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if shape is None:
+        shape = ()
+        m = as_array(mean)
+        if hasattr(m, "shape"):
+            shape = m.shape
+    out = jax.random.normal(next_key(), tuple(shape), get_default_dtype())
+    return Tensor(out * as_array(std) + as_array(mean))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype)
+    if d == jnp.int64:
+        d = jnp.int32  # x64 disabled by default; int32 is the TPU-native int
+    return Tensor(jax.random.randint(next_key(), tuple(shape), low, high,
+                                     dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    a = as_array(x)
+    return randint(low, high, tuple(a.shape), dtype or "int32")
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(jnp.int32))
+
+
+def shuffle(x, axis=0, name=None):
+    return Tensor(jax.random.permutation(next_key(), as_array(x), axis=axis,
+                                         independent=False))
+
+
+def bernoulli(x, name=None):
+    a = as_array(x)
+    return Tensor(jax.random.bernoulli(next_key(), a).astype(a.dtype))
+
+
+def poisson(x, name=None):
+    a = as_array(x)
+    return Tensor(jax.random.poisson(next_key(), a).astype(a.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    a = as_array(x)
+    logits = jnp.log(jnp.maximum(a, 1e-30))
+    if replacement:
+        # categorical broadcasts batch dims leading: sample with
+        # num_samples leading, then move it to the trailing position
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples, *a.shape[:-1]))
+        out = jnp.moveaxis(out, 0, -1) if a.ndim > 1 else out.reshape(-1)
+    else:
+        # Gumbel top-k trick gives sampling without replacement
+        g = jax.random.gumbel(next_key(), a.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int32))
+
+
+def exponential_(x, lam=1.0, name=None):
+    a = as_array(x)
+    out = jax.random.exponential(next_key(), a.shape, a.dtype) / lam
+    x.set_value(out)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    a = as_array(x)
+    x.set_value(jax.random.normal(next_key(), a.shape, a.dtype) * std + mean)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    a = as_array(x)
+    x.set_value(jax.random.uniform(next_key(), a.shape, a.dtype,
+                                   minval=min, maxval=max))
+    return x

@@ -1,0 +1,135 @@
+"""Reduction ops (reference: python/paddle/tensor/math.py sum/mean/...,
+paddle/fluid/operators/reduce_ops/*)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = convert_dtype(dtype)
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.sum(a, axis=ax, dtype=d, keepdims=keepdim),
+                 x, op_name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x,
+                 op_name="mean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x,
+                 op_name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x,
+                 op_name="min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.prod(a, axis=ax, dtype=d, keepdims=keepdim),
+                 x, op_name="prod")
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    ax = _norm_axis(axis)
+    def _argmax(a):
+        out = jnp.argmax(a.reshape(-1) if ax is None else a, axis=0 if ax is None else ax)
+        if keepdim and ax is not None:
+            out = jnp.expand_dims(out, ax)
+        return out.astype(jnp.int32)
+    return apply(_argmax, x, op_name="argmax", nondiff=True)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    ax = _norm_axis(axis)
+    def _argmin(a):
+        out = jnp.argmin(a.reshape(-1) if ax is None else a, axis=0 if ax is None else ax)
+        if keepdim and ax is not None:
+            out = jnp.expand_dims(out, ax)
+        return out.astype(jnp.int32)
+    return apply(_argmin, x, op_name="argmin", nondiff=True)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x,
+                 op_name="all", nondiff=True)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x,
+                 op_name="any", nondiff=True)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    import jax
+    ax = _norm_axis(axis)
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=ax,
+                                                       keepdims=keepdim),
+                 x, op_name="logsumexp")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply(lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                 x, op_name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply(lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                 x, op_name="var")
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x,
+                 op_name="median")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x,
+                 op_name="nanmean")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = convert_dtype(dtype)
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.nansum(a, axis=ax, dtype=d, keepdims=keepdim),
+                 x, op_name="nansum")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim),
+                 x, op_name="count_nonzero", nondiff=True)

@@ -1,0 +1,28 @@
+"""Test harness config.
+
+Tests run on a virtual 8-device CPU mesh (SURVEY §4 implication: XLA gives
+true single-process multi-device, unlike the reference's subprocess-based
+TestDistBase) — set env BEFORE jax initialises.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# exact-ish matmuls for numeric checks (bench sets its own precision)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,220 @@
+"""Autograd tape tests, modelled on the reference's dygraph autograd suite
+(test_imperative_basic.py, test_imperative_auto_prune.py) plus numeric
+gradient checking in the OpTest style (op_test.py:110 get_numeric_gradient).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain_rule_two_ops():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x          # 4
+    z = y * x          # 8  => dz/dx = 3x^2 = 12
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks_flow():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    d = y.detach()
+    z = (d * x).sum()
+    z.backward()
+    # only the direct x factor contributes: dz/dx = d = 9
+    np.testing.assert_allclose(x.grad.numpy(), [9.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * x
+    assert y.stop_gradient
+
+
+def test_matmul_grad():
+    a = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32),
+                         stop_gradient=False)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(),
+                               np.ones((3, 5)) @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(),
+                               a.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_broadcast_grad():
+    a = paddle.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    (a + b).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [3, 3, 3, 3])
+
+
+def test_non_scalar_backward_needs_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [x, z], retain_graph=True)
+    gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+    assert gz is None
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy()))
+    (x * 5).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [5.0])
+
+
+def test_hook_modifies_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 2)
+    (x * 5).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Finite-difference oracle, OpTest-style (op_test.py:110)."""
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        f1 = f(x)
+        flat[i] = orig - eps
+        f0 = f(x)
+        flat[i] = orig
+        gf[i] = (f1 - f0) / (2 * eps)
+    return g
+
+
+@pytest.mark.parametrize("op,np_op", [
+    ("exp", np.exp),
+    ("tanh", np.tanh),
+    ("sqrt", np.sqrt),
+    ("log", np.log),
+    ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+])
+def test_numeric_gradient_match(op, np_op):
+    xv = np.random.rand(3, 4).astype(np.float64) * 0.8 + 0.1
+    x = paddle.to_tensor(xv.astype(np.float32), stop_gradient=False)
+    y = getattr(paddle, op)(x).sum()
+    y.backward()
+    ng = numeric_grad(lambda a: np_op(a).sum(), xv.copy())
+    np.testing.assert_allclose(x.grad.numpy(), ng, rtol=1e-2, atol=1e-3)
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    parts = paddle.split(x, 3)
+    loss = (parts[0] * 1 + parts[1] * 2 + parts[2] * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 2, 2, 3, 3])
+
+
+def test_setitem_grad_flow():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2
+    y[0] = 0.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+def test_second_use_of_intermediate():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 3
+    z = y + y        # dz/dx = 6
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_two_independent_graphs():
+    """Regression: one backward must not clobber other live graphs."""
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    l1 = (a * 2).sum()
+    l2 = (a * 3).sum()
+    l1.backward()
+    l2.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [5.0])
+
+
+def test_second_backward_raises():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_grad_wrt_intermediate():
+    """Regression: paddle.grad w.r.t. a non-leaf tensor."""
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    z = (y * y).sum()
+    (gy,) = paddle.grad(z, [y])
+    np.testing.assert_allclose(gy.numpy(), [12.0])  # 2y = 12
+
+
+def test_forward_only_does_not_leak_graph():
+    import gc
+    import weakref
+    from paddle_tpu.core.autograd import Node
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    ref = weakref.ref(y._node)
+    del y
+    gc.collect()
+    assert ref() is None  # node died with its output tensor

@@ -1,0 +1,189 @@
+"""Tensor basics: creation, metadata, math methods, indexing.
+
+Models the reference's tensor API tests
+(python/paddle/fluid/tests/unittests/test_var_base.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_and_numpy():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert x.dtype == paddle.float32
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+    assert x.stop_gradient is True
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+    np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+    e = paddle.eye(3).numpy()
+    np.testing.assert_allclose(e, np.eye(3, dtype=np.float32))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+
+
+def test_arithmetic_operators():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2], rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2.0 - a).numpy(), [1, 0, -1])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((10.0 / a).numpy(), [10, 5, 10 / 3], rtol=1e-6)
+
+
+def test_matmul():
+    a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    c = a @ b
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy())
+    d = paddle.matmul(a, b.t(), transpose_y=True)
+    np.testing.assert_allclose(d.numpy(), a.numpy() @ b.numpy())
+    e = paddle.matmul(a, a, transpose_y=True)
+    np.testing.assert_allclose(e.numpy(), a.numpy() @ a.numpy().T)
+
+
+def test_reductions():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert float(x.sum()) == 66
+    assert float(x.mean()) == 5.5
+    np.testing.assert_allclose(x.sum(axis=0).numpy(), [12, 15, 18, 21])
+    np.testing.assert_allclose(x.max(axis=1).numpy(), [3, 7, 11])
+    assert x.sum(axis=1, keepdim=True).shape == [3, 1]
+    assert int(x.argmax()) == 11
+    np.testing.assert_allclose(x.argmax(axis=0).numpy(), [2, 2, 2, 2])
+
+
+def test_manipulation():
+    x = paddle.arange(24, dtype="float32")
+    y = x.reshape([2, 3, 4])
+    assert y.shape == [2, 3, 4]
+    z = y.transpose([2, 0, 1])
+    assert z.shape == [4, 2, 3]
+    assert y.flatten().shape == [24]
+    assert y.flatten(1, 2).shape == [2, 12]
+    assert y.unsqueeze(0).shape == [1, 2, 3, 4]
+    assert y.unsqueeze([0, 2]).shape == [1, 2, 1, 3, 4]
+    w = paddle.concat([y, y], axis=1)
+    assert w.shape == [2, 6, 4]
+    s = paddle.stack([x, x])
+    assert s.shape == [2, 24]
+    parts = paddle.split(paddle.ones([6, 2]), [2, 2, -1], axis=0)
+    assert [p.shape for p in parts] == [[2, 2], [2, 2], [2, 2]]
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(x[0].numpy(), [0, 1, 2, 3])
+    np.testing.assert_allclose(x[1, 2].numpy(), 6)
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[0:2, 1:3].numpy(), [[1, 2], [5, 6]])
+    idx = paddle.to_tensor(np.array([0, 2]))
+    np.testing.assert_allclose(x[idx].numpy(), x.numpy()[[0, 2]])
+    # setitem is functional under the hood but keeps python identity
+    x[0, 0] = 100.0
+    assert float(x[0, 0]) == 100.0
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = paddle.to_tensor(np.array([0, 2]))
+    g = paddle.gather(x, idx)
+    np.testing.assert_allclose(g.numpy(), x.numpy()[[0, 2]])
+    upd = paddle.ones([2, 3])
+    s = paddle.scatter(x, idx, upd)
+    expect = x.numpy().copy()
+    expect[[0, 2]] = 1
+    np.testing.assert_allclose(s.numpy(), expect)
+
+
+def test_where_topk_sort():
+    x = paddle.to_tensor([3.0, 1.0, 2.0])
+    v, i = paddle.topk(x, 2)
+    np.testing.assert_allclose(v.numpy(), [3, 2])
+    np.testing.assert_allclose(i.numpy(), [0, 2])
+    s = paddle.sort(x, descending=True)
+    np.testing.assert_allclose(s.numpy(), [3, 2, 1])
+    w = paddle.where(x > 1.5, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(w.numpy(), [3, 0, 2])
+
+
+def test_cast_and_dtype():
+    x = paddle.ones([2], dtype="float32")
+    y = x.astype("int32")
+    assert y.dtype == paddle.int32
+    z = x.astype(paddle.bfloat16)
+    assert z.dtype == paddle.bfloat16
+
+
+def test_comparison_and_logic():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([3.0, 2.0, 1.0])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+    assert bool(paddle.allclose(a, a))
+    assert not bool(paddle.allclose(a, b))
+
+
+def test_inplace_style():
+    x = paddle.ones([2, 2])
+    x.zero_()
+    assert x.numpy().sum() == 0
+    x.fill_(3.0)
+    assert x.numpy().sum() == 12
+    x.set_value(np.eye(2, dtype=np.float32))
+    np.testing.assert_allclose(x.numpy(), np.eye(2))
+
+
+def test_random_reproducible():
+    import paddle_tpu
+    paddle_tpu.seed(7)
+    a = paddle.randn([4, 4])
+    paddle_tpu.seed(7)
+    b = paddle.randn([4, 4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    c = paddle.rand([1000])
+    assert 0.0 <= float(c.min()) and float(c.max()) <= 1.0
+    r = paddle.randint(0, 10, [100])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 10
+
+
+def test_save_load(tmp_path):
+    path = str(tmp_path / "ckpt.pdparams")
+    obj = {"w": paddle.ones([2, 3]), "step": 7, "nested": [paddle.zeros([1])]}
+    paddle.save(obj, path)
+    loaded = paddle.load(path)
+    np.testing.assert_allclose(loaded["w"].numpy(), np.ones((2, 3)))
+    assert loaded["step"] == 7
+    np.testing.assert_allclose(loaded["nested"][0].numpy(), [0])
+
+
+def test_pad_short_form_pads_last_dim_first():
+    x = paddle.ones([1, 1, 2, 2])
+    y = paddle.ops.pad(x, [1, 0, 0, 0])  # pad W left
+    assert y.shape == [1, 1, 2, 3]
+    z = paddle.ops.pad(x, [0, 0, 1, 1])  # pad H both sides
+    assert z.shape == [1, 1, 4, 2]
+
+
+def test_mode():
+    v, i = paddle.ops.mode(paddle.to_tensor([3.0, 3.0, 3.0, 3.0, 7.0, 7.0, 1.0, 2.0]))
+    assert float(v) == 3.0
+
+
+def test_multinomial_batched():
+    p = paddle.ones([4, 3])
+    s = paddle.multinomial(p, 2, replacement=True)
+    assert s.shape == [4, 2]
+    s2 = paddle.multinomial(p, 2, replacement=False)
+    assert s2.shape == [4, 2]
+    row = s2.numpy()
+    assert all(len(set(r)) == 2 for r in row)  # no replacement
