@@ -32,6 +32,23 @@ from .device import (device_count, get_device, is_compiled_with_cuda,  # noqa
                      is_compiled_with_tpu, is_compiled_with_xpu, set_device)
 from .framework_io import load, save  # noqa: E402
 
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import jit  # noqa: E402
+from . import amp  # noqa: E402
+from . import io  # noqa: E402
+from . import metric  # noqa: E402
+from . import distribution  # noqa: E402
+from . import vision  # noqa: E402
+from . import text  # noqa: E402
+from . import hapi  # noqa: E402
+from .hapi import Model, summary  # noqa: E402
+from . import distributed  # noqa: E402
+from . import parallel  # noqa: E402
+from .parallel import DataParallel  # noqa: E402
+from .optimizer import regularizer  # noqa: E402
+from .nn.layer_base import ParamAttr  # noqa: E402
+
 CPUPlace = "cpu"
 TPUPlace = "tpu"
 

@@ -48,9 +48,11 @@ class Node:
     """
 
     __slots__ = ("seq", "inputs", "in_ids", "in_leaf", "in_nodes", "vjp_fn",
-                 "out_ids", "out_avals", "n_outs", "__weakref__")
+                 "out_ids", "out_avals", "n_outs", "out_is_tuple",
+                 "__weakref__")
 
-    def __init__(self, inputs, vjp_fn, out_ids, out_avals):
+    def __init__(self, inputs, vjp_fn, out_ids, out_avals,
+                 out_is_tuple=False):
         self.seq = next(_seq)
         self.inputs = inputs            # strong refs: leaves need .grad deposit
         self.in_ids = [t._bw_id for t in inputs]
@@ -60,6 +62,7 @@ class Node:
         self.out_ids = out_ids          # bw_id per output
         self.out_avals = out_avals      # (shape, dtype) per output
         self.n_outs = len(out_ids)
+        self.out_is_tuple = out_is_tuple
 
 
 _tls = threading.local()
@@ -134,8 +137,8 @@ def _sweep(nodes, cot, retain_graph, want=None, results=None,
         out_cots = tuple(
             cot.pop(oid) if oid in cot else _zero_cotangent(*aval)
             for oid, aval in zip(node.out_ids, node.out_avals))
-        in_cots = (node.vjp_fn(out_cots[0]) if node.n_outs == 1
-                   else node.vjp_fn(out_cots))
+        in_cots = (node.vjp_fn(out_cots) if node.out_is_tuple
+                   else node.vjp_fn(out_cots[0]))
         if not retain_graph:
             node.vjp_fn = None
         for tin, bid, leaf, g in zip(node.inputs, node.in_ids,

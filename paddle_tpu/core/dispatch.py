@@ -57,6 +57,12 @@ def apply(fn: Callable, *inputs, op_name: str | None = None,
     name = op_name or getattr(fn, "__name__", "op").lstrip("_")
     arrays = [as_array(x) for x in inputs]
 
+    # AMP autocast hook — the single cast point shared by eager and traced
+    # modes (reference: tracer.cc:160-163 AutoCastInputs)
+    from ..amp import amp_active, amp_cast_inputs
+    if amp_active():
+        arrays = amp_cast_inputs(name, arrays)
+
     diff_idx = []
     if autograd.grad_enabled() and not nondiff:
         for i, x in enumerate(inputs):
@@ -93,6 +99,7 @@ def apply(fn: Callable, *inputs, op_name: str | None = None,
             vjp_fn=vjp_fn,
             out_ids=[t._bw_id for t in out_tensors],
             out_avals=[(t.shape_tuple, np.dtype(t.data.dtype)) for t in out_tensors],
+            out_is_tuple=multi,
         )
         for t in out_tensors:
             t._node = node

@@ -1,1 +1,38 @@
-"""Placeholder — populated in subsequent milestones."""
+"""paddle_tpu.distributed — SPMD distribution over jax.sharding.Mesh
+(reference: python/paddle/distributed/ — SURVEY §2.2/§2.3: the c_* op zoo,
+NCCLCommContext rings and TCP bootstrap collapse into named mesh axes +
+lax collectives + jax.distributed.initialize)."""
+from . import fleet as _fleet_mod  # noqa: F401
+from .collective import (Group, ReduceOp, all_gather,  # noqa: F401
+                         all_gather_object, all_reduce, alltoall, barrier,
+                         broadcast, collective_permute, get_group, in_spmd,
+                         new_group, recv, reduce, reduce_scatter, scatter,
+                         send, spmd)
+from .env import (ParallelEnv, get_rank, get_world_size,  # noqa: F401
+                  init_parallel_env, is_initialized)
+from .fleet import Fleet, fleet  # noqa: F401
+from .mesh import (DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS, axis_size,  # noqa
+                   ensure_mesh, get_mesh, init_mesh, set_mesh, sharding)
+from .strategy import DistributedStrategy  # noqa: F401
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split parity (reference: collective.py:809)."""
+    from ..parallel.tp_layers import split as _split
+    return _split(x, size, operation, axis, num_partitions, gather_out,
+                  weight_attr, bias_attr, name)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: distributed/spawn.py.  On TPU the SPMD model needs no
+    process-per-device: run func once; the mesh spans all devices."""
+    func(*args)
+
+
+def launch():
+    raise NotImplementedError(
+        "paddle.distributed.launch: single-controller SPMD needs no "
+        "per-device process launcher; for multi-host, start one process "
+        "per host with COORDINATOR_ADDRESS/PADDLE_TRAINER_ID set and call "
+        "init_parallel_env().")

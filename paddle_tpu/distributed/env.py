@@ -1,0 +1,85 @@
+"""Process-level distributed environment.
+
+Reference analog: ``paddle.distributed.init_parallel_env`` (parallel.py:57),
+RoleMaker env parsing (role_maker.py:528), launch/fleetrun.  On TPU the
+process model is jax's: one controller process per host, all devices visible;
+``jax.distributed.initialize`` is the TCP-bootstrap equivalent
+(gen_comm_id_helper.cc analog) for multi-host.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from .mesh import ensure_mesh, init_mesh
+
+_initialized = False
+
+
+def init_parallel_env(mesh_shape=None):
+    """paddle.distributed.init_parallel_env parity.
+
+    Single-host: builds the global mesh over local devices.  Multi-host (env
+    ``PADDLE_TRAINERS_NUM``>1 or jax coordinator envs set): calls
+    ``jax.distributed.initialize`` first so jax.devices() spans all hosts.
+    """
+    global _initialized
+    if _initialized:
+        return ensure_mesh()
+    coord = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get(
+        "PADDLE_MASTER")
+    n_proc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if coord and n_proc > 1 and jax.process_count() == 1:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=n_proc,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    mesh = init_mesh(mesh_shape)
+    _initialized = True
+    return mesh
+
+
+def get_rank(group=None) -> int:
+    """Process rank (reference: paddle.distributed.get_rank)."""
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    """Number of *processes* (reference: get_world_size).  Note: on TPU a
+    process controls many devices; device-level parallelism lives in the
+    mesh axes, not in process count."""
+    return jax.process_count()
+
+
+def device_world_size() -> int:
+    return len(jax.devices())
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+class ParallelEnv:
+    """reference: fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []

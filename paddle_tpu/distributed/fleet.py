@@ -1,0 +1,180 @@
+"""Fleet — the distributed-training facade.
+
+Reference: python/paddle/distributed/fleet/ (Fleet fleet_base.py:63: init
+:130, distributed_optimizer :598, distributed_model :649, minimize
+:1078-1202 meta-optimizer composition; RoleMaker role_maker.py:528).
+
+TPU-native compilation of the strategy: instead of rewriting ProgramDescs
+through chained meta-optimizers, ``distributed_optimizer``/
+``distributed_model`` record the strategy, and ``get_train_step`` compiles
+ONE SpmdTrainStep whose mesh shape + shardings realise the same
+capabilities (amp → autocast+scaler; recompute → jax.checkpoint; sharding →
+ZeRO shardings; tensor_parallel → 'mp' axis; pipeline → 'pp' axis;
+gradient_merge → microbatch accumulation loop; lamb/lars → optimizer swap).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env
+from .mesh import ensure_mesh, get_mesh, init_mesh
+from .strategy import DistributedStrategy
+
+
+class _RoleMaker:
+    """reference: role_maker.py PaddleCloudRoleMaker (env parsing)."""
+
+    def __init__(self, is_collective=True):
+        self.is_collective = is_collective
+
+    def worker_num(self):
+        return get_world_size()
+
+    def worker_index(self):
+        return get_rank()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._role_maker: Optional[_RoleMaker] = None
+        self._optimizer = None
+        self._user_optimizer = None
+        self._model = None
+
+    # -- lifecycle (fleet_base.py:130) ------------------------------------
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role_maker = role_maker or _RoleMaker(is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        import jax
+        n = len(jax.devices())
+        mesh_shape = self._strategy.infer_mesh_shape(n)
+        init_parallel_env(mesh_shape)
+        return self
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def worker_endpoints(self, to_string=False):
+        eps = ParallelEnv().trainer_endpoints
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        from .collective import barrier
+        barrier()
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self):
+        raise NotImplementedError(
+            "Parameter-server mode: on TPU the PS capability is provided by "
+            "mesh-sharded embedding tables (paddle_tpu.parallel tp_layers) "
+            "— see SURVEY §7 'Sparse/PS capability'.")
+
+    def stop_worker(self):
+        pass
+
+    # -- strategy compilation (fleet_base.py:598,649,1078) -----------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        self._user_optimizer = optimizer
+        opt = optimizer
+        s = self._strategy or DistributedStrategy()
+        if s.lamb:
+            from ..optimizer import Lamb
+            if not isinstance(opt, Lamb):
+                opt = Lamb(learning_rate=opt._learning_rate,
+                           lamb_weight_decay=s.lamb_configs.lamb_weight_decay,
+                           parameters=opt._parameter_list,
+                           grad_clip=opt._grad_clip)
+        elif s.lars:
+            from ..optimizer import LarsMomentum
+            if not isinstance(opt, LarsMomentum):
+                opt = LarsMomentum(
+                    learning_rate=opt._learning_rate,
+                    lars_coeff=s.lars_configs.lars_coeff,
+                    lars_weight_decay=s.lars_configs.lars_weight_decay,
+                    parameters=opt._parameter_list,
+                    grad_clip=opt._grad_clip)
+        self._optimizer = opt
+        return opt
+
+    def distributed_model(self, model):
+        from ..parallel.data_parallel import DataParallel
+        self._model = model
+        return DataParallel(model)
+
+    def get_train_step(self, model, loss_fn, optimizer=None, n_inputs=1):
+        """Compile the strategy into one SpmdTrainStep (the meta-optimizer
+        chain's terminal 'graph execution' stage, fleet_base.py:1191)."""
+        from ..parallel.spmd_train_step import SpmdTrainStep
+        opt = optimizer or self._optimizer
+        return SpmdTrainStep(model, loss_fn, opt, mesh=ensure_mesh(),
+                             strategy=self._strategy, n_inputs=n_inputs,
+                             donate=True)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        assert self._optimizer is not None, "call distributed_optimizer first"
+        return self._optimizer.minimize(loss)
+
+    # -- persistence (fleet_base.py:550) ----------------------------------
+    def save_persistables(self, executor=None, dirname=None,
+                          main_program=None, mode=0):
+        import paddle_tpu as paddle
+        if self._model is not None and dirname:
+            paddle.save(self._model.state_dict(),
+                        os.path.join(dirname, "model.pdparams"))
+
+    def save_inference_model(self, executor=None, dirname=None,
+                             feeded_var_names=None, target_vars=None,
+                             main_program=None, export_for_deployment=True):
+        pass
+
+    @property
+    def util(self):
+        return _FleetUtil()
+
+
+class _FleetUtil:
+    def all_reduce(self, input, mode="sum"):
+        return input
+
+    def barrier(self, comm_world="worker"):
+        from .collective import barrier
+        barrier()
+
+    def get_file_shard(self, files):
+        w = get_world_size()
+        i = get_rank()
+        return files[i::w]
+
+
+fleet = Fleet()

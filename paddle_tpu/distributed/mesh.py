@@ -1,0 +1,79 @@
+"""Device-mesh management.
+
+TPU-native replacement for the reference's communicator plumbing: where the
+reference keys NCCL communicators by ``ring_id`` (reference:
+platform/collective_helper.h:52-115) bootstrapped over TCP
+(gen_comm_id_helper.cc:126-321), a TPU job has ONE ``jax.sharding.Mesh``
+whose *named axes* play the role of rings: 'dp' (data), 'mp' (tensor/model),
+'pp' (pipeline), 'sp' (sequence/context).  Multi-host bootstrap is
+``jax.distributed.initialize`` (SURVEY §2.3 mapping).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_lock = threading.Lock()
+_global_mesh: Optional[Mesh] = None
+
+DP_AXIS = "dp"
+MP_AXIS = "mp"
+PP_AXIS = "pp"
+SP_AXIS = "sp"
+
+
+def init_mesh(shape: Optional[Dict[str, int]] = None,
+              devices=None) -> Mesh:
+    """Create + install the global mesh.
+
+    ``shape`` maps axis name -> size, e.g. ``{"dp": 2, "mp": 4}``.  Defaults
+    to all devices on a single 'dp' axis (pure data parallel)."""
+    global _global_mesh
+    devices = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = {DP_AXIS: len(devices)}
+    sizes = list(shape.values())
+    n = int(np.prod(sizes))
+    assert n <= len(devices), (
+        f"mesh needs {n} devices, only {len(devices)} available")
+    arr = np.asarray(devices[:n]).reshape(sizes)
+    mesh = Mesh(arr, tuple(shape.keys()))
+    with _lock:
+        _global_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _global_mesh
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh
+    with _lock:
+        _global_mesh = mesh
+
+
+def ensure_mesh() -> Mesh:
+    if _global_mesh is None:
+        return init_mesh()
+    return _global_mesh
+
+
+def axis_size(name: str) -> int:
+    m = get_mesh()
+    if m is None or name not in m.shape:
+        return 1
+    return m.shape[name]
+
+
+def sharding(*spec) -> NamedSharding:
+    """NamedSharding over the global mesh with the given PartitionSpec."""
+    return NamedSharding(ensure_mesh(), PartitionSpec(*spec))
+
+
+def replicated() -> NamedSharding:
+    return NamedSharding(ensure_mesh(), PartitionSpec())

@@ -1,0 +1,213 @@
+"""DistributedStrategy — the single distributed-config object.
+
+Reference: framework/distributed_strategy.proto:126-171 + Python façade
+fleet/base/distributed_strategy.py.  The reference compiles this config into
+program rewrites via meta-optimizers (fleet_base.py:1159-1202); here it
+compiles into mesh shape + sharding rules + step-wrapper choices
+(SURVEY §5.6 'TPU equivalent: a single DistributedStrategy-like sharding
+config')."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AMPConfig:
+    """proto: distributed_strategy.proto AMPConfig."""
+    init_loss_scaling: float = 32768.0
+    incr_every_n_steps: int = 1000
+    decr_every_n_nan_or_inf: int = 2
+    incr_ratio: float = 2.0
+    decr_ratio: float = 0.5
+    use_dynamic_loss_scaling: bool = True
+    custom_white_list: list = field(default_factory=list)
+    custom_black_list: list = field(default_factory=list)
+    use_pure_fp16: bool = False
+    dtype: str = "bfloat16"
+
+
+@dataclass
+class RecomputeConfig:
+    """proto:67-69 — checkpoint tensors for activation recompute."""
+    checkpoints: list = field(default_factory=list)
+    enable_offload: bool = False
+
+
+@dataclass
+class ShardingConfig:
+    """proto:31-35 — ZeRO-style sharding (sharding_optimizer.py:33)."""
+    sharding_degree: int = 8
+    stage: int = 2                    # 1: opt-state, 2: +grads, 3: +params
+    fuse_broadcast_MB: float = 32.0
+    hybrid_dp: bool = False
+
+
+@dataclass
+class PipelineConfig:
+    """proto:120-124 — micro-batching (schedule in section_worker.cc)."""
+    micro_batch_size: int = 1
+    accumulate_steps: int = 1
+    schedule_mode: str = "1F1B"       # or 'F-then-B'
+    pp_degree: int = 1
+
+
+@dataclass
+class TensorParallelConfig:
+    tensor_parallel_degree: int = 1
+    tensor_init_seed: int = -1
+
+
+@dataclass
+class GradientMergeConfig:
+    """proto:61-64."""
+    k_steps: int = 1
+    avg: bool = True
+
+
+@dataclass
+class LocalSGDConfig:
+    """proto:51-54."""
+    k_steps: int = 1
+    begin_step: int = 1
+
+
+@dataclass
+class AdaptiveLocalSGDConfig:
+    init_k_steps: int = 1
+    begin_step: int = 1
+
+
+@dataclass
+class DGCConfig:
+    """proto — deep gradient compression."""
+    rampup_begin_step: int = 0
+    rampup_step: int = 1
+    sparsity: list = field(default_factory=lambda: [0.999])
+
+
+@dataclass
+class LambConfig:
+    lamb_weight_decay: float = 0.01
+    exclude_from_weight_decay: list = field(default_factory=list)
+
+
+@dataclass
+class LarsConfig:
+    lars_coeff: float = 0.001
+    lars_weight_decay: float = 0.0005
+    epsilon: float = 0.0
+    exclude_from_weight_decay: list = field(default_factory=list)
+
+
+@dataclass
+class AsyncConfig:
+    """proto:106-118 — parameter-server async/GEO knobs (accepted for
+    parity; PS capability is mesh-sharded embedding on TPU)."""
+    k_steps: int = -1
+    max_merge_var_num: int = 1
+    send_queue_size: int = 16
+    independent_recv_thread: bool = False
+    thread_pool_size: int = 1
+    send_wait_times: int = 1
+    runtime_split_send_recv: bool = False
+    launch_barrier: bool = True
+
+
+@dataclass
+class SequenceParallelConfig:
+    """Beyond-reference (SURVEY §5.7): ring-attention context parallelism."""
+    sp_degree: int = 1
+    ring_attention: bool = True
+
+
+class DistributedStrategy:
+    """fleet.DistributedStrategy parity: bool toggles + nested *_configs.
+
+    Toggles map 1:1 to the reference's proto fields; configs accept dicts
+    like the reference's property setters."""
+
+    _CONFIGS = {
+        "amp_configs": AMPConfig,
+        "recompute_configs": RecomputeConfig,
+        "sharding_configs": ShardingConfig,
+        "pipeline_configs": PipelineConfig,
+        "tensor_parallel_configs": TensorParallelConfig,
+        "gradient_merge_configs": GradientMergeConfig,
+        "localsgd_configs": LocalSGDConfig,
+        "adaptive_localsgd_configs": AdaptiveLocalSGDConfig,
+        "dgc_configs": DGCConfig,
+        "lamb_configs": LambConfig,
+        "lars_configs": LarsConfig,
+        "a_sync_configs": AsyncConfig,
+        "sequence_parallel_configs": SequenceParallelConfig,
+    }
+
+    def __init__(self):
+        # toggles (proto:126-171)
+        self.amp = False
+        self.recompute = False
+        self.sharding = False
+        self.pipeline = False
+        self.tensor_parallel = False
+        self.gradient_merge = False
+        self.localsgd = False
+        self.adaptive_localsgd = False
+        self.dgc = False
+        self.lamb = False
+        self.lars = False
+        self.a_sync = False
+        self.sequence_parallel = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True     # XLA does this natively
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1              # parity no-op
+        self.hierarchical_allreduce = False  # topology handled by XLA
+        self.elastic = False
+        self.auto = False
+        for name, cls in self._CONFIGS.items():
+            object.__setattr__(self, "_" + name, cls())
+
+    def __getattr__(self, name):
+        if name in DistributedStrategy._CONFIGS:
+            return getattr(self, "_" + name)
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name in self._CONFIGS:
+            cfg = self._CONFIGS[name]()
+            if isinstance(value, dict):
+                for k, v in value.items():
+                    if hasattr(cfg, k):
+                        setattr(cfg, k, v)
+            else:
+                cfg = value
+            object.__setattr__(self, "_" + name, cfg)
+        else:
+            object.__setattr__(self, name, value)
+
+    # -- mesh inference ---------------------------------------------------
+    def infer_mesh_shape(self, n_devices: int) -> Dict[str, int]:
+        """Derive the mesh {axis: size} this strategy implies."""
+        from .mesh import DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS
+        shape: Dict[str, int] = {}
+        mp = (self.tensor_parallel_configs.tensor_parallel_degree
+              if self.tensor_parallel else 1)
+        pp = (self.pipeline_configs.pp_degree if self.pipeline else 1)
+        sp = (self.sequence_parallel_configs.sp_degree
+              if self.sequence_parallel else 1)
+        dp = max(n_devices // (mp * pp * sp), 1)
+        if pp > 1:
+            shape[PP_AXIS] = pp
+        shape[DP_AXIS] = dp
+        if sp > 1:
+            shape[SP_AXIS] = sp
+        if mp > 1:
+            shape[MP_AXIS] = mp
+        return shape
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items()
+              if isinstance(v, bool) and v]
+        return f"DistributedStrategy(enabled={on})"

@@ -1,1 +1,143 @@
-"""Placeholder — populated in subsequent milestones."""
+"""Probability distributions (reference: python/paddle/distribution.py —
+Distribution/Uniform/Normal/Categorical)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, as_array
+from ..core.rng import next_key
+from ..core.tensor import Tensor
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return Tensor(jnp.exp(as_array(self.log_prob(value))))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = as_array(low)
+        self.high = as_array(high)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(np.shape(self.low))
+        u = jax.random.uniform(next_key(), shape)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        return apply(
+            lambda v: jnp.where((v >= self.low) & (v < self.high),
+                                -jnp.log(self.high - self.low), -jnp.inf),
+            value, op_name="uniform_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = as_array(loc)
+        self.scale = as_array(scale)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(np.shape(self.loc))
+        z = jax.random.normal(next_key(), shape)
+        return Tensor(self.loc + z * self.scale)
+
+    def log_prob(self, value):
+        loc, scale = self.loc, self.scale
+        return apply(
+            lambda v: (-((v - loc) ** 2) / (2 * scale ** 2)
+                       - jnp.log(scale) - 0.5 * math.log(2 * math.pi)),
+            value, op_name="normal_log_prob")
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale)
+                      + jnp.zeros_like(self.loc))
+
+    def kl_divergence(self, other):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = as_array(logits)
+
+    def sample(self, shape=()):
+        n = int(np.prod(shape)) if shape else 1
+        out = jax.random.categorical(
+            next_key(), self.logits, shape=tuple(shape) +
+            tuple(np.shape(self.logits))[:-1])
+        return Tensor(out)
+
+    def _probs(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return apply(lambda v: jnp.take_along_axis(
+            jnp.broadcast_to(logp, v.shape[:-0] + logp.shape),
+            v[..., None].astype(jnp.int32), axis=-1)[..., 0],
+            value, op_name="categorical_log_prob")
+
+    def probs(self, value):
+        p = self._probs()
+        return apply(lambda v: jnp.take_along_axis(
+            jnp.broadcast_to(p, v.shape[:-0] + p.shape),
+            v[..., None].astype(jnp.int32), axis=-1)[..., 0],
+            value, op_name="categorical_probs")
+
+    def entropy(self):
+        p = self._probs()
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-jnp.sum(p * logp, axis=-1))
+
+    def kl_divergence(self, other):
+        p = self._probs()
+        return Tensor(jnp.sum(
+            p * (jax.nn.log_softmax(self.logits, axis=-1)
+                 - jax.nn.log_softmax(other.logits, axis=-1)), axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.p = as_array(probs)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(np.shape(self.p))
+        return Tensor(jax.random.bernoulli(
+            next_key(), self.p, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        p = self.p
+        return apply(lambda v: v * jnp.log(jnp.maximum(p, 1e-12))
+                     + (1 - v) * jnp.log(jnp.maximum(1 - p, 1e-12)),
+                     value, op_name="bernoulli_log_prob")
+
+    def entropy(self):
+        p = self.p
+        return Tensor(-(p * jnp.log(jnp.maximum(p, 1e-12))
+                        + (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-12))))
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    return p.kl_divergence(q)

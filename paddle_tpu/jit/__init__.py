@@ -1,1 +1,13 @@
-"""Placeholder — populated in subsequent milestones."""
+"""paddle_tpu.jit — trace/compile execution mode
+(reference: python/paddle/fluid/dygraph/jit.py + dygraph_to_static/;
+SURVEY §7 step 2 'dual-mode dispatch')."""
+from .bind import bind, buffer_arrays, param_arrays, param_list  # noqa
+from .save_load import TranslatedLayer, load, save  # noqa: F401
+from .static_function import InputSpec, StaticFunction, to_static  # noqa
+from .train_step import TrainStep  # noqa: F401
+
+not_to_static = lambda fn: fn  # parity no-op
+
+
+def enable_to_static(flag: bool = True):
+    StaticFunction._enabled = flag

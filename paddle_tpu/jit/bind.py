@@ -1,0 +1,78 @@
+"""Parameter/buffer binding for functional execution.
+
+The bridge between the stateful Layer world and pure-functional XLA: swap
+every Parameter/buffer ``.data`` with (possibly traced) arrays for the
+duration of a trace, and collect buffer mutations (BatchNorm running stats)
+on exit so the compiled step can thread them as explicit outputs — the
+TPU answer to the reference's in-place Scope mutation (SURVEY §7
+hard-parts)."""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence
+
+
+class BindResult:
+    """Filled on context exit with mutated buffer values."""
+
+    def __init__(self):
+        self.new_buffers: Dict[str, object] = {}
+
+
+@contextlib.contextmanager
+def bind(layer, param_arrays: Optional[Sequence] = None,
+         buffer_arrays: Optional[Sequence] = None,
+         param_names: Optional[List[str]] = None):
+    """Bind ``param_arrays``/``buffer_arrays`` (aligned with
+    ``layer.named_parameters()`` / ``named_buffers()`` order) into the layer.
+
+    Yields a :class:`BindResult`; after the with-block, ``new_buffers`` maps
+    buffer names whose ``.data`` changed during the trace to the new value.
+    All original arrays are restored on exit.
+    """
+    params = list(layer.named_parameters())
+    buffers = list(layer.named_buffers())
+    old_p = [p.data for _, p in params]
+    old_b = [b.data for _, b in buffers]
+    res = BindResult()
+    try:
+        if param_arrays is not None:
+            assert len(param_arrays) == len(params), (
+                f"bind: {len(param_arrays)} arrays for {len(params)} params")
+            for (name, p), arr in zip(params, param_arrays):
+                p.data = arr
+        if buffer_arrays is not None:
+            assert len(buffer_arrays) == len(buffers)
+            for (name, b), arr in zip(buffers, buffer_arrays):
+                b.data = arr
+        yield res
+        # collect mutations: any buffer whose data is not the bound-in array
+        if buffer_arrays is not None:
+            for (name, b), arr in zip(buffers, buffer_arrays):
+                if b.data is not arr:
+                    res.new_buffers[name] = b.data
+        else:
+            for (name, b), old in zip(buffers, old_b):
+                if b.data is not old:
+                    res.new_buffers[name] = b.data
+    finally:
+        for (_, p), old in zip(params, old_p):
+            p.data = old
+        for (_, b), old in zip(buffers, old_b):
+            b.data = old
+
+
+def param_arrays(layer):
+    return [p.data for _, p in layer.named_parameters()]
+
+
+def buffer_arrays(layer):
+    return [b.data for _, b in layer.named_buffers()]
+
+
+def param_list(layer):
+    return [p for _, p in layer.named_parameters()]
+
+
+def buffer_names(layer):
+    return [n for n, _ in layer.named_buffers()]

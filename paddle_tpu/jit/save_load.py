@@ -1,0 +1,147 @@
+"""jit.save / jit.load — deployable model serialization.
+
+Reference analog: ``paddle.jit.save`` writes a ProgramDesc + params and
+``paddle.jit.load`` returns a TranslatedLayer (reference: dygraph/jit.py:269,
+io.py TranslatedLayer).  TPU-native: we export the traced forward as
+serialized StableHLO via ``jax.export`` (portable, version-stable) alongside
+the state_dict; ``load`` returns a :class:`TranslatedLayer` that executes
+the compiled artifact — the inference path needs no Python model code.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from ..core import autograd, rng
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ..framework_io import load as _load_obj
+from ..framework_io import save as _save_obj
+from .bind import bind, buffer_arrays, param_arrays
+from .static_function import InputSpec, StaticFunction
+
+SUFFIX_MODEL = ".pdmodel"
+SUFFIX_PARAMS = ".pdiparams"
+
+
+def _example_arrays(input_spec):
+    """InputSpecs with None/-1 dims become jax symbolic dimensions so the
+    exported artifact is shape-polymorphic (batch-size agnostic)."""
+    out = []
+    sym_count = [0]
+
+    def _sym():
+        sym_count[0] += 1
+        return f"b{sym_count[0]}"
+
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            if any(s is None or (isinstance(s, int) and s < 0)
+                   for s in spec.shape):
+                dims = ", ".join(
+                    _sym() if (s is None or s < 0) else str(s)
+                    for s in spec.shape)
+                shape = jax_export.symbolic_shape(dims)
+                out.append(jax.ShapeDtypeStruct(
+                    shape, convert_dtype(spec.dtype)))
+            else:
+                out.append(jnp.zeros(tuple(spec.shape),
+                                     convert_dtype(spec.dtype)))
+        elif isinstance(spec, Tensor):
+            out.append(spec.data)
+        else:
+            out.append(jnp.asarray(spec))
+    return out
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize ``layer`` for inference (StableHLO) + its state_dict."""
+    from ..nn.layer_base import Layer
+    fwd = layer.forward
+    if isinstance(fwd, StaticFunction):
+        spec = input_spec or fwd._input_spec
+        fwd_fn = fwd._fn
+    else:
+        spec = input_spec
+        fwd_fn = fwd
+    if spec is None:
+        raise ValueError(
+            "jit.save needs input_spec (list of InputSpec/example Tensors) "
+            "unless the layer was decorated with to_static(input_spec=...)")
+    examples = _example_arrays(spec)
+
+    was_training = layer.training
+    layer.eval()
+    p_arr = param_arrays(layer)
+    b_arr = buffer_arrays(layer)
+    fixed_key = jax.random.key(0)
+
+    def infer_fn(*in_arrays):
+        with autograd.no_grad(), rng.seed_scope(fixed_key):
+            with bind(layer):  # params bound to their concrete values
+                out = fwd_fn(*[Tensor(a) for a in in_arrays])
+        return jax.tree.map(
+            lambda t: t.data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    exported = jax_export.export(jax.jit(infer_fn))(*examples)
+    blob = exported.serialize()
+    if was_training:
+        layer.train()
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + SUFFIX_MODEL, "wb") as f:
+        meta = {
+            "format": "paddle_tpu.stablehlo.v1",
+            "in_shapes": [tuple(str(d) for d in e.shape) for e in examples],
+            "in_dtypes": [str(e.dtype) for e in examples],
+        }
+        head = pickle.dumps(meta)
+        f.write(len(head).to_bytes(8, "little"))
+        f.write(head)
+        f.write(blob)
+    _save_obj(layer.state_dict(), path + SUFFIX_PARAMS)
+
+
+class TranslatedLayer:
+    """Executable loaded model (reference: TranslatedLayer, io.py)."""
+
+    def __init__(self, exported, meta, state_dict):
+        self._exported = exported
+        self._meta = meta
+        self._state = state_dict
+        self.training = False
+
+    def __call__(self, *args):
+        arrays = [a.data if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+        out = self._exported.call(*arrays)
+        return jax.tree.map(Tensor, out)
+
+    forward = __call__
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def state_dict(self):
+        return self._state
+
+    def parameters(self):
+        return list(self._state.values())
+
+
+def load(path, **configs):
+    with open(path + SUFFIX_MODEL, "rb") as f:
+        n = int.from_bytes(f.read(8), "little")
+        meta = pickle.loads(f.read(n))
+        blob = f.read()
+    exported = jax_export.deserialize(blob)
+    state = (_load_obj(path + SUFFIX_PARAMS)
+             if os.path.exists(path + SUFFIX_PARAMS) else {})
+    return TranslatedLayer(exported, meta, state)

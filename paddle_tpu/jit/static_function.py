@@ -1,0 +1,227 @@
+"""to_static: trace-and-compile execution mode.
+
+TPU-native replacement for the reference's dy2static AST transpiler +
+ProgramTranslator + partial_program run_program_op (reference:
+python/paddle/fluid/dygraph/dygraph_to_static/, jit.py:160 `declarative`).
+
+Where the reference rewrites Python AST into a ProgramDesc and replays it
+with an Executor, we simply trace the SAME op functions with jax tracers and
+let XLA compile — "static mode" is a jit cache, and the whole compiled
+program participates in outer eager autograd as ONE fused op on the tape
+(its vjp is the compiled backward), mirroring how run_program_op embeds a
+traced program into dygraph autograd.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd, dispatch, rng
+from ..core.tensor import Tensor
+from .bind import bind, buffer_names, param_list
+
+
+class InputSpec:
+    """reference: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _find_layer(fn) -> Optional[object]:
+    from ..nn.layer_base import Layer
+    if isinstance(fn, Layer):
+        return fn
+    self = getattr(fn, "__self__", None)
+    if isinstance(self, Layer):
+        return self
+    return None
+
+
+class StaticFunction:
+    """Callable wrapping ``fn`` with jit compilation.
+
+    The compiled pure function takes (rng_key, *param_arrays,
+    *buffer_arrays, *tensor_args) and returns (outputs, new_buffer_values);
+    it is pushed through ``dispatch.apply`` so eager autograd sees it as a
+    single differentiable op.
+    """
+
+    def __init__(self, fn: Callable, input_spec=None, layer=None):
+        self._fn = fn.forward if layer is not None and fn is layer else fn
+        self._layer = layer if layer is not None else _find_layer(fn)
+        self._input_spec = input_spec
+        self._cache: Dict[Any, Callable] = {}
+        functools.update_wrapper(self, self._fn)
+
+    @property
+    def layer(self):
+        return self._layer
+
+    def _build(self, treedef, n_tensors, static_leaves, training):
+        layer = self._layer
+        fn = self._fn
+        n_p = len(param_list(layer)) if layer else 0
+        bnames = buffer_names(layer) if layer else []
+        n_b = len(bnames)
+
+        def pure_fn(key_data, *arrays):
+            key_data = jax.random.wrap_key_data(key_data)
+            p_arr = list(arrays[:n_p])
+            b_arr = list(arrays[n_p:n_p + n_b])
+            in_arr = arrays[n_p + n_b:]
+            # rebuild the (args, kwargs) structure with traced Tensors
+            leaves = []
+            it = iter(in_arr)
+            for leaf in static_leaves:
+                if leaf is _TENSOR_SENTINEL:
+                    leaves.append(Tensor(next(it), stop_gradient=True))
+                else:
+                    leaves.append(leaf)
+            args, kwargs = jax.tree.unflatten(treedef, leaves)
+            with autograd.no_grad(), rng.seed_scope(key_data):
+                if layer is not None:
+                    with bind(layer, p_arr, b_arr) as res:
+                        out = fn(*args, **kwargs)
+                    # new_buffers is populated on bind-context exit
+                    new_b = [res.new_buffers.get(n, old)
+                             for n, old in zip(bnames, b_arr)]
+                else:
+                    out = fn(*args, **kwargs)
+                    new_b = []
+            out_arrays = jax.tree.map(
+                lambda t: t.data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+            return out_arrays, tuple(new_b)
+
+        return jax.jit(pure_fn)
+
+    def __call__(self, *args, **kwargs):
+        layer = self._layer
+        params = param_list(layer) if layer else []
+        from .bind import buffer_arrays
+        b_arrs = buffer_arrays(layer) if layer else []
+        bnames = buffer_names(layer) if layer else []
+
+        leaves, treedef = jax.tree.flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        tensor_args = [l for l in leaves if isinstance(l, Tensor)]
+        static_leaves = tuple(
+            _TENSOR_SENTINEL if isinstance(l, Tensor) else l for l in leaves)
+        training = bool(layer.training) if layer is not None else False
+        key = (treedef, static_leaves, training)
+        try:
+            compiled = self._cache.get(key)
+        except TypeError:  # unhashable static leaf
+            key = None
+            compiled = None
+        if compiled is None:
+            compiled = self._build(treedef, len(tensor_args), static_leaves,
+                                   training)
+            if key is not None:
+                self._cache[key] = compiled
+
+        key_t = Tensor(jax.random.key_data(rng.next_key()))
+        inputs = [key_t] + list(params) + \
+            [Tensor(a) for a in b_arrs] + tensor_args
+
+        adapter = _MultiOut(compiled)
+        adapter.__name__ = getattr(self._fn, "__name__", "to_static")
+        out_flat = dispatch.apply(adapter, *inputs, op_name=adapter.__name__)
+        if not isinstance(out_flat, tuple):
+            out_flat = (out_flat,)
+        out, new_b = _renest(adapter, out_flat)
+        # write back mutated buffers (eager side effect)
+        if layer is not None and len(bnames):
+            buffers = dict(layer.named_buffers())
+            for n, t in zip(bnames, new_b):
+                buffers[n].data = t.data if isinstance(t, Tensor) else t
+        return out
+
+    def __get__(self, instance, owner=None):
+        """Descriptor protocol: @to_static on a method binds per instance
+        (the reference's declarative decorator does the analogous binding
+        via StaticFunction.__get__, dygraph/jit.py)."""
+        if instance is None:
+            return self
+        from ..nn.layer_base import Layer
+        key = "_static_fn_" + self._fn.__name__
+        cached = instance.__dict__.get(key) if hasattr(
+            instance, "__dict__") else None
+        if cached is not None:
+            return cached
+        layer = instance if isinstance(instance, Layer) else None
+        bound = StaticFunction(self._fn.__get__(instance, owner),
+                               self._input_spec, layer=layer)
+        try:
+            object.__setattr__(instance, key, bound)
+        except Exception:
+            pass
+        return bound
+
+    # concretisation for export/inference
+    def concrete(self, *example_args, **example_kwargs):
+        """Return (jitted_pure_fn, init_arrays) for AOT export."""
+        leaves, treedef = jax.tree.flatten(
+            (example_args, example_kwargs),
+            is_leaf=lambda x: isinstance(x, Tensor))
+        tensor_args = [l for l in leaves if isinstance(l, Tensor)]
+        static_leaves = tuple(
+            _TENSOR_SENTINEL if isinstance(l, Tensor) else l for l in leaves)
+        training = bool(self._layer.training) if self._layer else False
+        compiled = self._build(treedef, len(tensor_args), static_leaves,
+                               training)
+        return compiled, tensor_args
+
+
+class _TensorSentinel:
+    def __repr__(self):
+        return "<TensorArg>"
+
+
+_TENSOR_SENTINEL = _TensorSentinel()
+
+
+class _MultiOut:
+    """Adapter: dispatch.apply expects fn(*arrays); compiled returns
+    (out_tree, new_buffers).  Flatten outputs so the tape's vjp covers the
+    whole structure, then re-nest."""
+
+    def __init__(self, compiled):
+        self._compiled = compiled
+        self._out_treedef = None
+        self.__name__ = "to_static"
+
+    def __call__(self, key_data, *arrays):
+        out, new_b = self._compiled(key_data, *arrays)
+        flat, treedef = jax.tree.flatten(out)
+        self._out_treedef = (treedef, len(flat), len(new_b))
+        return tuple(flat) + tuple(new_b)
+
+
+def _renest(adapter, out_tensors):
+    treedef, n_out, n_b = adapter._out_treedef
+    outs = jax.tree.unflatten(treedef, list(out_tensors[:n_out]))
+    return outs, list(out_tensors[n_out:])
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
+    """``@paddle.jit.to_static`` parity (reference: dygraph/jit.py:160)."""
+    def decorate(fn):
+        from ..nn.layer_base import Layer
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, input_spec, layer=fn)
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, input_spec)
+    if function is not None:
+        return decorate(function)
+    return decorate

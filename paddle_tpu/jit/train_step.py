@@ -1,0 +1,142 @@
+"""TrainStep: whole-training-step compilation.
+
+The TPU-native analog of the reference's CompiledProgram/ParallelExecutor
+fast path (reference: fluid/compiler.py, parallel_executor.cc:619): forward,
+backward, gradient clip, and optimizer update are traced into ONE XLA
+executable with donated buffers, so the MXU never waits on Python between
+micro-steps.  Under a `Mesh` (paddle_tpu.distributed) the same step is
+pjit-sharded for DP/TP/PP hybrid execution.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd, rng
+from ..core.tensor import Tensor
+from .bind import bind, buffer_arrays, buffer_names, param_list
+
+_as_arr = lambda x: x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class TrainStep:
+    """Compile `loss = loss_fn(model(*inputs), *labels)` + optimizer update.
+
+    Usage::
+
+        step = TrainStep(model, loss_fn, opt)       # loss_fn(outputs, labels)
+        loss = step(x, y)                            # one fused XLA call
+
+    ``loss_fn`` receives (model_output, *labels) as Tensors inside the trace.
+    Model parameters / optimizer slots / buffers live as device arrays
+    between calls and are donated each step (no copies).
+    """
+
+    def __init__(self, model, loss_fn: Callable, optimizer,
+                 n_inputs: int = 1, donate: bool = False):
+        # donate=False by default: eager user code may alias param arrays
+        # (e.g. state_dict sharing); SpmdTrainStep/bench enable donation.
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.n_inputs = n_inputs
+        self._params = param_list(model)
+        self._bnames = buffer_names(model)
+        self._compiled: Dict[Any, Callable] = {}
+        self._opt_state = None
+        self._donate = donate
+
+    def _build(self, training: bool):
+        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        params_meta = self._params
+        bnames = self._bnames
+        n_in = self.n_inputs
+
+        def step_fn(p_arr, b_arr, opt_state, lr, step_i, key_data, inputs,
+                    labels):
+            key = jax.random.wrap_key_data(key_data)
+
+            def loss_of(p_list):
+                with autograd.no_grad(), rng.seed_scope(key):
+                    with bind(model, p_list, list(b_arr)) as res:
+                        out = model(*[Tensor(a) for a in inputs])
+                        lab = [Tensor(a) for a in labels]
+                        loss_t = loss_fn(out, *lab)
+                    # new_buffers is populated on bind-context exit
+                    new_b = tuple(
+                        _as_arr(res.new_buffers.get(n, old))
+                        for n, old in zip(bnames, b_arr))
+                return loss_t.data, new_b
+
+            (loss, new_b), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(list(p_arr))
+            new_p, new_s = opt.functional_update(
+                list(p_arr), grads, opt_state, lr, step_i,
+                params_meta=params_meta)
+            return loss, tuple(new_p), new_b, new_s
+
+        donate = (0, 1, 2) if self._donate else ()
+        return jax.jit(step_fn, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        assert len(batch) >= self.n_inputs, (
+            f"TrainStep expects at least {self.n_inputs} input(s)")
+        inputs = tuple(_as_arr(b) for b in batch[:self.n_inputs])
+        labels = tuple(_as_arr(b) for b in batch[self.n_inputs:])
+        p_arr = tuple(p.data for p in self._params)
+        b_arr = tuple(buffer_arrays(self.model))
+        if self._opt_state is None:
+            self._opt_state = self.optimizer.functional_init(list(p_arr))
+        key = self.optimizer  # noqa: F841 (readability)
+        training = self.model.training
+        compiled = self._compiled.get(training)
+        if compiled is None:
+            compiled = self._build(training)
+            self._compiled[training] = compiled
+
+        self.optimizer._step_count += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        step_i = jnp.asarray(self.optimizer._step_count, jnp.float32)
+        key_data = jax.random.key_data(rng.next_key())
+        loss, new_p, new_b, new_s = compiled(
+            p_arr, b_arr, self._opt_state, lr, step_i, key_data, inputs,
+            labels)
+        # write back (device-side aliasing, no host copies)
+        for p, arr in zip(self._params, new_p):
+            p.data = arr
+        buffers = dict(self.model.named_buffers())
+        for n, arr in zip(self._bnames, new_b):
+            buffers[n].data = arr
+        self._opt_state = new_s
+        return Tensor(loss)
+
+    def eval_step(self, *batch):
+        """Forward-only compiled step (no param update)."""
+        inputs = tuple(_as_arr(b) for b in batch[:self.n_inputs])
+        labels = tuple(_as_arr(b) for b in batch[self.n_inputs:])
+        model, loss_fn = self.model, self.loss_fn
+        bnames = self._bnames
+
+        key = ("eval", model.training)
+        compiled = self._compiled.get(key)
+        if compiled is None:
+            def eval_fn(p_arr, b_arr, key_data, inputs, labels):
+                k = jax.random.wrap_key_data(key_data)
+                with autograd.no_grad(), rng.seed_scope(k):
+                    with bind(model, list(p_arr), list(b_arr)):
+                        out = model(*[Tensor(a) for a in inputs])
+                        lab = [Tensor(a) for a in labels]
+                        loss_t = loss_fn(out, *lab)
+                out_arr = jax.tree.map(
+                    lambda t: t.data if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+                return loss_t.data, out_arr
+            compiled = jax.jit(eval_fn)
+            self._compiled[key] = compiled
+        p_arr = tuple(p.data for p in self._params)
+        b_arr = tuple(buffer_arrays(self.model))
+        key_data = jax.random.key_data(rng.next_key())
+        loss, out = compiled(p_arr, b_arr, key_data, inputs, labels)
+        return Tensor(loss), jax.tree.map(Tensor, out)

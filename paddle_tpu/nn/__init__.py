@@ -1,1 +1,34 @@
-"""Placeholder — populated in subsequent milestones."""
+"""paddle_tpu.nn — Layer system and neural-net layers
+(reference: python/paddle/nn/, ~19k LoC layer+functional; SURVEY §2.4)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer_base import Layer, ParamAttr  # noqa: F401
+from .layer.activation import (CELU, ELU, GELU, GLU, Hardshrink,  # noqa
+                               Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
+                               LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
+                               SELU, Sigmoid, Silu, Softmax, Softplus,
+                               Softshrink, Softsign, Swish, Tanh, Tanhshrink,
+                               ThresholdedReLU)
+from .layer.common import (AlphaDropout, Bilinear, CosineSimilarity,  # noqa
+                           Dropout, Dropout2D, Embedding, Flatten, Identity,
+                           Linear, Pad1D, Pad2D, Pad3D, PixelShuffle,
+                           Unfold, Upsample)
+from .layer.container import (LayerDict, LayerList, ParameterList,  # noqa
+                              Sequential)
+from .layer.conv import (Conv1D, Conv2D, Conv2DTranspose, Conv3D)  # noqa
+from .layer.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,  # noqa
+                         CrossEntropyLoss, CTCLoss, HingeEmbeddingLoss,
+                         KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
+                         NLLLoss, SmoothL1Loss)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,  # noqa
+                         GroupNorm, InstanceNorm1D, InstanceNorm2D,
+                         InstanceNorm3D, LayerNorm, LocalResponseNorm,
+                         SpectralNorm, SyncBatchNorm)
+from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,  # noqa
+                            AdaptiveMaxPool2D, AvgPool1D, AvgPool2D,
+                            AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D)
+from .layer.rnn import (BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN,  # noqa
+                        RNNCellBase, SimpleRNN, SimpleRNNCell)
+from .layer.transformer import (MultiHeadAttention, Transformer,  # noqa
+                                TransformerDecoder, TransformerDecoderLayer,
+                                TransformerEncoder, TransformerEncoderLayer)

@@ -1,0 +1,1000 @@
+"""nn.functional — functional mirror of the layer API
+(reference: python/paddle/nn/functional/*, lowering to
+operators/activation_op.*, conv_op.*, pool_op.*, softmax_op.*, etc.).
+
+All functions are thin wrappers over pure jnp/lax implementations dispatched
+through the shared tape/trace point; convs and matmuls map directly onto the
+MXU via lax.conv_general_dilated / dot_general.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply, as_array
+from ...core.rng import next_key
+from ...core.tensor import Tensor
+from ...ops.manipulation import pad as _pad_op
+
+# ---------------------------------------------------------------------------
+# activations (reference: operators/activation_op.cc kernel zoo)
+# ---------------------------------------------------------------------------
+
+
+def _act(jfn, name):
+    def op(x, name=None):
+        return apply(jfn, x, op_name=name)
+    op.__name__ = name
+    return op
+
+
+relu = _act(jax.nn.relu, "relu")
+relu6 = _act(jax.nn.relu6, "relu6")
+sigmoid = _act(jax.nn.sigmoid, "sigmoid")
+tanh = _act(jnp.tanh, "tanh")
+silu = _act(jax.nn.silu, "silu")
+swish = silu
+mish = _act(jax.nn.mish, "mish")
+softsign = _act(jax.nn.soft_sign, "softsign")
+tanhshrink = _act(lambda a: a - jnp.tanh(a), "tanhshrink")
+hardswish = _act(jax.nn.hard_swish, "hardswish")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), x,
+                 op_name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), x,
+                 op_name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _prelu(a, w):
+        if w.size == 1:
+            return jnp.where(a >= 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch] = w.size
+        return jnp.where(a >= 0, a, w.reshape(shape) * a)
+    return apply(_prelu, x, weight, op_name="prelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha), x, op_name="elu")
+
+
+def selu(x,
+         scale=1.0507009873554804934193349852946,
+         alpha=1.6732632423543772848170429916717, name=None):
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                 x, op_name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha), x, op_name="celu")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x,
+                 op_name="hardsigmoid")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), x, op_name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x,
+                 op_name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a - threshold,
+                                     jnp.where(a < -threshold, a + threshold,
+                                               0.0)),
+                 x, op_name="softshrink")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda a: jnp.where(a * beta > threshold, a,
+                                     jnp.log1p(jnp.exp(beta * a)) / beta),
+                 x, op_name="softplus")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _maxout(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply(_maxout, x, op_name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    return apply(lambda a: jax.nn.softmax(a, axis=axis), x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return apply(lambda a: jax.nn.log_softmax(a, axis=axis), x,
+                 op_name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    key = next_key()
+    def _gs(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            oh = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+            y = jax.lax.stop_gradient(oh - y) + y  # straight-through
+        return y
+    return apply(_gs, x, op_name="gumbel_softmax")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _normalize(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return apply(_normalize, x, op_name="normalize")
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b; W is [in, out] (reference: operators/matmul_v2 + fc)."""
+    if bias is None:
+        return apply(lambda a, w: jnp.matmul(a, w), x, weight,
+                     op_name="linear")
+    return apply(lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias,
+                 op_name="linear")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _bilinear(a, b, w):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        return out
+    out = apply(_bilinear, x1, x2, weight, op_name="bilinear")
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def _embedding(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids != padding_idx)[..., None].astype(w.dtype)
+            out = out * mask
+        return out
+    return apply(_embedding, x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda a: jax.nn.one_hot(a, num_classes), x,
+                 op_name="one_hot", nondiff=True)
+
+
+# ---------------------------------------------------------------------------
+# convolution (reference: operators/conv_op.*, conv_transpose_op.*)
+# ---------------------------------------------------------------------------
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _conv_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    raise ValueError(f"bad conv padding: {padding}")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """reference: operators/conv_op.cc; lowers to lax.conv_general_dilated
+    which XLA tiles onto the MXU."""
+    return _convnd(x, weight, bias, stride, padding, dilation, groups,
+                   data_format, 2)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups,
+                   data_format, 1)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups,
+                   data_format, 3)
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups, data_format,
+            n):
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad_spec = _conv_padding(padding, n)
+    channels_last = not data_format.startswith("NC")
+    sp = "".join("DHW"[3 - n:][i] for i in range(n))
+    if channels_last:
+        lhs_spec = "N" + sp + "C"
+    else:
+        lhs_spec = "NC" + sp
+    # paddle kernel layout: [out_c, in_c/groups, *spatial]
+    rhs_spec = "OI" + sp
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        as_array(x).shape, as_array(weight).shape,
+        (lhs_spec, rhs_spec, out_spec))
+
+    def _conv(a, w):
+        return jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad_spec,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+
+    out = apply(_conv, x, weight, op_name=f"conv{n}d")
+    if bias is not None:
+        shape = [1] * (n + 2)
+        shape[-1 if channels_last else 1] = -1
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW", name=None):
+    """reference: operators/conv_transpose_op.cc — implemented as the
+    gradient of conv2d (lax.conv_transpose with paddle's IOHW kernel)."""
+    n = 2
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    outpad = _norm_tuple(output_padding, n)
+    channels_last = not data_format.startswith("NC")
+    pad_int = padding if isinstance(padding, int) else None
+
+    def _convt(a, w):
+        # paddle kernel layout for transpose conv: [in_c, out_c/groups, H, W]
+        if channels_last:
+            a_ = jnp.moveaxis(a, -1, 1)
+        else:
+            a_ = a
+        k = _norm_tuple(w.shape[2], 1) + (w.shape[3],)
+        pads = _conv_padding(padding, n)
+        if isinstance(pads, str):
+            raise ValueError("string padding unsupported for conv_transpose")
+        # gradient-of-conv formulation: dilate input by stride, full-pad
+        lhs_dilation = stride
+        pad_list = []
+        for i in range(n):
+            kk = (w.shape[2 + i] - 1) * dilation[i] + 1
+            lo, hi = pads[i]
+            pad_list.append((kk - 1 - lo, kk - 1 - hi + outpad[i]))
+        w_flip = jnp.flip(w, axis=(2, 3))
+        w_t = jnp.swapaxes(w_flip, 0, 1)  # -> [out_c, in_c, H, W]
+        if groups > 1:
+            # grouped transpose: w is [in_c, out_c//g, kh, kw]
+            ic = a_.shape[1]
+            w_g = w_flip.reshape(groups, ic // groups, w.shape[1],
+                                 *w.shape[2:])
+            w_t = jnp.concatenate(
+                [jnp.swapaxes(w_g[g], 0, 1) for g in range(groups)], axis=0)
+        dn = jax.lax.conv_dimension_numbers(
+            a_.shape, w_t.shape, ("NCHW", "OIHW", "NCHW"))
+        out = jax.lax.conv_general_dilated(
+            a_, w_t, window_strides=(1, 1), padding=pad_list,
+            lhs_dilation=lhs_dilation, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    out = apply(_convt, x, weight, op_name="conv2d_transpose")
+    if bias is not None:
+        shape = [1, 1, 1, 1]
+        shape[-1 if channels_last else 1] = -1
+        out = out + bias.reshape(shape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pooling (reference: operators/pool_op.*)
+# ---------------------------------------------------------------------------
+
+def _pool(x, kernel, stride, padding, n, reducer, init, data_format,
+          ceil_mode=False, count_include_pad=True, average=False):
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride if stride is not None else kernel, n)
+    pads = _conv_padding(padding, n)
+    channels_last = not data_format.startswith("NC")
+    if channels_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pad_full = ([(0, 0)] + list(pads) + [(0, 0)]
+                    if not isinstance(pads, str) else pads)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pad_full = ([(0, 0), (0, 0)] + list(pads)
+                    if not isinstance(pads, str) else pads)
+
+    def _run(a):
+        out = jax.lax.reduce_window(a, init, reducer, window, strides,
+                                    pad_full)
+        if average:
+            if count_include_pad or (isinstance(pads, list)
+                                     and all(p == (0, 0) for p in pads)):
+                out = out / float(np.prod(kernel))
+            else:
+                ones = jnp.ones_like(a)
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                            strides, pad_full)
+                out = out / cnt
+        return out
+    return apply(_run, x, op_name="pool")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max,
+                 -jnp.inf, data_format, ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.add, 0.0,
+                 data_format, ceil_mode, count_include_pad=not exclusive,
+                 average=True)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max,
+                 -jnp.inf, "NCL", ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.add, 0.0,
+                 "NCL", ceil_mode, count_include_pad=not exclusive,
+                 average=True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
+                 -jnp.inf, data_format, ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.add, 0.0,
+                 data_format, ceil_mode, count_include_pad=not exclusive,
+                 average=True)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    os = _norm_tuple(output_size, 2)
+
+    def _aap(a):
+        if data_format.startswith("NC"):
+            N, C, H, W = a.shape
+            a_ = a
+        else:
+            N, H, W, C = a.shape
+            a_ = jnp.moveaxis(a, -1, 1)
+        # XLA-friendly: split into os windows when divisible, else mean over
+        # index buckets via reshape fallback
+        if H % os[0] == 0 and W % os[1] == 0:
+            out = a_.reshape(N, C, os[0], H // os[0], os[1], W // os[1])
+            out = out.mean(axis=(3, 5))
+        else:
+            # bucketed mean (static loop over output cells)
+            rows = [a_[:, :, (i * H) // os[0]:-(-(i + 1) * H // os[0]), :]
+                    for i in range(os[0])]
+            cells = []
+            for r in rows:
+                cells.append(jnp.stack(
+                    [r[:, :, :, (j * W) // os[1]:-(-(j + 1) * W // os[1])]
+                     .mean(axis=(2, 3)) for j in range(os[1])], axis=-1))
+            out = jnp.stack(cells, axis=2)
+        if not data_format.startswith("NC"):
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply(_aap, x, op_name="adaptive_avg_pool2d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    os = _norm_tuple(output_size, 2)
+
+    def _amp(a):
+        N, C, H, W = a.shape
+        if H % os[0] == 0 and W % os[1] == 0:
+            out = a.reshape(N, C, os[0], H // os[0], os[1], W // os[1])
+            return out.max(axis=(3, 5))
+        rows = [a[:, :, (i * H) // os[0]:-(-(i + 1) * H // os[0]), :]
+                for i in range(os[0])]
+        cells = []
+        for r in rows:
+            cells.append(jnp.stack(
+                [r[:, :, :, (j * W) // os[1]:-(-(j + 1) * W // os[1])]
+                 .max(axis=(2, 3)) for j in range(os[1])], axis=-1))
+        return jnp.stack(cells, axis=2)
+    return apply(_amp, x, op_name="adaptive_max_pool2d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    os = int(output_size)
+
+    def _aap(a):
+        N, C, L = a.shape
+        if L % os == 0:
+            return a.reshape(N, C, os, L // os).mean(axis=3)
+        return jnp.stack(
+            [a[:, :, (i * L) // os:-(-(i + 1) * L // os)].mean(axis=2)
+             for i in range(os)], axis=-1)
+    return apply(_aap, x, op_name="adaptive_avg_pool1d")
+
+
+# ---------------------------------------------------------------------------
+# normalisation (reference: operators/batch_norm_op.*, layer_norm_op.*)
+# ---------------------------------------------------------------------------
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Functional BN. In training mode returns (out, new_mean, new_var) data
+    updates through the Layer wrapper; here it computes with batch stats and
+    the Layer handles running-stat updates."""
+    ch_axis = 1 if data_format.startswith("NC") and as_array(x).ndim > 1 else -1
+    axes = tuple(i for i in range(as_array(x).ndim) if i != ch_axis % as_array(x).ndim)
+
+    use_batch = training and not use_global_stats
+
+    if use_batch:
+        def _bn(a, w, b):
+            m = jnp.mean(a, axis=axes, keepdims=True)
+            v = jnp.var(a, axis=axes, keepdims=True)
+            out = (a - m) * jax.lax.rsqrt(v + epsilon)
+            if w is not None:
+                out = out * _chan(w, a, ch_axis)
+            if b is not None:
+                out = out + _chan(b, a, ch_axis)
+            return out
+    else:
+        def _bn(a, w, b, rm=as_array(running_mean), rv=as_array(running_var)):
+            out = ((a - _chan(rm, a, ch_axis))
+                   * jax.lax.rsqrt(_chan(rv, a, ch_axis) + epsilon))
+            if w is not None:
+                out = out * _chan(w, a, ch_axis)
+            if b is not None:
+                out = out + _chan(b, a, ch_axis)
+            return out
+    return apply(_bn, x, weight, bias, op_name="batch_norm")
+
+
+def _chan(v, a, ch_axis):
+    shape = [1] * a.ndim
+    shape[ch_axis] = -1
+    return v.reshape(shape)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n = len(tuple(normalized_shape))
+
+    def _ln(a, *wb):
+        w = wb[0] if len(wb) > 0 else None
+        b = wb[1] if len(wb) > 1 else None
+        axes = tuple(range(a.ndim - n, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(_ln, *args, op_name="layer_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def _gn(a, *wb):
+        w = wb[0] if len(wb) > 0 else None
+        b = wb[1] if len(wb) > 1 else None
+        if not data_format.startswith("NC"):
+            a = jnp.moveaxis(a, -1, 1)
+        N, C = a.shape[:2]
+        spatial = a.shape[2:]
+        g = a.reshape(N, num_groups, C // num_groups, *spatial)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        v = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) * jax.lax.rsqrt(v + epsilon)).reshape(a.shape)
+        shape = [1, C] + [1] * len(spatial)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        if not data_format.startswith("NC"):
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(_gn, *args, op_name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def _in(a, *wb):
+        w = wb[0] if len(wb) > 0 else None
+        b = wb[1] if len(wb) > 1 else None
+        axes = tuple(range(2, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        if w is not None:
+            shape = [1, -1] + [1] * (a.ndim - 2)
+            out = out * w.reshape(shape)
+        if b is not None:
+            shape = [1, -1] + [1] * (a.ndim - 2)
+            out = out + b.reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(_in, *args, op_name="instance_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def _lrn(a):
+        sq = jnp.square(a)
+        half = size // 2
+        c = a.shape[1]
+        pads = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (a.ndim - 2)
+        padded = jnp.pad(sq, pads)
+        win = sum(padded[:, i:i + c] for i in range(size))
+        return a / (k + alpha * win) ** beta
+    return apply(_lrn, x, op_name="local_response_norm")
+
+
+# ---------------------------------------------------------------------------
+# dropout (reference: operators/dropout_op.*)
+# ---------------------------------------------------------------------------
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = next_key()
+
+    def _dropout(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0)
+        return jnp.where(keep, a, 0.0)
+    return apply(_dropout, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format.startswith("NC") else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format.startswith("NC") else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = next_key()
+
+    def _ad(a):
+        alpha = 1.6732632423543772848170429916717
+        scale = 1.0507009873554804934193349852946
+        neg = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        A = (q + neg ** 2 * q * p) ** -0.5
+        B = -A * p * neg
+        return A * jnp.where(keep, a, neg) + B
+    return apply(_ad, x, op_name="alpha_dropout")
+
+
+# ---------------------------------------------------------------------------
+# losses (reference: operators/cross_entropy_op.*, mse, bce, kldiv,
+# smooth_l1, margin_rank; python/paddle/nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    def _ce(logits, lab, *w):
+        wgt = w[0] if w else None
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        nclass = logits.shape[axis]
+        if soft_label:
+            tgt = lab
+        else:
+            lab_ = lab
+            if lab_.ndim == logp.ndim and lab_.shape[axis] == 1:
+                lab_ = jnp.squeeze(lab_, axis)
+            tgt = jax.nn.one_hot(lab_, nclass, axis=axis, dtype=logp.dtype)
+        if label_smoothing > 0.0:
+            tgt = tgt * (1.0 - label_smoothing) + label_smoothing / nclass
+        loss = -jnp.sum(tgt * logp, axis=axis)
+        if wgt is not None and not soft_label:
+            lab_ = lab
+            if lab_.ndim == logp.ndim and lab_.shape[axis] == 1:
+                lab_ = jnp.squeeze(lab_, axis)
+            loss = loss * jnp.take(wgt, lab_)
+        if not soft_label:
+            lab_ = lab
+            if lab_.ndim == logp.ndim and lab_.shape[axis] == 1:
+                lab_ = jnp.squeeze(lab_, axis)
+            mask = (lab_ != ignore_index).astype(loss.dtype)
+            loss = loss * mask
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply(_ce, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    loss = cross_entropy(logits, label, reduction="none",
+                         soft_label=soft_label, ignore_index=ignore_index,
+                         axis=axis)
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def _nll(logp, lab, *w):
+        wgt = w[0] if w else None
+        loss = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+        if wgt is not None:
+            loss = loss * jnp.take(wgt, lab)
+        return _reduce(loss, reduction)
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply(_nll, *args, op_name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 input, label, op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 input, label, op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _sl1(a, b):
+        d = a - b
+        abs_d = jnp.abs(d)
+        loss = jnp.where(abs_d < delta, 0.5 * d * d / delta,
+                         abs_d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply(_sl1, input, label, op_name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def _bce(p, t, *w):
+        eps = 1e-12
+        loss = -(t * jnp.log(jnp.maximum(p, eps))
+                 + (1 - t) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(_bce, *args, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def _bcewl(z, t, *extra):
+        i = 0
+        w = extra[i] if weight is not None else None
+        i += 1 if weight is not None else 0
+        pw = extra[i] if pos_weight is not None else None
+        # stable: max(z,0) - z*t + log(1+exp(-|z|))
+        loss = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            loss = loss * (t * (pw - 1) + 1)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply(_bcewl, *args, op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def _kl(logp, t):
+        loss = t * (jnp.log(jnp.maximum(t, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply(_kl, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply(lambda a, b, t: _reduce(
+        jnp.maximum(0.0, -t * (a - b) + margin), reduction),
+        input, other, label, op_name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    return apply(lambda a, t: _reduce(
+        jnp.where(t == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        input, label, op_name="hinge_embedding_loss")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def _cs(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply(_cs, x1, x2, op_name="cosine_similarity")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def _cel(a, b, t):
+        cs = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(t == 1, 1 - cs, jnp.maximum(0.0, cs - margin))
+        return _reduce(loss, reduction)
+    return apply(_cel, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def _sfl(z, t, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply(_sfl, *args, op_name="sigmoid_focal_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax's implementation (reference: warpctc dynload)."""
+    import optax
+    def _ctc(lp, lab, il, ll):
+        # optax expects [B, T, C] logits and paddings
+        lp_btc = jnp.transpose(lp, (1, 0, 2)) if lp.ndim == 3 else lp
+        B, T, C = lp_btc.shape
+        t_idx = jnp.arange(T)[None, :]
+        logitpad = (t_idx >= il[:, None]).astype(lp_btc.dtype)
+        L = lab.shape[1]
+        l_idx = jnp.arange(L)[None, :]
+        labelpad = (l_idx >= ll[:, None]).astype(lp_btc.dtype)
+        per_seq = optax.ctc_loss(lp_btc, logitpad, lab, labelpad,
+                                 blank_id=blank)
+        return _reduce(per_seq, reduction)
+    return apply(_ctc, log_probs, labels, input_lengths, label_lengths,
+                 op_name="ctc_loss")
+
+
+# ---------------------------------------------------------------------------
+# attention (tier-1 jnp path; the Pallas flash kernel replaces it on TPU)
+# ---------------------------------------------------------------------------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """[B, L, H, D] attention (paddle incubate layout).  On TPU the Pallas
+    flash-attention kernel (paddle_tpu.ops.pallas) replaces this when
+    FLAGS_use_pallas_kernels is on and shapes allow."""
+    dkey = next_key() if (dropout_p > 0.0 and training) else None
+
+    def _sdpa(q, k, v, *m):
+        mask = m[0] if m else None
+        B, Lq, H, D = q.shape
+        scale = 1.0 / math.sqrt(D)
+        qt = jnp.einsum("blhd,bshd->bhls", q, k) * scale
+        if is_causal:
+            causal = jnp.tril(jnp.ones((Lq, k.shape[1]), bool))
+            qt = jnp.where(causal[None, None], qt, -jnp.inf)
+        if mask is not None:
+            if mask.dtype == jnp.bool_:
+                qt = jnp.where(mask, qt, -jnp.inf)
+            else:
+                qt = qt + mask
+        w = jax.nn.softmax(qt, axis=-1)
+        if dkey is not None:
+            keep = jax.random.bernoulli(dkey, 1.0 - dropout_p, w.shape)
+            w = jnp.where(keep, w / (1.0 - dropout_p), 0.0)
+        return jnp.einsum("bhls,bshd->blhd", w, v)
+
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+    return apply(_sdpa, *args, op_name="scaled_dot_product_attention")
+
+
+# ---------------------------------------------------------------------------
+# misc (interpolate, pixel_shuffle, unfold, grid ops, sequence_mask)
+# ---------------------------------------------------------------------------
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    def _interp(a):
+        channels_last = not data_format.startswith("NC")
+        a_ = a if channels_last else jnp.moveaxis(a, 1, -1)
+        spatial = a_.shape[1:-1]
+        if size is not None:
+            out_sp = _norm_tuple(size, len(spatial))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(spatial)
+            out_sp = tuple(int(s * f) for s, f in zip(spatial, sf))
+        m = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        out = jax.image.resize(a_, (a_.shape[0], *out_sp, a_.shape[-1]),
+                               method=m)
+        return out if channels_last else jnp.moveaxis(out, -1, 1)
+    return apply(_interp, x, op_name="interpolate")
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def _ps(a):
+        N, C, H, W = a.shape
+        out = a.reshape(N, C // (r * r), r, r, H, W)
+        out = out.transpose(0, 1, 4, 2, 5, 3)
+        return out.reshape(N, C // (r * r), H * r, W * r)
+    return apply(_ps, x, op_name="pixel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    p = _conv_padding(paddings, 2)
+
+    def _unfold(a):
+        N, C, H, W = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s, padding=p, rhs_dilation=d,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                a.shape, (1, C, *k), ("NCHW", "OIHW", "NCHW")))
+        return patches.reshape(N, C * k[0] * k[1], -1)
+    return apply(_unfold, x, op_name="unfold")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    return _pad_op(x, pad, mode, value, data_format)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    from ...core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+    ml = maxlen or int(np.asarray(as_array(lengths)).max())
+    return apply(lambda l: (jnp.arange(ml)[None, :] <
+                            l[:, None]).astype(d),
+                 lengths, op_name="sequence_mask", nondiff=True)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _ls(t, *p):
+        n = t.shape[-1]
+        if p:
+            return (1 - epsilon) * t + epsilon * p[0]
+        return (1 - epsilon) * t + epsilon / n
+    args = [label] + ([prior_dist] if prior_dist is not None else [])
+    return apply(_ls, *args, op_name="label_smooth")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    def _ts(a):
+        NT, C, H, W = a.shape
+        N = NT // seg_num
+        v = a.reshape(N, seg_num, C, H, W)
+        fold = int(C * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(
+            v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                                 v[:, :-1, fold:2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(
+            NT, C, H, W)
+    return apply(_ts, x, op_name="temporal_shift")
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda a: jax.nn.glu(a, axis=axis), x, op_name="glu")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def _de(a):
+        n = a.shape[-1]
+        out = jnp.zeros(a.shape + (n,), a.dtype)
+        idx = jnp.arange(n)
+        return out.at[..., idx, idx].set(a)
+    return apply(_de, x, op_name="diag_embed")

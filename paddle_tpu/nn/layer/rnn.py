@@ -1,0 +1,370 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py; the
+reference executes RNNs as a `recurrent` sub-block op or cudnn kernels).
+
+TPU-first: the time loop is a single ``lax.scan`` inside one traced op, so
+XLA compiles the whole unrolled recurrence into one fused loop — no
+per-step Python dispatch."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from .. import functional as F
+from .. import initializer as I
+from ..layer_base import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        import paddle_tpu as paddle
+        B = batch_ref.shape[batch_dim_idx]
+        return paddle.full([B, self.hidden_size], init_value)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def _cell(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+        h = apply(_cell, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, op_name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    """Gate order i,f,g,o (paddle convention, rnn.py LSTMCell)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        H = self.hidden_size
+
+        def _cell(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = f * c + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+        new_h, new_c = apply(_cell, inputs, h, c, self.weight_ih,
+                             self.weight_hh, self.bias_ih, self.bias_hh,
+                             op_name="lstm_cell")
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    """Gate order r,z,c (paddle convention)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _cell(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xc = jnp.split(xg, 3, axis=-1)
+            hr, hz, hc = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            c = jnp.tanh(xc + r * hc)
+            return (1 - z) * c + z * h
+        h = apply(_cell, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, op_name="gru_cell")
+        return h, h
+
+
+def _scan_layer(mode, x, h0, c0, wi, wh, bi, bh, reverse=False):
+    """One direction of one layer as a lax.scan (pure function)."""
+    def step(carry, xt):
+        if mode == "LSTM":
+            h, c = carry
+            gates = xt @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+        elif mode == "GRU":
+            h = carry
+            xg = xt @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xc = jnp.split(xg, 3, axis=-1)
+            hr, hz, hc = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            c = jnp.tanh(xc + r * hc)
+            h = (1 - z) * c + z * h
+            return h, h
+        else:
+            h = carry
+            h = jnp.tanh(xt @ wi.T + bi + h @ wh.T + bh)
+            return h, h
+
+    init = (h0, c0) if mode == "LSTM" else h0
+    carry, ys = jax.lax.scan(step, init, x, reverse=reverse)
+    return carry, ys
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.num_directions = 2 if direction in ("bidirect",
+                                                 "bidirectional") else 1
+        g = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = (input_size if layer == 0
+                         else hidden_size * self.num_directions)
+                suffix = "_reverse" if d == 1 else ""
+                wi = self.create_parameter([g * hidden_size, in_sz],
+                                           weight_ih_attr,
+                                           default_initializer=u)
+                wh = self.create_parameter([g * hidden_size, hidden_size],
+                                           weight_hh_attr,
+                                           default_initializer=u)
+                bi = self.create_parameter([g * hidden_size], bias_ih_attr,
+                                           is_bias=True,
+                                           default_initializer=u)
+                bh = self.create_parameter([g * hidden_size], bias_hh_attr,
+                                           is_bias=True,
+                                           default_initializer=u)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", wi)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", wh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", bi)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", bh)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+        x = inputs
+        if not self.time_major:
+            x = x.transpose([1, 0, 2])  # -> [T, B, F]
+        T, B = x.shape[0], x.shape[1]
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+        is_lstm = self.mode == "LSTM"
+
+        if initial_states is None:
+            h0 = paddle.zeros([L * D, B, H])
+            c0 = paddle.zeros([L * D, B, H]) if is_lstm else None
+        else:
+            if is_lstm:
+                h0, c0 = initial_states
+            else:
+                h0, c0 = initial_states, None
+
+        mode = self.mode
+        n_weights = len(self._all_weights)
+
+        def _run(xa, h0a, *rest):
+            if is_lstm:
+                c0a = rest[0]
+                flat_w = rest[1:]
+            else:
+                c0a = None
+                flat_w = rest
+            ws = [flat_w[i * 4:(i + 1) * 4] for i in range(n_weights)]
+            out = xa
+            final_h, final_c = [], []
+            for layer in range(L):
+                outs_d = []
+                for d in range(D):
+                    wi, wh, bi, bh = ws[layer * D + d]
+                    hh = h0a[layer * D + d]
+                    cc = c0a[layer * D + d] if is_lstm else None
+                    carry, ys = _scan_layer(mode, out, hh, cc, wi, wh, bi,
+                                            bh, reverse=(d == 1))
+                    if is_lstm:
+                        final_h.append(carry[0])
+                        final_c.append(carry[1])
+                    else:
+                        final_h.append(carry)
+                    outs_d.append(ys)
+                out = (outs_d[0] if D == 1
+                       else jnp.concatenate(outs_d, axis=-1))
+            fh = jnp.stack(final_h, axis=0)
+            if is_lstm:
+                fc = jnp.stack(final_c, axis=0)
+                return out, fh, fc
+            return out, fh
+
+        flat_params = [p for tup in self._all_weights for p in tup]
+        if is_lstm:
+            res = apply(_run, x, h0, c0, *flat_params, op_name="lstm")
+            out, fh, fc = res
+            states = (fh, fc)
+        else:
+            out, fh = apply(_run, x, h0, *flat_params,
+                            op_name=self.mode.lower())
+            states = fh
+        if not self.time_major:
+            out = out.transpose([1, 0, 2])
+        return out, states
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class RNN(Layer):
+    """Generic cell-driven RNN wrapper (reference: nn/layer/rnn.py RNN).
+
+    Eager: python loop over time.  For compiled execution use the fused
+    SimpleRNN/LSTM/GRU classes (lax.scan)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        T = x.shape[0]
+        state = initial_states
+        outs = []
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in steps:
+            out, state = self.cell(x[t], state)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        y = paddle.stack(outs, axis=0)
+        if not self.time_major:
+            y = y.transpose([1, 0, 2])
+        return y, state
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        y_fw, s_fw = self.rnn_fw(inputs, states_fw)
+        y_bw, s_bw = self.rnn_bw(inputs, states_bw)
+        return paddle.concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)

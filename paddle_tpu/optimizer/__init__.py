@@ -1,1 +1,8 @@
-"""Placeholder — populated in subsequent milestones."""
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/ +
+operators/optimizers/ kernel zoo — SURVEY §2.1 'Optimizer ops')."""
+from . import lr  # noqa: F401
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                   ClipGradByValue, clip_grad_norm_)
+from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,  # noqa
+                        Lamb, LarsMomentum, Momentum, Optimizer, RMSProp)
+from .regularizer import L1Decay, L2Decay  # noqa: F401

@@ -1,0 +1,79 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py:152,243,345 —
+ClipGradByValue/ByNorm/ByGlobalNorm, applied inside optimizer apply)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """params_grads: list of (param, grad_array). Returns same structure
+        with clipped grads.  Pure w.r.t. arrays → usable under jit."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    """reference: fluid/clip.py:152."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        return [(p, jnp.clip(g, self.min, self.max))
+                for p, g in params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    """reference: fluid/clip.py:243 — per-tensor norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, g * scale))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """reference: fluid/clip.py:345 — joint norm over all grads."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        if not params_grads:
+            return params_grads
+        needs = [(p, g) for p, g in params_grads
+                 if getattr(p, "need_clip", True)]
+        sq = sum(jnp.sum(jnp.square(g)) for _, g in needs)
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(p, g * scale if getattr(p, "need_clip", True) else g)
+                for p, g in params_grads]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """torch-style helper operating on .grad in place."""
+    params = [p for p in parameters if p._grad_data is not None]
+    if not params:
+        return Tensor(jnp.asarray(0.0))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p._grad_data)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p._grad_data) ** norm_type)
+             for p in params])) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+    for p in params:
+        p._grad_data = p._grad_data * scale
+    return Tensor(total)

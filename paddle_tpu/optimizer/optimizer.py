@@ -1,0 +1,434 @@
+"""Optimizers.
+
+TPU-native replacement for the reference's optimizer-op zoo (reference:
+paddle/fluid/operators/optimizers/ — sgd_op, momentum_op, adam_op, lamb_op,
+lars_momentum_op...; python façade python/paddle/optimizer/).
+
+Design: every optimizer defines two PURE functions over arrays —
+``init_slots`` and ``update_param`` — shared by:
+- eager ``.step()`` (reads ``param.grad``, writes ``param.data``), and
+- the jit path (``paddle_tpu.jit.TrainStep`` tree-maps them inside one
+  compiled XLA program, where the whole update fuses into a handful of
+  kernels — the analog of the reference's fused optimizer kernels).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.tensor import Parameter, Tensor
+from .clip import ClipGradBase
+from .lr import LRScheduler
+from .regularizer import L1Decay, L2Decay, WeightDecayRegularizer
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                # param groups: flatten (kept simple; per-group lr TODO)
+                flat = []
+                for grp in parameters:
+                    flat.extend(grp["params"])
+                parameters = flat
+        self._parameter_list: Optional[List[Parameter]] = parameters
+        self._learning_rate = learning_rate
+        self._grad_clip: Optional[ClipGradBase] = grad_clip
+        if isinstance(weight_decay, (int, float)):
+            weight_decay = L2Decay(float(weight_decay))
+        self._weight_decay: Optional[WeightDecayRegularizer] = weight_decay
+        self._multi_precision = multi_precision
+        self._slots: Dict[int, Dict[str, Any]] = {}
+        self._step_count = 0
+
+    # -- lr ---------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when learning rate is an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return (self._learning_rate
+                if isinstance(self._learning_rate, LRScheduler) else None)
+
+    # -- pure per-param update (override these two) -----------------------
+    def init_slots(self, p: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def update_param(self, p, g, slots, lr, step):
+        raise NotImplementedError
+
+    # -- regularization ----------------------------------------------------
+    def _apply_decay(self, param: Parameter, g):
+        """Param-level regularizer wins over optimizer-level
+        (reference: fluid/regularizer.py append_regularization_ops)."""
+        reg = getattr(param, "regularizer", None) or self._weight_decay
+        if reg is not None and not self._decoupled():
+            g = reg(param.data, g)
+        return g
+
+    def _decoupled(self) -> bool:
+        return False  # AdamW overrides
+
+    # -- eager step --------------------------------------------------------
+    def step(self):
+        assert self._parameter_list is not None, (
+            "optimizer constructed without parameters; pass parameters= "
+            "or use the functional interface")
+        self._step_count += 1
+        pg = []
+        for p in self._parameter_list:
+            if not p.trainable or p._grad_data is None:
+                continue
+            g = self._apply_decay(p, p._grad_data)
+            pg.append((p, g))
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        lr = self.get_lr()
+        for p, g in pg:
+            slots = self._slots.get(id(p))
+            if slots is None:
+                slots = self.init_slots(p.data)
+                if (self._multi_precision
+                        and p.data.dtype in (jnp.bfloat16, jnp.float16)):
+                    slots["master"] = p.data.astype(jnp.float32)
+                self._slots[id(p)] = slots
+            plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+            if "master" in slots:
+                master = slots["master"]
+                new_master, new_slots = self.update_param(
+                    master, g.astype(jnp.float32),
+                    {k: v for k, v in slots.items() if k != "master"},
+                    plr, self._step_count)
+                new_slots["master"] = new_master
+                p.data = new_master.astype(p.data.dtype)
+            else:
+                p.data, new_slots = self.update_param(
+                    p.data, g, slots, plr, self._step_count)
+            self._slots[id(p)] = new_slots
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in (self._parameter_list or [])]
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list or []:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- functional interface (used by jit.TrainStep) ----------------------
+    def functional_init(self, param_arrays: Sequence[jnp.ndarray]):
+        states = []
+        for p in param_arrays:
+            s = self.init_slots(p)
+            if (self._multi_precision
+                    and p.dtype in (jnp.bfloat16, jnp.float16)):
+                s["master"] = p.astype(jnp.float32)
+            states.append(s)
+        return states
+
+    def functional_update(self, param_arrays, grad_arrays, states, lr,
+                          step, params_meta=None):
+        """Pure: returns (new_params, new_states). ``lr``/``step`` may be
+        traced scalars.  params_meta: optional list of Parameters for
+        regularizer / per-param lr metadata."""
+        meta = params_meta or [None] * len(param_arrays)
+        if self._grad_clip is not None:
+            pg = self._grad_clip(list(zip(meta, grad_arrays)))
+            grad_arrays = [g for _, g in pg]
+        new_ps, new_ss = [], []
+        for p, g, s, m in zip(param_arrays, grad_arrays, states, meta):
+            if m is not None:
+                reg = getattr(m, "regularizer", None) or self._weight_decay
+                if reg is not None and not self._decoupled():
+                    g = reg(p, g)
+                plr = lr * getattr(m, "optimize_attr", {}).get("learning_rate", 1.0)
+            elif self._weight_decay is not None and not self._decoupled():
+                g = self._weight_decay(p, g)
+                plr = lr
+            else:
+                plr = lr
+            if "master" in s:
+                sub = {k: v for k, v in s.items() if k != "master"}
+                new_master, ns = self.update_param(
+                    s["master"], g.astype(jnp.float32), sub, plr, step)
+                ns["master"] = new_master
+                new_ps.append(new_master.astype(p.dtype))
+            else:
+                np_, ns = self.update_param(p, g, s, plr, step)
+                new_ps.append(np_)
+            new_ss.append(ns)
+        return new_ps, new_ss
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self):
+        out = {"step": self._step_count, "slots": {}}
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                s = self._slots.get(id(p))
+                if s:
+                    out["slots"][str(i)] = {k: np.asarray(v)
+                                            for k, v in s.items()}
+        if self._lr_scheduler is not None:
+            out["lr_scheduler"] = self._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("step", 0)
+        slots = state.get("slots", {})
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                if str(i) in slots:
+                    self._slots[id(p)] = {
+                        k: jnp.asarray(v) for k, v in slots[str(i)].items()}
+        if self._lr_scheduler is not None and "lr_scheduler" in state:
+            self._lr_scheduler.set_state_dict(state["lr_scheduler"])
+
+
+class SGD(Optimizer):
+    """reference: operators/optimizers/sgd_op.cc."""
+
+    def update_param(self, p, g, slots, lr, step):
+        return p - lr * g.astype(p.dtype), slots
+
+
+class Momentum(Optimizer):
+    """reference: operators/optimizers/momentum_op.h."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def init_slots(self, p):
+        return {"velocity": jnp.zeros_like(
+            p, dtype=jnp.float32 if self._multi_precision else p.dtype)}
+
+    def update_param(self, p, g, slots, lr, step):
+        g = g.astype(p.dtype)
+        v = self._momentum * slots["velocity"] + g
+        if self._use_nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """reference: operators/optimizers/adam_op.h."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_slots(self, p):
+        dt = jnp.float32 if p.dtype in (jnp.bfloat16, jnp.float16) else p.dtype
+        return {"m": jnp.zeros_like(p, dtype=dt),
+                "v": jnp.zeros_like(p, dtype=dt)}
+
+    def update_param(self, p, g, slots, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        g = g.astype(slots["m"].dtype)
+        m = b1 * slots["m"] + (1 - b1) * g
+        v = b2 * slots["v"] + (1 - b2) * g * g
+        # bias correction with traced-friendly power
+        step_f = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - b1 ** step_f)
+        vhat = v / (1 - b2 ** step_f)
+        upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+        return (p - upd.astype(p.dtype)), {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: adamw — python/paddle/optimizer/
+    adamw.py; decay applied directly to the param, not the grad)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = (weight_decay.coeff
+                       if isinstance(weight_decay, L2Decay)
+                       else float(weight_decay))
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled(self):
+        return True
+
+    def update_param(self, p, g, slots, lr, step, param_name=None):
+        if (self._apply_decay_param_fun is None
+                or (param_name is not None
+                    and self._apply_decay_param_fun(param_name))):
+            p = p - lr * self._coeff * p
+        return super().update_param(p, g, slots, lr, step)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_slots(self, p):
+        return {"m": jnp.zeros_like(p), "inf": jnp.zeros_like(p)}
+
+    def update_param(self, p, g, slots, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        m = b1 * slots["m"] + (1 - b1) * g
+        u = jnp.maximum(b2 * slots["inf"], jnp.abs(g))
+        step_f = jnp.asarray(step, jnp.float32)
+        new_p = p - (lr / (1 - b1 ** step_f)) * m / (u + eps)
+        return new_p, {"m": m, "inf": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_slots(self, p):
+        return {"moment": jnp.full_like(p, self._init_acc)}
+
+    def update_param(self, p, g, slots, lr, step):
+        mom = slots["moment"] + g * g
+        return p - lr * g / (jnp.sqrt(mom) + self._eps), {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps, self._rho = epsilon, rho
+
+    def init_slots(self, p):
+        return {"avg_sq_grad": jnp.zeros_like(p),
+                "avg_sq_update": jnp.zeros_like(p)}
+
+    def update_param(self, p, g, slots, lr, step):
+        rho, eps = self._rho, self._eps
+        asg = rho * slots["avg_sq_grad"] + (1 - rho) * g * g
+        upd = g * jnp.sqrt(slots["avg_sq_update"] + eps) / jnp.sqrt(asg + eps)
+        asu = rho * slots["avg_sq_update"] + (1 - rho) * upd * upd
+        return p - lr * upd, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def init_slots(self, p):
+        s = {"mean_square": jnp.zeros_like(p),
+             "momentum": jnp.zeros_like(p)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p)
+        return s
+
+    def update_param(self, p, g, slots, lr, step):
+        rho, eps = self._rho, self._eps
+        ms = rho * slots["mean_square"] + (1 - rho) * g * g
+        out = dict(slots, mean_square=ms)
+        if self._centered:
+            mg = rho * slots["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + eps)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * slots["momentum"] + lr * g / denom
+        out["momentum"] = mom
+        return p - mom, out
+
+
+class Lamb(Optimizer):
+    """reference: operators/optimizers/lamb_op.h (large-batch)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_slots(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def update_param(self, p, g, slots, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        m = b1 * slots["m"] + (1 - b1) * g
+        v = b2 * slots["v"] + (1 - b2) * g * g
+        step_f = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - b1 ** step_f)
+        vhat = v / (1 - b2 ** step_f)
+        r = mhat / (jnp.sqrt(vhat) + eps) + self._wd * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where(
+            (w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"m": m, "v": v}
+
+
+class LarsMomentum(Optimizer):
+    """reference: operators/optimizers/lars_momentum_op.cc."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+
+    def init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def update_param(self, p, g, slots, lr, step):
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * w_norm
+            / (g_norm + self._lars_wd * w_norm + self._eps), lr)
+        v = (self._momentum * slots["velocity"]
+             + local_lr * (g + self._lars_wd * p))
+        return p - v, {"velocity": v}

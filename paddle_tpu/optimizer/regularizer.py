@@ -1,0 +1,26 @@
+"""Weight-decay regularizers (reference: python/paddle/fluid/regularizer.py
+L1Decay/L2Decay — appended to gradients before the update op)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param_array, grad_array):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param_array, grad_array):
+        return grad_array + self.coeff * param_array
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param_array, grad_array):
+        return grad_array + self.coeff * jnp.sign(param_array)

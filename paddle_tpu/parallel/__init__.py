@@ -1,1 +1,14 @@
-"""Placeholder — populated in subsequent milestones."""
+"""paddle_tpu.parallel — parallel execution building blocks
+(reference analogs: imperative/reducer.cc DataParallel, fleet meta-parallel
+layers, sharding_optimizer.py, section_worker.cc pipeline schedules; plus
+beyond-reference ring attention, SURVEY §5.7)."""
+from .data_parallel import DataParallel  # noqa: F401
+from .pipeline import (Pipeline, PipelineStage, pipelined_fn,  # noqa
+                       pipeline_train_fn, stack_stage_params)
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .ring_attention import (reference_attention, ring_attention,  # noqa
+                             ring_attention_per_device)
+from .spmd_train_step import SpmdTrainStep  # noqa: F401
+from .tp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa
+                        RowParallelLinear, VocabParallelEmbedding,
+                        get_placement, set_placement, split)

@@ -1,0 +1,46 @@
+"""DataParallel wrapper.
+
+Reference: ``paddle.DataParallel`` (fluid/dygraph/parallel.py:323) backed by
+the C++ Reducer (imperative/reducer.cc: gradient bucketing + fused
+allreduce) and NCCLParallelContext.
+
+TPU-native: under SPMD compilation the gradient allreduce falls out of
+GSPMD when the batch is sharded over 'dp' — there is nothing to bucket
+(XLA fuses collectives itself).  This wrapper therefore:
+- in eager mode: passthrough (single-controller sees the global batch)
+- exposes ``scale_loss``/``apply_collective_grads`` as the documented
+  no-ops (SURVEY §7 step 6: kept for API compatibility)
+- carries comm_buffer_size/last_comm_buffer_size knobs for parity.
+"""
+from __future__ import annotations
+
+from ..nn.layer_base import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        """No-op on TPU: loss scaling by nranks is folded into the mean over
+        the dp-sharded batch (reference: parallel.py:572)."""
+        return loss
+
+    def apply_collective_grads(self):
+        """No-op: grad psum is inserted by GSPMD (reference:
+        parallel.py:581)."""
+        return
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

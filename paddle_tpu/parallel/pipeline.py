@@ -1,0 +1,162 @@
+"""Pipeline parallelism.
+
+Reference: PipelineTrainer + SectionWorker (reference: trainer.h:328,
+section_worker.cc:115-165 — F-then-B and 1F1B microbatch schedules;
+program splitting in fluid/optimizer.py:3954 `_split_program`; inter-stage
+tensors via send_v2/recv_v2 collective ops).
+
+TPU-native design: stages are SPMD over a 'pp' mesh axis.  Each device
+executes the SAME stage function with ITS stage's parameters (stage params
+stacked on a leading axis and sharded over 'pp'); activations move between
+neighbouring stages with ``lax.ppermute`` (the send_v2/recv_v2 analog, but
+compiler-scheduled over ICI).  The fill-drain schedule is a ``lax.scan``
+over M + S - 1 ticks, so forward AND backward pipeline in one compiled
+program — differentiating the scan yields the reverse schedule
+automatically (the 1F1B interleaving the reference hand-codes in
+section_worker.cc:128-165 is here XLA's latency-hiding scheduler's job).
+
+Requirement (same as the reference's section programs): all stages must be
+shape-uniform — activation shape in == activation shape out (true for
+transformer blocks).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+from jax import shard_map
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from ..distributed.mesh import PP_AXIS, ensure_mesh
+from ..jit.bind import bind, param_list
+from ..nn.layer_base import Layer
+
+
+class PipelineStage(Layer):
+    """Marker container for one stage (uniform structure across stages)."""
+
+    def __init__(self, block: Layer):
+        super().__init__()
+        self.block = block
+
+    def forward(self, x):
+        return self.block(x)
+
+
+class Pipeline(Layer):
+    """A sequence of shape-uniform stages.
+
+    Eager/single-chip: runs stages sequentially (reference F-then-B
+    degenerate case).  Use :func:`pipelined_fn` to obtain the SPMD
+    microbatched execution over the 'pp' mesh axis.
+    """
+
+    def __init__(self, stages: Sequence[Layer], num_microbatches: int = 1):
+        super().__init__()
+        from ..nn.layer.container import LayerList
+        self.stages = LayerList(list(stages))
+        self.num_microbatches = num_microbatches
+
+    def forward(self, x):
+        for s in self.stages:
+            x = s(x)
+        return x
+
+
+def stack_stage_params(stages: Sequence[Layer]):
+    """Stack per-stage parameter arrays along a new leading 'stage' axis.
+
+    All stages must have identical parameter structure (the reference makes
+    the same uniformity assumption when splitting programs into sections).
+    Returns (stacked_arrays: list, n_params_per_stage)."""
+    per_stage = [[p.data for p in param_list(s)] for s in stages]
+    n = len(per_stage[0])
+    for ps in per_stage:
+        assert len(ps) == n, "pipeline stages must be structurally uniform"
+    stacked = [jnp.stack([ps[i] for ps in per_stage], axis=0)
+               for i in range(n)]
+    return stacked, n
+
+
+def pipelined_fn(stage_layer: Layer, n_stages: int, num_microbatches: int,
+                 mesh=None, pp_axis: str = PP_AXIS):
+    """Build a pure function running `stage_layer` as an S-stage pipeline.
+
+    Returns ``fn(stacked_params, x)`` where ``stacked_params`` are stage
+    params stacked on axis 0 (shard over 'pp') and ``x`` is the full batch
+    [B, ...]; B is split into ``num_microbatches``.  Output: [B, ...] after
+    all S stages.
+    """
+    mesh = mesh or ensure_mesh()
+    S = n_stages
+    M = num_microbatches
+    template = stage_layer
+    n_params = len(param_list(template))
+
+    def stage_apply(p_arrs, x):
+        with autograd.no_grad():
+            with bind(template, list(p_arrs)):
+                out = template(Tensor(x))
+        return out.data if isinstance(out, Tensor) else out
+
+    def per_device(*args):
+        stacked_local = args[:n_params]   # each [1, ...]: my stage's params
+        x = args[n_params]                # full batch (replicated)
+        my_params = [a[0] for a in stacked_local]
+        idx = jax.lax.axis_index(pp_axis)
+        mb = x.reshape(M, x.shape[0] // M, *x.shape[1:])
+        act_shape = mb.shape[1:]
+        T = M + S - 1
+
+        def tick(carry, t):
+            buf = carry
+            # stage 0 ingests microbatch t (clamped); others take the ring
+            take = jnp.clip(t, 0, M - 1)
+            inject = jax.lax.dynamic_index_in_dim(mb, take, 0,
+                                                  keepdims=False)
+            inp = jnp.where(idx == 0, inject, buf)
+            y = stage_apply(my_params, inp)
+            # pass activation to the next stage (ring; last->first unused)
+            nxt = jax.lax.ppermute(
+                y, pp_axis, [(i, (i + 1) % S) for i in range(S)])
+            # last stage's output for microbatch t-(S-1)
+            out_t = jnp.where(idx == S - 1, y, jnp.zeros_like(y))
+            return nxt, out_t
+
+        _, outs = jax.lax.scan(tick, jnp.zeros(act_shape, x.dtype),
+                               jnp.arange(T))
+        # keep ticks S-1..T-1 (the M valid last-stage outputs), broadcast
+        # from the last stage to all (psum over the zero-elsewhere buffer)
+        valid = outs[S - 1:]
+        valid = jax.lax.psum(valid, pp_axis)
+        return valid.reshape(M * mb.shape[1], *act_shape[1:])
+
+    in_specs = tuple([PartitionSpec(pp_axis)] * n_params
+                     + [PartitionSpec()])
+    out_specs = PartitionSpec()
+
+    def fn(stacked_params, x):
+        sm = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+        return sm(*stacked_params, x)
+
+    return fn
+
+
+def pipeline_train_fn(stage_layer: Layer, head_fn: Callable, n_stages: int,
+                      num_microbatches: int, mesh=None,
+                      pp_axis: str = PP_AXIS):
+    """fn(stacked_params, head_params..., x, y) -> scalar loss, for use
+    inside jax.value_and_grad.  ``head_fn(out_arrays, y)`` computes the
+    loss from pipeline output (pure jnp)."""
+    fwd = pipelined_fn(stage_layer, n_stages, num_microbatches, mesh,
+                       pp_axis)
+
+    def fn(stacked_params, x, y):
+        out = fwd(stacked_params, x)
+        return head_fn(out, y)
+
+    return fn

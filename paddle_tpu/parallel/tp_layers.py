@@ -1,0 +1,133 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Reference: ``paddle.distributed.split`` (collective.py:809) with
+``_parallel_linear`` (:735, row/column parallel Linear) and
+``_parallel_embedding`` (:769) built on c_allreduce/c_concat ops.
+
+TPU-native: a TP layer is an ORDINARY layer whose weight carries a
+``placement`` (PartitionSpec over the 'mp' mesh axis).  Under the SPMD train
+step, GSPMD partitions the matmul and inserts the reduction collectives the
+reference emits by hand — no explicit c_allreduce needed.  Eager
+single-chip execution is unchanged (placement is metadata).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ..core.tensor import Parameter
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import Layer
+from ..distributed.mesh import MP_AXIS
+
+
+def mark_placement(param: Parameter, *spec) -> Parameter:
+    """Attach a PartitionSpec placement to a Parameter (consumed by
+    SpmdTrainStep / dryrun_multichip for in_shardings)."""
+    object.__setattr__ if False else None
+    param.placement = PartitionSpec(*spec)
+    return param
+
+
+# Parameter uses __slots__; extend dynamically via a registry
+_placements = {}
+
+
+def set_placement(param, *spec):
+    _placements[id(param)] = PartitionSpec(*spec)
+    return param
+
+
+def get_placement(param):
+    return _placements.get(id(param))
+
+
+class ColumnParallelLinear(Layer):
+    """W split along output dim over 'mp'; output stays sharded unless
+    gather_output (reference: collective.py:735 axis=1 branch)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        set_placement(self.weight, None, MP_AXIS)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            set_placement(self.bias, MP_AXIS)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowParallelLinear(Layer):
+    """W split along input dim over 'mp'; GSPMD inserts the psum the
+    reference adds as c_allreduce_sum (collective.py:735 axis=0 branch)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        set_placement(self.weight, MP_AXIS, None)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table row-split over 'mp'
+    (reference: _parallel_embedding collective.py:769)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        set_placement(self.weight, MP_AXIS, None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Loss over mp-sharded logits; GSPMD handles the reduction."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, logits, label):
+        return F.cross_entropy(logits, label, reduction="mean")
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split parity (reference: collective.py:809).
+
+    Returns a TP layer applied to x."""
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = RowParallelLinear(in_f, out_f, weight_attr,
+                                      bias_attr is not False)
+        else:
+            layer = ColumnParallelLinear(in_f, out_f, weight_attr,
+                                         bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        n, d = size
+        layer = VocabParallelEmbedding(n, d, weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported split operation: {operation}")

@@ -1,1 +1,5 @@
-"""Placeholder — populated in subsequent milestones."""
+"""paddle_tpu.vision (reference: python/paddle/vision/ — datasets, models,
+transforms; SURVEY §2.4)."""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401

@@ -1,0 +1,110 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/: mnist.py,
+cifar.py, flowers.py, voc2012.py).
+
+Zero-egress environment: datasets load from a local ``data_file``/``image_path``
+when given, else generate a DETERMINISTIC synthetic stand-in with the real
+shapes/classes (documented divergence — the reference downloads from
+dataset.bj.bcebos.com, which is unreachable here).  Synthetic mode keeps all
+pipelines (transforms, loaders, training scripts) runnable end-to-end."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+
+def _synthetic_images(n, shape, num_classes, seed):
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, num_classes, n).astype(np.int64)
+    imgs = rs.rand(n, *shape).astype(np.float32)
+    # make images weakly class-dependent so models can actually learn
+    for c in range(num_classes):
+        mask = labels == c
+        imgs[mask] += 0.5 * np.sin(
+            np.linspace(0, 3.14 * (c + 1), int(np.prod(shape)))
+        ).reshape(shape).astype(np.float32)
+    return imgs, labels
+
+
+class MNIST(Dataset):
+    """reference: vision/datasets/mnist.py.  Reads idx-format files when
+    ``image_path``/``label_path`` provided; synthetic otherwise."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(
+                    f.read(), np.uint8).reshape(n, rows, cols).astype(
+                        np.float32) / 255.0
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                self.labels = np.frombuffer(f.read(), np.uint8).astype(
+                    np.int64)
+        else:
+            n = 6000 if mode == "train" else 1000
+            self.images, self.labels = _synthetic_images(
+                n, (28, 28), 10, seed=0 if mode == "train" else 1)
+
+    def __getitem__(self, idx):
+        img = self.images[idx][None]  # [1, 28, 28]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img.astype(np.float32), np.asarray(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """reference: vision/datasets/cifar.py."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            import pickle
+            import tarfile
+            imgs, labels = [], []
+            with tarfile.open(data_file) as tf:
+                names = [m for m in tf.getnames()
+                         if ("data_batch" in m if mode == "train"
+                             else "test_batch" in m)]
+                for name in sorted(names):
+                    d = pickle.load(tf.extractfile(name), encoding="bytes")
+                    imgs.append(d[b"data"])
+                    labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+            self.images = (np.concatenate(imgs).reshape(-1, 3, 32, 32)
+                           .astype(np.float32) / 255.0)
+            self.labels = np.asarray(labels, np.int64)
+        else:
+            n = 5000 if mode == "train" else 1000
+            self.images, self.labels = _synthetic_images(
+                n, (3, 32, 32), self.NUM_CLASSES,
+                seed=2 if mode == "train" else 3)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img.astype(np.float32), np.asarray(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100

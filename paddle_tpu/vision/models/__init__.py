@@ -1,0 +1,8 @@
+"""Model zoo (reference: python/paddle/vision/models/: lenet.py:21,
+resnet.py, vgg.py, mobilenetv1.py, mobilenetv2.py)."""
+from .lenet import LeNet  # noqa: F401
+from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1,  # noqa
+                        mobilenet_v2)
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa
+                     resnet152)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401

@@ -1,0 +1,171 @@
+"""Image transforms over numpy CHW arrays (reference:
+python/paddle/vision/transforms/)."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(np.asarray(img))
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[..., None]
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 255.0
+        if self.data_format == "CHW" and img.shape[-1] in (1, 3, 4):
+            img = np.transpose(img, (2, 0, 1))
+        return img.astype(np.float32)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        mean, std = self.mean, self.std
+        if self.data_format == "CHW":
+            shape = (-1,) + (1,) * (img.ndim - 1)
+        else:
+            shape = (1,) * (img.ndim - 1) + (-1,)
+        return ((img - mean.reshape(shape)) /
+                std.reshape(shape)).astype(np.float32)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        return np.transpose(img, self.order)
+
+
+def _chw(img):
+    return img.ndim == 3 and img.shape[0] in (1, 3, 4)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = ((size, size) if isinstance(size, numbers.Number)
+                     else tuple(size))
+
+    def _apply_image(self, img):
+        import jax
+        import jax.numpy as jnp
+        chw = _chw(img)
+        a = jnp.asarray(img)
+        if chw:
+            out = jax.image.resize(a, (a.shape[0], *self.size), "linear")
+        else:
+            out = jax.image.resize(a, (*self.size, a.shape[-1]), "linear")
+        return np.asarray(out)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = ((size, size) if isinstance(size, numbers.Number)
+                     else tuple(size))
+
+    def _apply_image(self, img):
+        th, tw = self.size
+        if _chw(img):
+            h, w = img.shape[1:]
+            i, j = (h - th) // 2, (w - tw) // 2
+            return img[:, i:i + th, j:j + tw]
+        h, w = img.shape[:2]
+        i, j = (h - th) // 2, (w - tw) // 2
+        return img[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = ((size, size) if isinstance(size, numbers.Number)
+                     else tuple(size))
+        self.padding = padding
+
+    def _apply_image(self, img):
+        th, tw = self.size
+        chw = _chw(img)
+        if self.padding:
+            p = self.padding
+            pad = ((0, 0), (p, p), (p, p)) if chw else ((p, p), (p, p),
+                                                        (0, 0))
+            img = np.pad(img, pad[:img.ndim], mode="constant")
+        h, w = img.shape[1:] if chw else img.shape[:2]
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        if chw:
+            return img[:, i:i + th, j:j + tw]
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return img[..., ::-1].copy() if _chw(img) else \
+                img[:, ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return (img[:, ::-1].copy() if _chw(img)
+                    else img[::-1].copy())
+        return img
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(img * alpha, 0, 1).astype(np.float32)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = (padding if isinstance(padding, (list, tuple))
+                        else (padding,) * 4)
+        self.fill = fill
+
+    def _apply_image(self, img):
+        l, t, r, b = (self.padding * 2)[:4] if len(self.padding) == 2 \
+            else self.padding
+        if _chw(img):
+            return np.pad(img, ((0, 0), (t, b), (l, r)),
+                          constant_values=self.fill)
+        return np.pad(img, ((t, b), (l, r)) + ((0, 0),) * (img.ndim - 2),
+                      constant_values=self.fill)

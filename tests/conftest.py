@@ -6,7 +6,9 @@ TestDistBase) — set env BEFORE jax initialises.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the shell may preset JAX_PLATFORMS=axon (the real TPU tunnel),
+# which is single-chip and slow for unit tests.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,8 +18,16 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# The axon sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon, so the env var above may be read too late — force the
+# platform through the config API as well.
+jax.config.update("jax_platforms", "cpu")
+
 # exact-ish matmuls for numeric checks (bench sets its own precision)
 jax.config.update("jax_default_matmul_precision", "highest")
+# persistent compile cache: big speedup on repeated test runs
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,299 @@
+"""amp / io / metric / distribution / vision / text / hapi.Model tests
+(modelled on the reference's test_amp*, test_dataloader*, test_metrics,
+test_distribution, test_model.py suites)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, distribution, io, metric, nn, optimizer
+from paddle_tpu.vision import datasets as vdatasets
+from paddle_tpu.vision import models as vmodels
+from paddle_tpu.vision import transforms as T
+
+
+# ---------------- amp ----------------
+
+def test_auto_cast_white_op_bf16():
+    a = paddle.randn([4, 4])
+    b = paddle.randn([4, 4])
+    with amp.auto_cast():
+        c = paddle.matmul(a, b)
+        d = a + b  # gray op: follows inputs (fp32)
+        e = paddle.exp(a)  # black op: fp32
+    assert c.dtype == paddle.bfloat16
+    assert d.dtype == paddle.float32
+    assert e.dtype == paddle.float32
+    c2 = paddle.matmul(a, b)
+    assert c2.dtype == paddle.float32
+
+
+def test_auto_cast_custom_lists():
+    a = paddle.randn([4, 4])
+    with amp.auto_cast(custom_white_list={"exp"}):
+        e = paddle.exp(a)
+    assert e.dtype == paddle.bfloat16
+
+
+def test_auto_cast_O2():
+    a = paddle.randn([4])
+    with amp.auto_cast(level="O2"):
+        out = paddle.tanh(a)  # gray op runs low-precision at O2
+    assert out.dtype == paddle.bfloat16
+
+
+def test_grad_scaler_fp16_flow():
+    net = nn.Linear(4, 2)
+    opt = optimizer.SGD(0.1, parameters=net.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.randn([8, 4])
+    y = paddle.randn([8, 2])
+    loss = nn.MSELoss()(net(x), y)
+    w0 = net.weight.numpy().copy()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    assert not np.allclose(net.weight.numpy(), w0)
+
+
+def test_grad_scaler_skips_on_inf():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = optimizer.SGD(0.1, parameters=[p])
+    scaler = amp.GradScaler(init_loss_scaling=4.0)
+    (p * float("inf")).backward()
+    scaler.step(opt)
+    np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
+    assert scaler._scale == 2.0  # halved
+
+
+# ---------------- io ----------------
+
+def test_tensor_dataset_dataloader():
+    X = np.random.rand(20, 3).astype(np.float32)
+    Y = np.arange(20).astype(np.int64)
+    ds = io.TensorDataset([X, Y])
+    dl = io.DataLoader(ds, batch_size=6, shuffle=False, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == [6, 3]
+    np.testing.assert_allclose(yb.numpy(), [0, 1, 2, 3, 4, 5])
+    assert batches[-1][0].shape == [2, 3]
+
+
+def test_dataloader_shuffle_covers_all():
+    ds = io.TensorDataset([np.arange(10).astype(np.float32)])
+    dl = io.DataLoader(ds, batch_size=3, shuffle=True)
+    seen = np.sort(np.concatenate([b[0].numpy() for b in dl]))
+    np.testing.assert_allclose(seen, np.arange(10))
+
+
+def test_dataloader_workers_ordered():
+    ds = io.TensorDataset([np.arange(30).astype(np.float32)])
+    dl = io.DataLoader(ds, batch_size=5, shuffle=False, num_workers=3)
+    out = np.concatenate([b[0].numpy() for b in dl])
+    np.testing.assert_allclose(out, np.arange(30))
+
+
+def test_custom_dataset_and_collate():
+    class DS(io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return {"x": np.full(2, i, np.float32), "y": i}
+
+    dl = io.DataLoader(DS(), batch_size=4)
+    b = next(iter(dl))
+    assert set(b) == {"x", "y"}
+    assert b["x"].shape == [4, 2]
+
+
+def test_distributed_batch_sampler_shards():
+    ds = io.TensorDataset([np.arange(10).astype(np.float32)])
+    s0 = io.DistributedBatchSampler(ds, 2, num_replicas=2, rank=0)
+    s1 = io.DistributedBatchSampler(ds, 2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 5
+    assert set(i0) | set(i1) == set(range(10))
+
+
+def test_random_split():
+    ds = io.TensorDataset([np.arange(10).astype(np.float32)])
+    a, b = io.random_split(ds, [7, 3])
+    assert len(a) == 7 and len(b) == 3
+
+
+# ---------------- metric ----------------
+
+def test_accuracy_metric():
+    m = metric.Accuracy()
+    pred = paddle.to_tensor([[0.1, 0.9], [0.8, 0.2], [0.6, 0.4]])
+    label = paddle.to_tensor(np.array([[1], [1], [0]]))
+    correct = m.compute(pred, label)
+    m.update(correct)
+    assert abs(m.accumulate() - 2 / 3) < 1e-6
+
+
+def test_precision_recall():
+    p = metric.Precision()
+    r = metric.Recall()
+    preds = paddle.to_tensor([0.9, 0.8, 0.2, 0.7])
+    labels = paddle.to_tensor(np.array([1, 0, 1, 1]))
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert abs(p.accumulate() - 2 / 3) < 1e-6
+    assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+
+def test_auc():
+    m = metric.Auc()
+    preds = paddle.to_tensor([0.1, 0.4, 0.35, 0.8])
+    labels = paddle.to_tensor(np.array([0, 0, 1, 1]))
+    m.update(preds, labels)
+    assert abs(m.accumulate() - 0.75) < 0.01
+
+
+def test_functional_accuracy():
+    pred = paddle.to_tensor([[0.1, 0.9], [0.8, 0.2]])
+    lab = paddle.to_tensor(np.array([1, 1]))
+    acc = metric.accuracy(pred, lab)
+    assert abs(float(acc) - 0.5) < 1e-6
+
+
+# ---------------- distribution ----------------
+
+def test_normal_distribution():
+    d = distribution.Normal(0.0, 1.0)
+    s = d.sample([1000])
+    assert abs(float(s.numpy().mean())) < 0.15
+    lp = d.log_prob(paddle.to_tensor([0.0]))
+    np.testing.assert_allclose(float(lp), -0.5 * np.log(2 * np.pi),
+                               rtol=1e-5)
+    d2 = distribution.Normal(1.0, 2.0)
+    kl = d.kl_divergence(d2)
+    assert float(kl.numpy()) > 0
+
+
+def test_uniform_distribution():
+    d = distribution.Uniform(0.0, 2.0)
+    s = d.sample([500])
+    assert 0 <= float(s.numpy().min()) and float(s.numpy().max()) < 2
+    np.testing.assert_allclose(float(d.entropy()), np.log(2), rtol=1e-6)
+
+
+def test_categorical_distribution():
+    logits = paddle.to_tensor([0.0, 0.0, 10.0])
+    d = distribution.Categorical(logits)
+    s = d.sample([100])
+    assert (s.numpy() == 2).mean() > 0.95
+    assert float(d.entropy()) < 0.1
+
+
+# ---------------- vision ----------------
+
+def test_lenet_forward_and_shapes():
+    net = vmodels.LeNet()
+    out = net(paddle.randn([2, 1, 28, 28]))
+    assert out.shape == [2, 10]
+
+
+def test_resnet18_forward():
+    net = vmodels.resnet18(num_classes=10)
+    net.eval()
+    out = net(paddle.randn([1, 3, 32, 32]))
+    assert out.shape == [1, 10]
+
+
+def test_mobilenet_v2_forward():
+    net = vmodels.mobilenet_v2(num_classes=7)
+    net.eval()
+    out = net(paddle.randn([1, 3, 32, 32]))
+    assert out.shape == [1, 7]
+
+
+def test_mnist_dataset_and_transform():
+    tf = T.Compose([T.Normalize(mean=0.5, std=0.5)])
+    ds = vdatasets.MNIST(mode="train", transform=tf)
+    img, label = ds[0]
+    assert img.shape == (1, 28, 28)
+    assert 0 <= int(label) < 10
+    assert len(ds) == 6000
+
+
+def test_transforms():
+    img = np.random.rand(3, 16, 16).astype(np.float32)
+    out = T.CenterCrop(8)(img)
+    assert out.shape == (3, 8, 8)
+    out = T.Resize((4, 4))(img)
+    assert out.shape == (3, 4, 4)
+    hwc = np.random.randint(0, 255, (8, 8, 3), np.uint8)
+    out = T.ToTensor()(hwc)
+    assert out.shape == (3, 8, 8) and out.max() <= 1.0
+
+
+# ---------------- text ----------------
+
+def test_text_datasets():
+    from paddle_tpu.text import Imdb, UCIHousing, WMT14
+    ds = Imdb(mode="train")
+    x, y = ds[0]
+    assert x.shape == (128,) and int(y) in (0, 1)
+    h = UCIHousing(mode="test")
+    feat, target = h[0]
+    assert feat.shape == (13,) and target.shape == (1,)
+    w = WMT14(mode="train")
+    src, tin, tout = w[0]
+    assert src.shape == (24,) and tin.shape == (23,)
+
+
+# ---------------- hapi Model ----------------
+
+def test_model_fit_evaluate_predict(tmp_path):
+    paddle.seed(5)
+    X = np.random.rand(64, 4).astype(np.float32)
+    W = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+    Yc = (X @ W > 0.6).astype(np.int64).reshape(-1)
+    ds = io.TensorDataset([X, Yc])
+
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = paddle.Model(net)
+    model.prepare(optimizer.Adam(0.05, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), metric.Accuracy())
+    hist = model.fit(ds, epochs=6, batch_size=16, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert logs["acc"] > 0.8
+    preds = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 2)
+
+    path = str(tmp_path / "ckpt")
+    model.save(path)
+    net2 = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    m2 = paddle.Model(net2)
+    m2.prepare(optimizer.Adam(0.05, parameters=net2.parameters()),
+               nn.CrossEntropyLoss())
+    m2.load(path)
+    np.testing.assert_allclose(net[0].weight.numpy(),
+                               net2[0].weight.numpy())
+
+
+def test_model_early_stopping():
+    X = np.random.rand(16, 2).astype(np.float32)
+    Y = np.zeros(16, np.int64)
+    ds = io.TensorDataset([X, Y])
+    net = nn.Linear(2, 2)
+    model = paddle.Model(net)
+    model.prepare(optimizer.SGD(0.0, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    es = paddle.hapi.EarlyStopping(monitor="loss", patience=0, min_delta=1e9)
+    model.fit(ds, eval_data=ds, epochs=10, batch_size=8, verbose=0,
+              callbacks=[es])
+    # with huge min_delta nothing "improves" → stops after patience
+    assert model.stop_training
+
+
+def test_summary_counts_params(capsys):
+    net = nn.Linear(10, 5)
+    info = paddle.summary(net)
+    assert info["total_params"] == 55

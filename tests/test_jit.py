@@ -1,0 +1,150 @@
+"""to_static / TrainStep / jit.save-load tests (modelled on the reference's
+dygraph_to_static suite: static outputs must match eager)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn, optimizer
+
+
+def _model():
+    paddle.seed(1)
+    return nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+
+
+def test_to_static_output_parity():
+    m = _model()
+    x = paddle.randn([6, 4])
+    eager = m(x).numpy()
+    static_fwd = jit.to_static(m.forward)
+    static = static_fwd(x).numpy()
+    np.testing.assert_allclose(eager, static, rtol=1e-5)
+
+
+def test_to_static_grad_parity():
+    m = _model()
+    x = paddle.randn([6, 4])
+    lossf = nn.CrossEntropyLoss()
+    y = paddle.to_tensor(np.array([0, 1, 0, 1, 0, 1]))
+
+    static_fwd = jit.to_static(m.forward)
+    lossf(static_fwd(x), y).backward()
+    gs = m[0].weight.grad.numpy().copy()
+    m.clear_gradients()
+    lossf(m(x), y).backward()
+    ge = m[0].weight.grad.numpy()
+    np.testing.assert_allclose(gs, ge, rtol=1e-4, atol=1e-6)
+
+
+def test_to_static_decorator_on_layer():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(3, 3)
+
+        @jit.to_static
+        def forward(self, x):
+            return self.fc(x) * 2
+
+    net = Net()
+    x = paddle.randn([2, 3])
+    out = net(x)
+    expect = (x.numpy() @ net.fc.weight.numpy()
+              + net.fc.bias.numpy()) * 2
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)
+
+
+def test_to_static_respects_shape_cache():
+    m = _model()
+    fwd = jit.to_static(m.forward)
+    a = fwd(paddle.randn([2, 4]))
+    b = fwd(paddle.randn([5, 4]))   # new shape triggers retrace, not error
+    assert a.shape == [2, 2] and b.shape == [5, 2]
+
+
+def test_to_static_batchnorm_buffer_update():
+    bn = nn.BatchNorm1D(4)
+    bn.train()
+    fwd = jit.to_static(bn.forward)
+    x = paddle.randn([16, 4]) * 3 + 1
+    before = bn._mean.numpy().copy()
+    fwd(x)
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after), "running mean must update via jit"
+
+
+def test_train_step_converges_and_matches_eager():
+    paddle.seed(3)
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    m2.set_state_dict(m1.state_dict())
+    X = paddle.randn([16, 4])
+    Y = paddle.randn([16, 1])
+    lossf = nn.MSELoss()
+
+    o1 = optimizer.SGD(learning_rate=0.1, parameters=m1.parameters())
+    o2 = optimizer.SGD(learning_rate=0.1, parameters=m2.parameters())
+    step = jit.TrainStep(m1, lossf, o1)
+    for _ in range(5):
+        l_jit = float(step(X, Y))
+        loss = lossf(m2(X), Y)
+        loss.backward()
+        o2.step()
+        o2.clear_grad()
+        l_eager = float(loss)
+        np.testing.assert_allclose(l_jit, l_eager, rtol=1e-4)
+    np.testing.assert_allclose(
+        m1[0].weight.numpy(), m2[0].weight.numpy(), rtol=1e-4, atol=1e-6)
+
+
+def test_train_step_adam_with_clip():
+    paddle.seed(4)
+    m = _model()
+    opt = optimizer.Adam(learning_rate=0.01,
+                         parameters=m.parameters(),
+                         grad_clip=optimizer.ClipGradByGlobalNorm(1.0))
+    step = jit.TrainStep(m, nn.CrossEntropyLoss(), opt)
+    X = paddle.randn([32, 4])
+    Y = paddle.to_tensor(np.random.randint(0, 2, (32,)))
+    losses = [float(step(X, Y)) for _ in range(30)]
+    assert losses[-1] < losses[0]
+
+
+def test_eval_step():
+    m = _model()
+    m.eval()
+    step = jit.TrainStep(m, nn.CrossEntropyLoss(),
+                         optimizer.SGD(0.1, parameters=m.parameters()))
+    X = paddle.randn([4, 4])
+    Y = paddle.to_tensor(np.array([0, 1, 1, 0]))
+    loss, out = step.eval_step(X, Y)
+    assert out.shape == [4, 2]
+    np.testing.assert_allclose(
+        float(loss), float(nn.CrossEntropyLoss()(m(X), Y)), rtol=1e-5)
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    m = _model()
+    m.eval()
+    path = str(tmp_path / "model")
+    jit.save(m, path, input_spec=[jit.InputSpec([None, 4])])
+    loaded = jit.load(path)
+    x = paddle.randn([7, 4])
+    np.testing.assert_allclose(m(x).numpy(), loaded(x).numpy(), rtol=1e-5)
+    # polymorphic batch
+    x2 = paddle.randn([2, 4])
+    np.testing.assert_allclose(m(x2).numpy(), loaded(x2).numpy(), rtol=1e-5)
+
+
+def test_static_function_with_dropout_varies_but_deterministic_under_seed():
+    drop = nn.Dropout(0.5)
+    drop.train()
+    fwd = jit.to_static(drop.forward)
+    x = paddle.ones([100])
+    paddle.seed(11)
+    a = fwd(x).numpy()
+    b = fwd(x).numpy()
+    assert not np.allclose(a, b), "different calls draw different masks"
+    paddle.seed(11)
+    a2 = fwd(x).numpy()
+    np.testing.assert_allclose(a, a2)

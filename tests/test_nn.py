@@ -1,0 +1,296 @@
+"""nn.Layer system + layer correctness tests (modelled on the reference's
+test_layers.py / per-op OpTest suites)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_matches_numpy():
+    l = nn.Linear(4, 3)
+    x = paddle.randn([5, 4])
+    y = l(x)
+    expect = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+    np.testing.assert_allclose(y.numpy(), expect, rtol=1e-5)
+
+
+def test_layer_registry_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.act = nn.ReLU()
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    sd = net.state_dict()
+    assert set(sd) == set(names)
+
+    net2 = Net()
+    net2.set_state_dict(sd)
+    for (n1, p1), (n2, p2) in zip(net.named_parameters(),
+                                  net2.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy())
+
+
+def test_state_dict_save_load_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(net.state_dict(), path)
+    net2 = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+    net2.set_state_dict(paddle.load(path))
+    x = paddle.randn([2, 3])
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_conv2d_shape_and_grad():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    x.stop_gradient = False
+    y = conv(x)
+    assert y.shape == [2, 8, 8, 8]
+    y.sum().backward()
+    assert x.grad is not None and conv.weight.grad is not None
+    assert conv.weight.grad.shape == [8, 3, 3, 3]
+
+
+def test_conv2d_matches_manual():
+    # 1x1 conv == matmul over channels
+    conv = nn.Conv2D(4, 2, 1, bias_attr=False)
+    x = paddle.randn([1, 4, 5, 5])
+    y = conv(x)
+    w = conv.weight.numpy().reshape(2, 4)
+    expect = np.einsum("oc,nchw->nohw", w, x.numpy())
+    np.testing.assert_allclose(y.numpy(), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_depthwise_and_grouped_conv():
+    conv = nn.Conv2D(4, 4, 3, groups=4, padding=1)
+    y = conv(paddle.randn([1, 4, 8, 8]))
+    assert y.shape == [1, 4, 8, 8]
+    assert conv.weight.shape == [4, 1, 3, 3]
+
+
+def test_conv2d_transpose():
+    convt = nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)
+    y = convt(paddle.randn([1, 3, 8, 8]))
+    assert y.shape == [1, 6, 16, 16]
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3, momentum=0.5)
+    x = paddle.randn([8, 3, 4, 4]) * 2 + 1
+    bn.train()
+    y = bn(x)
+    # normalized output: near zero mean / unit var per channel
+    yn = y.numpy()
+    assert abs(yn.mean()) < 1e-5
+    np.testing.assert_allclose(yn.var(axis=(0, 2, 3)), np.ones(3), rtol=1e-3)
+    # running stats moved toward batch stats
+    assert float(bn._mean.abs().sum()) > 0
+    bn.eval()
+    y2 = bn(x)
+    assert not np.allclose(y2.numpy(), yn)
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([4, 8]) * 3 + 2
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(axis=-1), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(y.var(axis=-1), np.ones(4), rtol=1e-3)
+
+
+def test_groupnorm():
+    gn = nn.GroupNorm(2, 4)
+    y = gn(paddle.randn([2, 4, 4, 4]))
+    assert y.shape == [2, 4, 4, 4]
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[0, 1], [2, 0]]))
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4))
+    np.testing.assert_allclose(out.numpy()[1, 1], np.zeros(4))
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    d.train()
+    y = d(x)
+    frac = (y.numpy() == 0).mean()
+    assert 0.4 < frac < 0.6
+    # upscale keeps expectation
+    assert abs(y.numpy().mean() - 1.0) < 0.05
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_pools():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2, 2)(x)
+    np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+    ap = nn.AvgPool2D(2, 2)(x)
+    np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    aap = nn.AdaptiveAvgPool2D(1)(x)
+    np.testing.assert_allclose(aap.numpy()[0, 0], [[7.5]])
+    amp = nn.AdaptiveMaxPool2D(2)(x)
+    np.testing.assert_allclose(amp.numpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_activations_values():
+    x = paddle.to_tensor([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 0, 0.5, 2])
+    np.testing.assert_allclose(
+        nn.LeakyReLU(0.1)(x).numpy(), [-0.2, -0.05, 0, 0.5, 2], rtol=1e-6)
+    np.testing.assert_allclose(
+        nn.Sigmoid()(x).numpy(), 1 / (1 + np.exp(-x.numpy())), rtol=1e-5)
+    s = nn.Softmax()(x).numpy()
+    np.testing.assert_allclose(s.sum(), 1.0, rtol=1e-6)
+    g = F.gelu(x).numpy()
+    assert g[2] == 0 and g[4] > 1.9
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+    assert len(seq) == 3
+    assert isinstance(seq[0], nn.Linear)
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
+
+
+def test_cross_entropy_matches_manual():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor(np.array([0, 2, 4, 1]))
+    loss = F.cross_entropy(logits, labels)
+    lp = logits.numpy() - logits.numpy().max(axis=1, keepdims=True)
+    lp = lp - np.log(np.exp(lp).sum(axis=1, keepdims=True))
+    expect = -lp[np.arange(4), labels.numpy()].mean()
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor(np.array([0, -100, 4, -100]))
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    lp = logits.numpy() - logits.numpy().max(axis=1, keepdims=True)
+    lp = lp - np.log(np.exp(lp).sum(axis=1, keepdims=True))
+    expect = -(lp[0, 0] + lp[2, 4]) / 2
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+
+
+def test_losses():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([1.5, 2.0, 2.0])
+    np.testing.assert_allclose(float(nn.MSELoss()(a, b)),
+                               np.mean([0.25, 0, 1]), rtol=1e-6)
+    np.testing.assert_allclose(float(nn.L1Loss()(a, b)),
+                               np.mean([0.5, 0, 1]), rtol=1e-6)
+    p = paddle.to_tensor([0.9, 0.1])
+    t = paddle.to_tensor([1.0, 0.0])
+    np.testing.assert_allclose(float(nn.BCELoss()(p, t)),
+                               -np.mean([np.log(0.9), np.log(0.9)]),
+                               rtol=1e-4)
+    z = paddle.to_tensor([2.0, -1.0])
+    bwl = float(nn.BCEWithLogitsLoss()(z, t))
+    expect = np.mean([np.log1p(np.exp(-2.0)), np.log1p(np.exp(-1.0))])
+    np.testing.assert_allclose(bwl, expect, rtol=1e-5)
+
+
+def test_multihead_attention_shapes_and_grad():
+    mha = nn.MultiHeadAttention(32, 4)
+    q = paddle.randn([2, 6, 32])
+    out = mha(q, q, q)
+    assert out.shape == [2, 6, 32]
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_mha_causal_mask():
+    mha = nn.MultiHeadAttention(16, 2)
+    mha.eval()
+    x = paddle.randn([1, 4, 16])
+    L = 4
+    mask = paddle.to_tensor(np.tril(np.ones((1, 1, L, L), bool)))
+    y_masked = mha(x, x, x, attn_mask=mask)
+    # position 0 attends only to itself; change in later tokens must not
+    # affect position 0 output
+    x2 = x.clone()
+    x2[0, 3] = paddle.randn([16])
+    y2 = mha(x2, x2, x2, attn_mask=mask)
+    np.testing.assert_allclose(y_masked.numpy()[0, 0], y2.numpy()[0, 0],
+                               rtol=2e-3, atol=2e-5)
+
+
+def test_transformer_encoder_decoder():
+    model = nn.Transformer(d_model=32, nhead=4, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=64)
+    src = paddle.randn([2, 5, 32])
+    tgt = paddle.randn([2, 3, 32])
+    out = model(src, tgt)
+    assert out.shape == [2, 3, 32]
+
+
+def test_lstm_and_gru():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.randn([2, 6, 4])
+    y, (h, c) = lstm(x)
+    assert y.shape == [2, 6, 8]
+    assert h.shape == [1, 2, 8] and c.shape == [1, 2, 8]
+    # final hidden equals last output step for unidirectional lstm
+    np.testing.assert_allclose(y.numpy()[:, -1], h.numpy()[0], rtol=1e-5)
+
+    gru = nn.GRU(4, 8, direction="bidirect")
+    y2, h2 = gru(x)
+    assert y2.shape == [2, 6, 16]
+    assert h2.shape == [2, 2, 8]
+    y2.sum().backward()
+    assert gru.weight_ih_l0.grad is not None
+
+
+def test_lstm_cell_vs_layer():
+    cell = nn.LSTMCell(4, 8)
+    rnn = nn.RNN(cell)
+    x = paddle.randn([2, 5, 4])
+    y, state = rnn(x)
+    assert y.shape == [2, 5, 8]
+
+
+def test_train_eval_propagates():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    net.train()
+    assert net[1].training
+
+
+def test_interpolate():
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    y = F.interpolate(x, scale_factor=2, mode="nearest")
+    assert y.shape == [1, 1, 4, 4]
+    np.testing.assert_allclose(y.numpy()[0, 0, :2, :2], 0)
+    b = F.interpolate(x, size=[4, 4], mode="bilinear")
+    assert b.shape == [1, 1, 4, 4]
+
+
+def test_forward_hooks():
+    l = nn.Linear(2, 2)
+    calls = []
+    h = l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    l(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    l(paddle.randn([1, 2]))
+    assert calls == [1]

@@ -1,0 +1,217 @@
+"""Optimizer + LR schedule + clip tests (modelled on the reference's
+test_sgd_op.py / test_adam_op.py / test_lr_scheduler.py oracles)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.optimizer import lr as lr_mod
+
+
+def _make_problem():
+    paddle.seed(42)
+    net = nn.Linear(4, 1, bias_attr=False)
+    X = paddle.randn([32, 4])
+    w_true = paddle.to_tensor([[1.0], [-2.0], [0.5], [3.0]])
+    Y = X @ w_true
+    return net, X, Y
+
+
+def _train(net, X, Y, opt, steps=300):
+    losses = []
+    for _ in range(steps):
+        loss = ((net(X) - Y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (optimizer.SGD, dict(learning_rate=0.1)),
+    (optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9,
+                              use_nesterov=True)),
+    (optimizer.Adam, dict(learning_rate=0.1)),
+    (optimizer.AdamW, dict(learning_rate=0.1, weight_decay=0.001)),
+    (optimizer.RMSProp, dict(learning_rate=0.05)),
+    (optimizer.Adagrad, dict(learning_rate=0.3)),
+    (optimizer.Adadelta, dict(learning_rate=5.0)),
+    (optimizer.Adamax, dict(learning_rate=0.1)),
+    (optimizer.Lamb, dict(learning_rate=0.03, lamb_weight_decay=0.0)),
+    (optimizer.LarsMomentum, dict(learning_rate=0.3, lars_weight_decay=0.0, lars_coeff=0.01)),
+])
+def test_optimizer_converges(cls, kw):
+    net, X, Y = _make_problem()
+    opt = cls(parameters=net.parameters(), **kw)
+    losses = _train(net, X, Y, opt)
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_sgd_matches_manual():
+    p = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+    (p * p).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [1 - 0.1 * 2, 2 - 0.1 * 4],
+                               rtol=1e-6)
+
+
+def test_adam_matches_reference_formula():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = optimizer.Adam(learning_rate=0.1, parameters=[p])
+    (p * 3).sum().backward()
+    opt.step()
+    # step1: m=0.1*3=0.3, v=0.001*9=0.009, mhat=3, vhat=9
+    expect = 1 - 0.1 * 3 / (3 + 1e-8)
+    np.testing.assert_allclose(p.numpy(), [expect], rtol=1e-5)
+
+
+def test_weight_decay_coupled():
+    p = paddle.to_tensor([2.0], stop_gradient=False)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p],
+                        weight_decay=0.5)
+    paddle.to_tensor([1.0])
+    (p * 0.0).sum().backward()   # zero grad; only decay acts
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [2 - 0.1 * 0.5 * 2], rtol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    p = paddle.to_tensor([2.0], stop_gradient=False)
+    opt = optimizer.AdamW(learning_rate=0.1, parameters=[p],
+                          weight_decay=0.1)
+    (p * 0.0).sum().backward()
+    opt.step()
+    # decoupled: p -= lr*coeff*p then adam update with g=0
+    np.testing.assert_allclose(p.numpy(), [2 * (1 - 0.01)], rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    p1 = paddle.to_tensor([3.0], stop_gradient=False)
+    p2 = paddle.to_tensor([4.0], stop_gradient=False)
+    clip = optimizer.ClipGradByGlobalNorm(1.0)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p1, p2],
+                        grad_clip=clip)
+    (p1 * 3.0 + p2 * 4.0).sum().backward()   # grads 3, 4; norm 5
+    opt.step()
+    np.testing.assert_allclose(p1.numpy(), [3.0 - 3.0 / 5], rtol=1e-5)
+    np.testing.assert_allclose(p2.numpy(), [4.0 - 4.0 / 5], rtol=1e-5)
+
+
+def test_grad_clip_by_value():
+    p = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p],
+                        grad_clip=optimizer.ClipGradByValue(0.5))
+    (p * paddle.to_tensor([10.0, 0.1])).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.5, 0.9], rtol=1e-5)
+
+
+def test_lr_scheduler_with_optimizer():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    sched = lr_mod.StepDecay(learning_rate=0.1, step_size=2, gamma=0.1)
+    opt = optimizer.SGD(learning_rate=sched, parameters=[p])
+    assert abs(opt.get_lr() - 0.1) < 1e-9
+    sched.step()
+    sched.step()
+    assert abs(opt.get_lr() - 0.01) < 1e-9
+
+
+@pytest.mark.parametrize("sched,expect", [
+    (lambda: lr_mod.ExponentialDecay(1.0, 0.5), [1.0, 0.5, 0.25]),
+    (lambda: lr_mod.NaturalExpDecay(1.0, 1.0),
+     [1.0, np.exp(-1), np.exp(-2)]),
+    (lambda: lr_mod.InverseTimeDecay(1.0, 1.0), [1.0, 0.5, 1 / 3]),
+    (lambda: lr_mod.StepDecay(1.0, 2, 0.1), [1.0, 1.0, 0.1]),
+    (lambda: lr_mod.MultiStepDecay(1.0, [1, 2]), [1.0, 0.1, 0.01]),
+    (lambda: lr_mod.PiecewiseDecay([1, 2], [0.1, 0.2, 0.3]),
+     [0.1, 0.2, 0.3]),
+    (lambda: lr_mod.LambdaDecay(1.0, lambda e: 1 / (e + 1)),
+     [1.0, 0.5, 1 / 3]),
+])
+def test_lr_schedules(sched, expect):
+    s = sched()
+    got = []
+    for _ in expect:
+        got.append(s())
+        s.step()
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_cosine_annealing():
+    s = lr_mod.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(s() - 1.0) < 1e-9
+    for _ in range(10):
+        s.step()
+    assert s() < 1e-9
+
+
+def test_linear_warmup():
+    s = lr_mod.LinearWarmup(0.5, warmup_steps=5, start_lr=0.0, end_lr=0.5)
+    vals = []
+    for _ in range(7):
+        vals.append(s())
+        s.step()
+    np.testing.assert_allclose(vals[:5], [0.0, 0.1, 0.2, 0.3, 0.4],
+                               rtol=1e-6)
+    assert vals[5] == 0.5 and vals[6] == 0.5
+
+
+def test_noam():
+    s = lr_mod.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+    prev = 0
+    for i in range(10):
+        cur = s()
+        if 0 < i:
+            assert cur >= prev  # warming up
+        prev = cur
+        s.step()
+    for i in range(20):
+        s.step()
+    assert s() < prev  # decaying after warmup
+
+
+def test_reduce_on_plateau():
+    s = lr_mod.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+    s.step(1.0)
+    s.step(1.0)   # bad epoch 1
+    s.step(1.0)   # bad epoch 2 > patience -> reduce
+    assert abs(s() - 0.5) < 1e-9
+
+
+def test_optimizer_state_dict_roundtrip():
+    net, X, Y = _make_problem()
+    opt = optimizer.Adam(learning_rate=0.1, parameters=net.parameters())
+    _train(net, X, Y, opt, steps=3)
+    sd = opt.state_dict()
+    opt2 = optimizer.Adam(learning_rate=0.1, parameters=net.parameters())
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 3
+    s1 = opt._slots[id(net.parameters()[0])]
+    s2 = opt2._slots[id(net.parameters()[0])]
+    np.testing.assert_allclose(np.asarray(s1["m"]), np.asarray(s2["m"]))
+
+
+def test_minimize_api():
+    net, X, Y = _make_problem()
+    opt = optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+    l0 = None
+    for _ in range(60):
+        loss = ((net(X) - Y) ** 2).mean()
+        opt.minimize(loss)
+        opt.clear_grad()
+        l0 = l0 or float(loss)
+    assert float(loss) < l0 * 0.2
+
+
+def test_multi_precision_bf16():
+    p = paddle.nn.Linear(4, 4).weight
+    p.data = p.data.astype(paddle.bfloat16)
+    opt = optimizer.Momentum(learning_rate=0.1, parameters=[p],
+                             multi_precision=True)
+    (p.sum() * 1.0).backward()
+    opt.step()
+    assert p.dtype == paddle.bfloat16
+    assert opt._slots[id(p)]["master"].dtype == np.float32
