@@ -152,6 +152,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = {}  # id(optimizer) -> found_inf for this step
 
     def scale(self, var):
         if not self._enable:
@@ -161,6 +162,10 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        if id(optimizer) in self._unscaled:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()")
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list or []:
@@ -171,22 +176,29 @@ class GradScaler:
             if not finite:
                 found = True
             p._grad_data = g
-        self._found_inf = found
+        self._unscaled[id(optimizer)] = found
+        self._found_inf = self._found_inf or found
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        if id(optimizer) not in self._unscaled:
+            self.unscale_(optimizer)
+        if not self._unscaled.pop(id(optimizer)):
             optimizer.step()
-        self.update()
+        # auto-update only once all unscaled optimizers have stepped, so a
+        # multi-optimizer flow (unscale D, unscale G, step D, step G) never
+        # re-unscales G's grads mid-flight
+        if not self._unscaled:
+            self.update()
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
 
     def update(self):
+        self._unscaled.clear()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

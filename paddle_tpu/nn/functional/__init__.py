@@ -677,11 +677,17 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
         if label_smoothing > 0.0:
             tgt = tgt * (1.0 - label_smoothing) + label_smoothing / nclass
         loss = -jnp.sum(tgt * logp, axis=axis)
+        w_row = None
         if wgt is not None and not soft_label:
             lab_ = lab
             if lab_.ndim == logp.ndim and lab_.shape[axis] == 1:
                 lab_ = jnp.squeeze(lab_, axis)
-            loss = loss * jnp.take(wgt, lab_)
+            # ignore_index (e.g. -100) is out of range for the weight
+            # table — jnp.take would fill NaN; ignored rows are masked to
+            # zero below, so any in-range index works here
+            safe = jnp.where(lab_ == ignore_index, 0, lab_)
+            w_row = jnp.take(wgt, safe)
+            loss = loss * w_row
         if not soft_label:
             lab_ = lab
             if lab_.ndim == logp.ndim and lab_.shape[axis] == 1:
@@ -689,7 +695,13 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             mask = (lab_ != ignore_index).astype(loss.dtype)
             loss = loss * mask
             if reduction == "mean":
-                return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+                if w_row is not None:
+                    # weighted mean divides by the sum of selected class
+                    # weights (reference: nn/functional/loss.py weighted CE)
+                    denom = jnp.sum(mask * w_row)
+                else:
+                    denom = jnp.sum(mask)
+                return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
         return _reduce(loss, reduction)
 
     args = [input, label]

@@ -180,6 +180,18 @@ class Layer:
         return [b for _, b in self.named_buffers(
             include_sublayers=include_sublayers)]
 
+    def _named_persistable_buffers(self, prefix=""):
+        """Like named_buffers, but each layer filters its OWN
+        non-persistable buffers (so sublayer persistability is honored)."""
+        for name, b in self._buffers.items():
+            if b is not None and name not in self._non_persistable_buffer_names:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        for lname, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{lname}" if prefix else lname
+            yield from layer._named_persistable_buffers(sub_prefix)
+
     def children(self) -> Iterator["Layer"]:
         for l in self._sub_layers.values():
             if l is not None:
@@ -230,10 +242,9 @@ class Layer:
         dest = destination if destination is not None else collections.OrderedDict()
         for n, p in self.named_parameters(structured_name_prefix.rstrip(".")):
             dest[n] = p
-        for n, b in self.named_buffers(structured_name_prefix.rstrip(".")):
-            short = n.rsplit(".", 1)[-1]
-            if short not in self._non_persistable_buffer_names:
-                dest[n] = b
+        for n, b in self._named_persistable_buffers(
+                structured_name_prefix.rstrip(".")):
+            dest[n] = b
         return dest
 
     def set_state_dict(self, state_dict, use_structured_name=True):

@@ -84,20 +84,28 @@ class Optimizer:
     def _decoupled(self) -> bool:
         return False  # AdamW overrides
 
+    def _decoupled_decay(self, p, lr, param_name=None):
+        """Decoupled (AdamW-style) decay applied to the param array right
+        before the main update; base optimizers are a no-op."""
+        return p
+
+    def _param_lr_ratio(self, param) -> float:
+        return 1.0  # AdamW lr_ratio overrides
+
     # -- eager step --------------------------------------------------------
     def step(self):
         assert self._parameter_list is not None, (
             "optimizer constructed without parameters; pass parameters= "
             "or use the functional interface")
         self._step_count += 1
-        pg = []
-        for p in self._parameter_list:
-            if not p.trainable or p._grad_data is None:
-                continue
-            g = self._apply_decay(p, p._grad_data)
-            pg.append((p, g))
+        # clip raw grads first, THEN regularize — matching the reference's
+        # apply_gradients order (python/paddle/optimizer/optimizer.py:746-757)
+        # and this file's functional_update.
+        pg = [(p, p._grad_data) for p in self._parameter_list
+              if p.trainable and p._grad_data is not None]
         if self._grad_clip is not None:
             pg = self._grad_clip(pg)
+        pg = [(p, self._apply_decay(p, g)) for p, g in pg]
         lr = self.get_lr()
         for p, g in pg:
             slots = self._slots.get(id(p))
@@ -108,8 +116,9 @@ class Optimizer:
                     slots["master"] = p.data.astype(jnp.float32)
                 self._slots[id(p)] = slots
             plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+            plr = plr * self._param_lr_ratio(p)
             if "master" in slots:
-                master = slots["master"]
+                master = self._decoupled_decay(slots["master"], plr, p.name)
                 new_master, new_slots = self.update_param(
                     master, g.astype(jnp.float32),
                     {k: v for k, v in slots.items() if k != "master"},
@@ -117,8 +126,9 @@ class Optimizer:
                 new_slots["master"] = new_master
                 p.data = new_master.astype(p.data.dtype)
             else:
+                pdata = self._decoupled_decay(p.data, plr, p.name)
                 p.data, new_slots = self.update_param(
-                    p.data, g, slots, plr, self._step_count)
+                    pdata, g, slots, plr, self._step_count)
             self._slots[id(p)] = new_slots
 
     def minimize(self, loss, startup_program=None, parameters=None,
@@ -160,19 +170,23 @@ class Optimizer:
                 if reg is not None and not self._decoupled():
                     g = reg(p, g)
                 plr = lr * getattr(m, "optimize_attr", {}).get("learning_rate", 1.0)
+                plr = plr * self._param_lr_ratio(m)
             elif self._weight_decay is not None and not self._decoupled():
                 g = self._weight_decay(p, g)
                 plr = lr
             else:
                 plr = lr
+            pname = m.name if m is not None else None
             if "master" in s:
                 sub = {k: v for k, v in s.items() if k != "master"}
+                master = self._decoupled_decay(s["master"], plr, pname)
                 new_master, ns = self.update_param(
-                    s["master"], g.astype(jnp.float32), sub, plr, step)
+                    master, g.astype(jnp.float32), sub, plr, step)
                 ns["master"] = new_master
                 new_ps.append(new_master.astype(p.dtype))
             else:
-                np_, ns = self.update_param(p, g, s, plr, step)
+                p_in = self._decoupled_decay(p, plr, pname)
+                np_, ns = self.update_param(p_in, g, s, plr, step)
                 new_ps.append(np_)
             new_ss.append(ns)
         return new_ps, new_ss
@@ -277,16 +291,21 @@ class AdamW(Adam):
                        if isinstance(weight_decay, L2Decay)
                        else float(weight_decay))
         self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
 
     def _decoupled(self):
         return True
 
-    def update_param(self, p, g, slots, lr, step, param_name=None):
-        if (self._apply_decay_param_fun is None
-                or (param_name is not None
-                    and self._apply_decay_param_fun(param_name))):
-            p = p - lr * self._coeff * p
-        return super().update_param(p, g, slots, lr, step)
+    def _param_lr_ratio(self, param):
+        if self._lr_ratio is None or param is None:
+            return 1.0
+        return float(self._lr_ratio(param))
+
+    def _decoupled_decay(self, p, lr, param_name=None):
+        fn = self._apply_decay_param_fun
+        if fn is not None and param_name is not None and not fn(param_name):
+            return p
+        return p - lr * self._coeff * p
 
 
 class Adamax(Optimizer):

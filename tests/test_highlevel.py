@@ -1,6 +1,7 @@
 """amp / io / metric / distribution / vision / text / hapi.Model tests
 (modelled on the reference's test_amp*, test_dataloader*, test_metrics,
 test_distribution, test_model.py suites)."""
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -297,3 +298,24 @@ def test_summary_counts_params(capsys):
     net = nn.Linear(10, 5)
     info = paddle.summary(net)
     assert info["total_params"] == 55
+
+
+def test_grad_scaler_no_double_unscale():
+    # ADVICE r1: unscale_ then step must not divide grads twice.
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as opt_mod
+    p = paddle.nn.Linear(2, 2).weight
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    opt = opt_mod.SGD(learning_rate=1.0, parameters=[p])
+    p0 = np.asarray(p.data).copy()
+    g = np.ones((2, 2), np.float32)
+    p._grad_data = jnp.asarray(g * 4.0)  # pre-scaled grad
+    scaler.unscale_(opt)
+    scaler.step(opt)       # must NOT unscale again
+    scaler.update()
+    np.testing.assert_allclose(np.asarray(p.data), p0 - g, rtol=1e-6)
+    import pytest as _pytest
+    p._grad_data = jnp.asarray(g)
+    scaler.unscale_(opt)
+    with _pytest.raises(RuntimeError):
+        scaler.unscale_(opt)

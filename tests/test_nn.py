@@ -294,3 +294,39 @@ def test_forward_hooks():
     h.remove()
     l(paddle.randn([1, 2]))
     assert calls == [1]
+
+
+def test_cross_entropy_weighted_mean_semantics():
+    # ADVICE r1: weighted mean divides by the sum of selected class weights.
+    logits = paddle.to_tensor(np.array(
+        [[2.0, 1.0, 0.1], [0.5, 2.5, 0.3]], np.float32))
+    label = paddle.to_tensor(np.array([0, 1], np.int64))
+    weight = paddle.to_tensor(np.array([0.5, 2.0, 1.0], np.float32))
+    out = F.cross_entropy(logits, label, weight=weight, reduction="mean")
+    logp = np.log(np.exp(np.asarray(logits.data))
+                  / np.exp(np.asarray(logits.data)).sum(-1, keepdims=True))
+    per = -logp[np.arange(2), [0, 1]] * np.array([0.5, 2.0])
+    expect = per.sum() / (0.5 + 2.0)
+    np.testing.assert_allclose(float(out), expect, rtol=1e-5)
+
+
+def test_sublayer_non_persistable_buffer_excluded():
+    # ADVICE r1: sublayer non-persistable buffers must not hit state_dict.
+    class Sub(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("scratch", paddle.to_tensor(
+                np.zeros(2, np.float32)), persistable=False)
+            self.register_buffer("kept", paddle.to_tensor(
+                np.ones(2, np.float32)), persistable=True)
+
+    class Top(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.sub = Sub()
+            self.register_buffer("kept", paddle.to_tensor(
+                np.full(2, 2.0, np.float32)), persistable=True)
+
+    sd = Top().state_dict()
+    assert "sub.scratch" not in sd
+    assert "sub.kept" in sd and "kept" in sd

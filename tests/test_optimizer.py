@@ -1,5 +1,6 @@
 """Optimizer + LR schedule + clip tests (modelled on the reference's
 test_sgd_op.py / test_adam_op.py / test_lr_scheduler.py oracles)."""
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -215,3 +216,58 @@ def test_multi_precision_bf16():
     opt.step()
     assert p.dtype == paddle.bfloat16
     assert opt._slots[id(p)]["master"].dtype == np.float32
+
+
+def test_adamw_apply_decay_param_fun():
+    # ADVICE r1: param_name must reach the decay decision in BOTH paths.
+    w = paddle.nn.Linear(4, 4).weight
+    w.name = "linear_0.w_0"
+    b = paddle.nn.Linear(4, 4).bias
+    b.name = "linear_0.b_0"
+    w0, b0 = np.asarray(w.data).copy(), np.asarray(b.data).copy()
+    opt = optimizer.AdamW(
+        learning_rate=0.1, parameters=[w, b], weight_decay=0.5,
+        apply_decay_param_fun=lambda n: not n.endswith("b_0"))
+    # zero grads: only decoupled decay moves params
+    w._grad_data = jnp.zeros_like(w.data)
+    b._grad_data = jnp.zeros_like(b.data)
+    opt.step()
+    assert not np.allclose(np.asarray(w.data), w0), "weight must decay"
+    np.testing.assert_allclose(np.asarray(b.data), b0, atol=1e-7)
+
+
+def test_adamw_functional_decay_param_fun():
+    w = paddle.nn.Linear(4, 4).weight
+    w.name = "w_0"
+    b = paddle.nn.Linear(4, 4).bias
+    b.name = "b_0"
+    opt = optimizer.AdamW(
+        learning_rate=0.1, parameters=[w, b], weight_decay=0.5,
+        apply_decay_param_fun=lambda n: not n.startswith("b"))
+    states = opt.functional_init([w.data, b.data])
+    zeros = [jnp.zeros_like(w.data), jnp.zeros_like(b.data)]
+    (nw, nb), _ = opt.functional_update(
+        [w.data, b.data], zeros, states, 0.1, 1, params_meta=[w, b])
+    assert not np.allclose(np.asarray(nw), np.asarray(w.data))
+    np.testing.assert_allclose(np.asarray(nb), np.asarray(b.data), atol=1e-7)
+
+
+def test_eager_clip_before_decay_matches_functional():
+    # ADVICE r1: eager step() must clip raw grads first, then regularize —
+    # same order as functional_update.
+    from paddle_tpu.optimizer.clip import ClipGradByGlobalNorm
+    rng = np.random.RandomState(0)
+    pa = paddle.nn.Linear(4, 4).weight
+    pa.data = jnp.asarray(rng.randn(4, 4).astype(np.float32))
+    g = jnp.asarray(rng.randn(4, 4).astype(np.float32) * 10)
+    opt1 = optimizer.Momentum(learning_rate=0.1, parameters=[pa],
+                              weight_decay=0.1,
+                              grad_clip=ClipGradByGlobalNorm(1.0))
+    p0 = pa.data
+    states = opt1.functional_init([p0])
+    (expect,), _ = opt1.functional_update([p0], [g], states, 0.1, 1,
+                                          params_meta=[pa])
+    pa._grad_data = g
+    opt1.step()
+    np.testing.assert_allclose(np.asarray(pa.data), np.asarray(expect),
+                               rtol=1e-6)
