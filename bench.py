@@ -1,0 +1,183 @@
+"""Benchmark harness — BERT-base-shaped masked-LM pretraining step.
+
+Run:  python bench.py [--steps N] [--profile DIR] [--small]
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+The flagship config matches BASELINE.json configs[2-3] (BERT-base /
+ERNIE-1.0 shapes: L=12, H=768, A=12, FF=3072, seq=512).  The whole train
+step — forward, backward, AdamW update, global-norm clip — is ONE compiled
+XLA program with donated buffers (paddle_tpu.jit.TrainStep), bf16 compute
+with fp32 master weights.  vs_baseline is measured MFU / 0.35 (the
+BASELINE.json north-star floor of 35% MFU).
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+# bf16 peak FLOPs/s per chip by device kind (public specs)
+_PEAK = {
+    "TPU v2": 46e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU7x": 2307e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for k, v in sorted(_PEAK.items(), key=lambda kv: -len(kv[0])):
+        if k.lower() in kind.lower():
+            return v
+    return 0.0  # unknown (CPU): MFU not defined
+
+
+def build_model(vocab, hidden, layers, heads, ffn, seq, dropout):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    class BertMLM(nn.Layer):
+        """BERT-base-shaped encoder LM (reference shapes:
+        nn/layer/transformer.py TransformerEncoder; PaddleNLP bert-base)."""
+
+        def __init__(self):
+            super().__init__()
+            self.tok = nn.Embedding(vocab, hidden)
+            self.pos = nn.Embedding(seq, hidden)
+            enc = nn.TransformerEncoderLayer(
+                hidden, heads, ffn, dropout=dropout, activation="gelu",
+                attn_dropout=dropout, act_dropout=dropout)
+            self.encoder = nn.TransformerEncoder(enc, layers)
+            self.norm = nn.LayerNorm(hidden)
+            self.head = nn.Linear(hidden, vocab)
+
+        def forward(self, ids):
+            pos_ids = paddle.arange(ids.shape[1]).unsqueeze(0)
+            x = self.tok(ids) + self.pos(pos_ids)
+            x = self.encoder(x)
+            return self.head(self.norm(x))
+
+    return BertMLM()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--profile", type=str, default=None,
+                    help="directory for a jax profiler trace of timed steps")
+    ap.add_argument("--small", action="store_true",
+                    help="force the tiny CPU config")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu" and not args.small
+
+    if on_tpu:
+        cfg = dict(vocab=30522, hidden=768, layers=12, heads=12, ffn=3072,
+                   seq=512, batch=64, dropout=0.1)
+        steps = args.steps or 20
+        dtype = "bfloat16"
+    else:
+        cfg = dict(vocab=1000, hidden=128, layers=2, heads=4, ffn=512,
+                   seq=128, batch=8, dropout=0.1)
+        steps = args.steps or 5
+        dtype = "float32"
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import amp, nn, optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.optimizer.clip import ClipGradByGlobalNorm
+
+    paddle.seed(2024)
+    model = build_model(cfg["vocab"], cfg["hidden"], cfg["layers"],
+                        cfg["heads"], cfg["ffn"], cfg["seq"], cfg["dropout"])
+    opt = optimizer.AdamW(
+        learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01,
+        grad_clip=ClipGradByGlobalNorm(1.0),
+        multi_precision=(dtype != "float32"))
+    if dtype != "float32":
+        model, opt = amp.decorate(model, opt, level="O2", dtype=dtype)
+
+    def loss_fn(out, labels):
+        return F.cross_entropy(out.reshape([-1, cfg["vocab"]]),
+                               labels.reshape([-1]))
+
+    step = TrainStep(model, loss_fn, opt, n_inputs=1, donate=True)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, cfg["vocab"],
+                                (cfg["batch"], cfg["seq"]), dtype=np.int32))
+    y = jnp.asarray(rng.randint(0, cfg["vocab"],
+                                (cfg["batch"], cfg["seq"]), dtype=np.int32))
+
+    for _ in range(args.warmup):
+        loss = step(x, y)
+    float(loss)  # sync
+
+    prof = None
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
+        prof = args.profile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    last = float(loss)  # device sync
+    dt = time.perf_counter() - t0
+    if prof:
+        jax.profiler.stop_trace()
+
+    steps_per_sec = steps / dt
+    tokens = cfg["batch"] * cfg["seq"]
+    tokens_per_sec = tokens * steps_per_sec
+
+    # model FLOPs: 6*N*T for matmuls (fwd+bwd) + 12*L*B*S^2*H attention
+    # scores/values (PaLM appendix-B accounting)
+    n_params = sum(int(np.prod(p.shape_tuple)) for p in model.parameters())
+    n_embed = cfg["vocab"] * cfg["hidden"] + cfg["seq"] * cfg["hidden"]
+    n_dense = n_params - n_embed
+    flops_per_step = (6 * n_dense * tokens
+                      + 12 * cfg["layers"] * cfg["batch"]
+                      * cfg["seq"] ** 2 * cfg["hidden"])
+    achieved = flops_per_step * steps_per_sec
+    peak = _peak_flops(dev)
+    mfu = achieved / peak if peak else 0.0
+
+    result = {
+        "metric": ("bert_base_pretrain_tokens_per_sec_per_chip" if on_tpu
+                   else "bert_tiny_cpu_smoke_tokens_per_sec"),
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4) if peak else 0.0,
+        "mfu": round(mfu, 4),
+        "steps_per_sec": round(steps_per_sec, 4),
+        "step_time_ms": round(1000 * dt / steps, 2),
+        "model_flops_per_step": flops_per_step,
+        "final_loss": round(last, 4),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "platform": dev.platform,
+        "config": cfg,
+        "dtype": dtype,
+        "donated": True,
+        "profile_dir": prof,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

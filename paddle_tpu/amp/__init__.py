@@ -167,35 +167,34 @@ class GradScaler:
                 "unscale_() has already been called on this optimizer "
                 "since the last update()")
         inv = 1.0 / self._scale
-        found = False
+        checks = []
         for p in optimizer._parameter_list or []:
             if p._grad_data is None:
                 continue
             g = p._grad_data * inv
-            finite = bool(jnp.all(jnp.isfinite(g)))
-            if not finite:
-                found = True
+            checks.append(jnp.all(jnp.isfinite(g)))
             p._grad_data = g
+        # one host sync for the whole param list, not one per param
+        found = bool(not jnp.all(jnp.stack(checks))) if checks else False
         self._unscaled[id(optimizer)] = found
         self._found_inf = self._found_inf or found
 
     def step(self, optimizer):
+        """Unscale (if not already) and apply the optimizer step unless inf/
+        nan was found.  Call ``update()`` once per iteration afterwards
+        (paddle 2.x flow); ``minimize`` does both."""
         if not self._enable:
             optimizer.step()
             return
         if id(optimizer) not in self._unscaled:
             self.unscale_(optimizer)
-        if not self._unscaled.pop(id(optimizer)):
+        if not self._unscaled[id(optimizer)]:
             optimizer.step()
-        # auto-update only once all unscaled optimizers have stepped, so a
-        # multi-optimizer flow (unscale D, unscale G, step D, step G) never
-        # re-unscales G's grads mid-flight
-        if not self._unscaled:
-            self.update()
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
         self._unscaled.clear()

@@ -36,7 +36,7 @@ _name_counter = itertools.count(0)
 class Tensor:
     __slots__ = ("data", "stop_gradient", "name", "persistable", "_bw_id",
                  "_produced", "_node", "_grad_data", "_backward_hooks",
-                 "trainable", "__weakref__")
+                 "trainable", "placement", "__weakref__")
 
     def __init__(self, data, stop_gradient: bool = True, name: str | None = None,
                  persistable: bool = False, _produced: bool = False):
@@ -54,6 +54,7 @@ class Tensor:
         self._grad_data = None
         self._backward_hooks: List = []
         self.trainable = not stop_gradient
+        self.placement = None  # PartitionSpec set by parallel.set_placement
 
     # -- basic metadata ----------------------------------------------------
     @property
@@ -223,7 +224,8 @@ class Tensor:
 
 class Parameter(Tensor):
     """Trainable tensor (reference: python/paddle/fluid/framework.py Parameter)."""
-    __slots__ = ("regularizer", "need_clip", "optimize_attr", "is_distributed")
+    __slots__ = ("regularizer", "need_clip", "optimize_attr",
+                 "is_distributed")
 
     def __init__(self, data, name=None, trainable=True, regularizer=None,
                  need_clip=True):

@@ -50,6 +50,17 @@ class ParamAttr:
         raise TypeError(f"Cannot interpret {attr!r} as ParamAttr")
 
 
+_unique_counters: Dict[str, int] = {}
+
+
+def unique_name(prefix: str) -> str:
+    """paddle.utils.unique_name-style 'prefix_N' generator (reference:
+    python/paddle/fluid/unique_name.py)."""
+    i = _unique_counters.get(prefix, 0)
+    _unique_counters[prefix] = i + 1
+    return f"{prefix}_{i}"
+
+
 class Layer:
     def __init__(self, name_scope=None, dtype="float32"):
         object.__setattr__(self, "_parameters", collections.OrderedDict())
@@ -61,6 +72,8 @@ class Layer:
         self._forward_pre_hooks = collections.OrderedDict()
         self._forward_post_hooks = collections.OrderedDict()
         self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._auto_name = None  # lazy 'linear_0'-style unique scope
+        self._param_suffix_counts = {}
 
     # -- attribute magic ---------------------------------------------------
     def __setattr__(self, name, value):
@@ -119,7 +132,17 @@ class Layer:
         dtype = convert_dtype(dtype) or self._dtype or get_default_dtype()
         init = attr.initializer or default_initializer or (
             I.Constant(0.0) if is_bias else I.XavierNormal())
-        p = Parameter(init(tuple(shape), dtype), name=attr.name,
+        name = attr.name
+        if name is None:
+            # paddle-convention auto-name 'linear_0.w_0' / 'linear_0.b_0'
+            # so apply_decay_param_fun-style predicates work unmodified
+            if self._auto_name is None:
+                self._auto_name = unique_name(self._name_scope)
+            suffix = "b" if is_bias else "w"
+            k = self._param_suffix_counts.get(suffix, 0)
+            self._param_suffix_counts[suffix] = k + 1
+            name = f"{self._auto_name}.{suffix}_{k}"
+        p = Parameter(init(tuple(shape), dtype), name=name,
                       trainable=attr.trainable, regularizer=attr.regularizer,
                       need_clip=attr.need_clip)
         p.optimize_attr["learning_rate"] = attr.learning_rate

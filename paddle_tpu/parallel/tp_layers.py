@@ -22,25 +22,18 @@ from ..nn.layer_base import Layer
 from ..distributed.mesh import MP_AXIS
 
 
-def mark_placement(param: Parameter, *spec) -> Parameter:
+def set_placement(param: Parameter, *spec) -> Parameter:
     """Attach a PartitionSpec placement to a Parameter (consumed by
     SpmdTrainStep / dryrun_multichip for in_shardings)."""
-    object.__setattr__ if False else None
     param.placement = PartitionSpec(*spec)
     return param
 
 
-# Parameter uses __slots__; extend dynamically via a registry
-_placements = {}
-
-
-def set_placement(param, *spec):
-    _placements[id(param)] = PartitionSpec(*spec)
-    return param
+mark_placement = set_placement
 
 
 def get_placement(param):
-    return _placements.get(id(param))
+    return getattr(param, "placement", None)
 
 
 class ColumnParallelLinear(Layer):

@@ -62,6 +62,7 @@ def test_grad_scaler_skips_on_inf():
     scaler = amp.GradScaler(init_loss_scaling=4.0)
     (p * float("inf")).backward()
     scaler.step(opt)
+    scaler.update()
     np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
     assert scaler._scale == 2.0  # halved
 
