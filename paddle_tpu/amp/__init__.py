@@ -188,7 +188,9 @@ class GradScaler:
             return
         if id(optimizer) not in self._unscaled:
             self.unscale_(optimizer)
-        if not self._unscaled[id(optimizer)]:
+        # pop: the entry covers exactly one step, so the next iteration's
+        # step() re-unscales even if the user skips update()
+        if not self._unscaled.pop(id(optimizer)):
             optimizer.step()
 
     def minimize(self, optimizer, scaled_loss):

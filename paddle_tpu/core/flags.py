@@ -90,3 +90,6 @@ define_flag("allocator_strategy", "xla",
             "Accepted for parity; XLA/TPU runtime owns allocation.")
 define_flag("profile_dir", "",
             "If set, profiler traces are written here.")
+define_flag("pallas_attention_min_seqlen", 1024,
+            "Use the Pallas flash-attention kernel at/above this sequence "
+            "length (below it XLA's fused attention is faster on-chip).")

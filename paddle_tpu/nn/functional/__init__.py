@@ -876,9 +876,23 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
-    """[B, L, H, D] attention (paddle incubate layout).  On TPU the Pallas
-    flash-attention kernel (paddle_tpu.ops.pallas) replaces this when
-    FLAGS_use_pallas_kernels is on and shapes allow."""
+    """[B, L, H, D] attention (paddle incubate layout).  The Pallas
+    flash-attention kernel (paddle_tpu.ops.pallas) replaces the jnp path
+    when FLAGS_use_pallas_kernels is on and shapes allow (reference analog:
+    operators/math/bert_encoder_functor.cu fused attention)."""
+    from ...core.flags import get_flag
+    if get_flag("use_pallas_kernels"):
+        from ...ops.pallas import flash_attention, flash_attention_supported
+        q_shape = tuple(query.shape)
+        k_shape = tuple(key.shape)
+        dtype = (query.data if hasattr(query, "data") else query).dtype
+        eff_dropout = dropout_p if training else 0.0
+        if flash_attention_supported(q_shape, k_shape, dtype, attn_mask,
+                                     eff_dropout):
+            return apply(
+                lambda q, k, v: flash_attention(q, k, v, causal=is_causal),
+                query, key, value, op_name="flash_attention")
+
     dkey = next_key() if (dropout_p > 0.0 and training) else None
 
     def _sdpa(q, k, v, *m):

@@ -1,0 +1,14 @@
+"""Pallas TPU kernels — the hand-fused native tier.
+
+These are the TPU analog of the reference's hand-written CUDA fusions
+(reference: operators/math/bert_encoder_functor.cu multi-head attention,
+operators/fused/, ir/*_fuse_pass.cc): where XLA's automatic fusion is not
+enough (attention's softmax-rescale dataflow), we write the kernel by hand
+against the MXU/VMEM model.  Selection is behind FLAGS_use_pallas_kernels
+with per-op capability checks; every kernel has an interpret-mode path so
+the same code runs (slowly) on CPU in tests.
+"""
+from .flash_attention import (flash_attention, flash_attention_supported,
+                              mha_reference)
+
+__all__ = ["flash_attention", "flash_attention_supported", "mha_reference"]

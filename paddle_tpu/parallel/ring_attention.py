@@ -94,17 +94,78 @@ def ring_attention_per_device(q, k, v, axis_name: str, is_causal: bool,
     return o / denom
 
 
+def _flash_eligible(q) -> bool:
+    from ..core.flags import get_flag
+    from ..ops.pallas.flash_attention import flash_attention_supported
+    if not get_flag("use_pallas_kernels"):
+        return False
+    shape = tuple(q.shape)  # the per-device local shard shape
+    return flash_attention_supported(shape, shape, q.dtype)
+
+
+def ring_attention_per_device_flash(q, k, v, axis_name: str, is_causal: bool,
+                                    scale: Optional[float] = None):
+    """Ring attention whose per-block math is the Pallas flash kernel.
+
+    Each round attends my Q block against the circulating K/V block with
+    the fused kernel (normalized output + logsumexp), then merges rounds
+    with logsumexp weights.  Causality rides the kernel's *global position
+    offsets*: q_off = my·L, k_off = src·L — rounds holding earlier shards
+    are fully visible, later shards fully masked, the diagonal causal,
+    all with one kernel (differentiable through the scan)."""
+    from ..ops.pallas.flash_attention import flash_attention_block
+    B, Lq, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    S = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    qt = jnp.swapaxes(q, 1, 2)                 # [B, H, L, D]
+    q_off = (my * Lq).astype(jnp.float32).reshape(1, 1)
+
+    def step(carry, r):
+        k_blk, v_blk, o, lse = carry
+        src = (my - r) % S
+        if is_causal:
+            k_off = (src * Lq).astype(jnp.float32).reshape(1, 1)
+        else:
+            # every position visible: put K "infinitely in the past"
+            k_off = jnp.full((1, 1), -1e9, jnp.float32)
+        o_b, lse_b = flash_attention_block(
+            qt, jnp.swapaxes(k_blk, 1, 2), jnp.swapaxes(v_blk, 1, 2),
+            q_off, k_off, scale)
+        lse_new = jnp.logaddexp(lse, lse_b)
+        finite = jnp.isfinite(lse_new)
+        w_old = jnp.where(finite, jnp.exp(lse - lse_new), 0.0)
+        w_new = jnp.where(finite, jnp.exp(lse_b - lse_new), 0.0)
+        o = o * w_old + o_b.astype(jnp.float32) * w_new
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, o, lse_new), None
+
+    o0 = jnp.zeros((B, H, Lq, D), jnp.float32)
+    lse0 = jnp.full((B, H, Lq, 1), -jnp.inf, jnp.float32)
+    (_, _, o, _), _ = jax.lax.scan(step, (k, v, o0, lse0), jnp.arange(S))
+    return jnp.swapaxes(o, 1, 2).astype(q.dtype)
+
+
 def ring_attention(q, k, v, is_causal=True, mesh=None,
                    axis_name: str = SP_AXIS):
     """Tensor-level ring attention: q/k/v [B, L, H, D] with L sharded over
-    the 'sp' axis.  Exact attention over the full sequence."""
+    the 'sp' axis.  Exact attention over the full sequence.  Per-block math
+    uses the Pallas flash kernel when eligible (long local blocks)."""
     mesh = mesh or ensure_mesh()
 
     def _ra(qa, ka, va):
+        n = mesh.shape[axis_name]
+        local = qa.shape[1] // n
+        use_flash = _flash_eligible(
+            jax.ShapeDtypeStruct((qa.shape[0], local, qa.shape[2],
+                                  qa.shape[3]), qa.dtype))
+        body = (ring_attention_per_device_flash if use_flash
+                else ring_attention_per_device)
         spec = PartitionSpec(None, axis_name, None, None)
         fn = shard_map(
-            lambda a, b, c: ring_attention_per_device(
-                a, b, c, axis_name, is_causal),
+            lambda a, b, c: body(a, b, c, axis_name, is_causal),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
         return fn(qa, ka, va)

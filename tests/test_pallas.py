@@ -1,0 +1,174 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh; the same kernels
+compile on TPU — parity there was measured during bring-up).
+
+Modelled on the reference's fused-op tests (test_fused_attention_op.py
+pattern: fused output vs composed-op oracle, fwd + grad)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.flags import get_flag, set_flags
+from paddle_tpu.ops.pallas import (flash_attention,
+                                   flash_attention_supported, mha_reference)
+
+
+@pytest.fixture
+def low_seq_threshold():
+    old = get_flag("pallas_attention_min_seqlen")
+    set_flags({"pallas_attention_min_seqlen": 16})
+    yield
+    set_flags({"pallas_attention_min_seqlen": old})
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-5),
+                                       (jnp.bfloat16, 3e-2)])
+def test_flash_forward_parity(causal, dtype, tol):
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.randn(2, 128, 2, 32), dtype)
+    k = jnp.asarray(r.randn(2, 128, 2, 32), dtype)
+    v = jnp.asarray(r.randn(2, 128, 2, 32), dtype)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=tol)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grad_parity(causal):
+    r = np.random.RandomState(1)
+    q = jnp.asarray(r.randn(1, 64, 2, 16), jnp.float32)
+    k = jnp.asarray(r.randn(1, 64, 2, 16), jnp.float32)
+    v = jnp.asarray(r.randn(1, 64, 2, 16), jnp.float32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        o = mha_reference(q, k, v, causal=causal)
+        return jnp.sum(o * o)
+
+    gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_cross_attention_shapes():
+    r = np.random.RandomState(2)
+    q = jnp.asarray(r.randn(2, 64, 2, 16), jnp.float32)
+    k = jnp.asarray(r.randn(2, 128, 2, 16), jnp.float32)
+    v = jnp.asarray(r.randn(2, 128, 2, 16), jnp.float32)
+    out = flash_attention(q, k, v, causal=False, block_q=32, block_k=64)
+    ref = mha_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_supported_capability_checks(low_seq_threshold):
+    shape = (2, 128, 2, 32)
+    assert flash_attention_supported(shape, shape, jnp.float32)
+    assert not flash_attention_supported(shape, shape, jnp.float16)
+    assert not flash_attention_supported(shape, shape, jnp.float32,
+                                         attn_mask=object())
+    assert not flash_attention_supported(shape, shape, jnp.float32,
+                                         dropout_p=0.1)
+    assert not flash_attention_supported((2, 128, 2, 30), shape, jnp.float32)
+    # below the profitability threshold -> jnp path
+    set_flags({"pallas_attention_min_seqlen": 100000})
+    assert not flash_attention_supported(shape, shape, jnp.float32)
+
+
+def test_sdpa_dispatches_to_flash(low_seq_threshold):
+    import paddle_tpu.nn.functional as F
+    r = np.random.RandomState(3)
+    q = paddle.to_tensor(r.randn(1, 64, 2, 16).astype(np.float32),
+                         stop_gradient=False)
+    k = paddle.to_tensor(r.randn(1, 64, 2, 16).astype(np.float32),
+                         stop_gradient=False)
+    v = paddle.to_tensor(r.randn(1, 64, 2, 16).astype(np.float32),
+                         stop_gradient=False)
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    ref = mha_reference(q.data, k.data, v.data, causal=True)
+    np.testing.assert_allclose(np.asarray(out.data), np.asarray(ref),
+                               atol=1e-5)
+    # autograd flows through the custom vjp
+    out.sum().backward()
+    assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
+
+
+def test_ring_attention_flash_path(low_seq_threshold):
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.parallel.ring_attention import (reference_attention,
+                                                    ring_attention)
+    mesh = init_mesh({"sp": 4})
+    r = np.random.RandomState(4)
+    # 32 positions per device >= the lowered threshold -> flash block math
+    q = paddle.to_tensor(r.randn(1, 128, 2, 16).astype(np.float32))
+    k = paddle.to_tensor(r.randn(1, 128, 2, 16).astype(np.float32))
+    v = paddle.to_tensor(r.randn(1, 128, 2, 16).astype(np.float32))
+    for causal in (False, True):
+        out = ring_attention(q, k, v, is_causal=causal, mesh=mesh)
+        ref = reference_attention(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.asarray(ref.data),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_flash_grads(low_seq_threshold):
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.parallel.ring_attention import (
+        reference_attention, ring_attention_per_device_flash)
+    from jax.sharding import PartitionSpec
+    from jax import shard_map
+    mesh = init_mesh({"sp": 4})
+    r = np.random.RandomState(5)
+    qkv = [jnp.asarray(r.randn(1, 128, 2, 16), jnp.float32)
+           for _ in range(3)]
+    spec = PartitionSpec(None, "sp", None, None)
+
+    def ring_loss(q, k, v):
+        fn = shard_map(
+            lambda a, b, c: ring_attention_per_device_flash(
+                a, b, c, "sp", True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    def ref_loss(q, k, v):
+        o = reference_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                paddle.to_tensor(v), is_causal=True)
+        return jnp.sum(o.data ** 2)
+
+    g_ring = jax.grad(ring_loss, (0, 1, 2))(*qkv)
+    g_ref = jax.grad(ref_loss, (0, 1, 2))(*qkv)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-5)
+
+
+def test_ring_attention_non_block_multiple_falls_back(low_seq_threshold):
+    # local shard 520 is not a multiple of the 512 block: eligibility must
+    # reject it and the jnp ring path must produce exact results
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.parallel.ring_attention import (reference_attention,
+                                                    ring_attention)
+    mesh = init_mesh({"sp": 2})
+    r = np.random.RandomState(6)
+    q = paddle.to_tensor(r.randn(1, 1040, 1, 8).astype(np.float32))
+    k = paddle.to_tensor(r.randn(1, 1040, 1, 8).astype(np.float32))
+    v = paddle.to_tensor(r.randn(1, 1040, 1, 8).astype(np.float32))
+    out = ring_attention(q, k, v, is_causal=True, mesh=mesh)
+    ref = reference_attention(q, k, v, is_causal=True)
+    assert np.isfinite(np.asarray(out.data)).all()
+    np.testing.assert_allclose(np.asarray(out.data), np.asarray(ref.data),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_supported_vmem_cap():
+    # 32k x 64 f32 K/V cannot be staged whole in VMEM -> not supported
+    big = (1, 32768, 1, 64)
+    assert not flash_attention_supported(big, big, jnp.float32)
