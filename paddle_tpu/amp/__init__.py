@@ -226,6 +226,7 @@ class GradScaler:
         return self._scale
 
     def state_dict(self):
+        self._sync_from_bound_step()
         return {"scale": self._scale, "good_steps": self._good_steps,
                 "bad_steps": self._bad_steps}
 
@@ -233,3 +234,19 @@ class GradScaler:
         self._scale = sd["scale"]
         self._good_steps = sd["good_steps"]
         self._bad_steps = sd["bad_steps"]
+        # invalidate any compiled TrainStep's in-graph state so the next
+        # step reinitialises from the loaded values
+        step = getattr(self, "_bound_step", None)
+        if step is not None:
+            step._scaler_state = None
+
+    def _sync_from_bound_step(self):
+        """Pull the in-graph loss-scaling state from a TrainStep that
+        threads this scaler through its compiled step (jit/train_step.py);
+        one host sync, used at checkpoint time only."""
+        step = getattr(self, "_bound_step", None)
+        st = getattr(step, "_scaler_state", None)
+        if st and "scale" in st:
+            self._scale = float(st["scale"])
+            self._good_steps = int(st["good"])
+            self._bad_steps = int(st["bad"])

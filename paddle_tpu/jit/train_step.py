@@ -6,6 +6,18 @@ backward, gradient clip, and optimizer update are traced into ONE XLA
 executable with donated buffers, so the MXU never waits on Python between
 micro-steps.  Under a `Mesh` (paddle_tpu.distributed) the same step is
 pjit-sharded for DP/TP/PP hybrid execution.
+
+Also compiled in-graph (zero host syncs per step):
+- **dynamic loss scaling** (``scaler=``): scale the loss, unscale grads,
+  detect non-finite grads, skip the update and adjust the scale — the
+  reference's check_finite_and_unscale + update_loss_scaling ops
+  (operators/amp/check_finite_and_unscale_op.cu, update_loss_scaling_op.cu)
+  as a handful of fused scalar ops.
+- **gradient accumulation** (``accumulate_steps=k``): a lax.scan over k
+  microbatches accumulating f32 grads, one optimizer update — the
+  reference's gradient-merge meta-optimizer
+  (fleet/meta_optimizers/gradient_merge_optimizer.py:18,
+  grad_merge_all_reduce_op_handle.cc) without the extra memory pass.
 """
 from __future__ import annotations
 
@@ -21,6 +33,12 @@ from .bind import bind, buffer_arrays, buffer_names, param_list
 _as_arr = lambda x: x.data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
+def _select(pred, when_true, when_false):
+    """Per-leaf scalar select over matching pytrees."""
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b),
+                        when_true, when_false)
+
+
 class TrainStep:
     """Compile `loss = loss_fn(model(*inputs), *labels)` + optimizer update.
 
@@ -32,10 +50,16 @@ class TrainStep:
     ``loss_fn`` receives (model_output, *labels) as Tensors inside the trace.
     Model parameters / optimizer slots / buffers live as device arrays
     between calls and are donated each step (no copies).
+
+    ``scaler``: a paddle_tpu.amp.GradScaler whose dynamic-loss-scaling state
+    is threaded through the compiled step (fp16 path; bf16 needs none).
+    ``accumulate_steps``: microbatch gradient accumulation inside the step
+    (the global batch you pass is split into this many microbatches).
     """
 
     def __init__(self, model, loss_fn: Callable, optimizer,
-                 n_inputs: int = 1, donate: bool = False):
+                 n_inputs: int = 1, donate: bool = False, scaler=None,
+                 accumulate_steps: int = 1):
         # donate=False by default: eager user code may alias param arrays
         # (e.g. state_dict sharing); SpmdTrainStep/bench enable donation.
         self.model = model
@@ -47,49 +71,160 @@ class TrainStep:
         self._compiled: Dict[Any, Callable] = {}
         self._opt_state = None
         self._donate = donate
+        self.scaler = (scaler if scaler is not None
+                       and getattr(scaler, "_enable", True) else None)
+        self.accumulate_steps = int(accumulate_steps)
+        self._scaler_state = None
+        if self.scaler is not None:
+            # let scaler.state_dict()/load_state_dict() see the in-graph
+            # state (checkpoint correctness)
+            self.scaler._bound_step = self
 
-    def _build(self, training: bool):
+    # -- hooks for subclasses ---------------------------------------------
+    def _grad_transform(self, grads: List[jnp.ndarray]) -> List[jnp.ndarray]:
+        """Applied to (unscaled) grads before the optimizer update.
+        SpmdTrainStep overrides this for ZeRO-2 grad sharding."""
+        return grads
+
+    # -- the compiled step -------------------------------------------------
+    def _make_step_fn(self):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
         params_meta = self._params
         bnames = self._bnames
-        n_in = self.n_inputs
+        K = self.accumulate_steps
+        scaler = self.scaler
+        grad_transform = self._grad_transform
+        if scaler is not None:
+            sc = dict(incr_ratio=scaler._incr_ratio,
+                      decr_ratio=scaler._decr_ratio,
+                      incr_every=scaler._incr_every,
+                      decr_every=scaler._decr_every,
+                      dynamic=scaler._dynamic)
 
-        def step_fn(p_arr, b_arr, opt_state, lr, step_i, key_data, inputs,
-                    labels):
+        def step_fn(p_arr, b_arr, opt_state, sc_state, lr, step_i, key_data,
+                    inputs, labels):
             key = jax.random.wrap_key_data(key_data)
+            scale = sc_state["scale"] if scaler is not None else None
 
-            def loss_of(p_list):
-                with autograd.no_grad(), rng.seed_scope(key):
-                    with bind(model, p_list, list(b_arr)) as res:
-                        out = model(*[Tensor(a) for a in inputs])
-                        lab = [Tensor(a) for a in labels]
-                        loss_t = loss_fn(out, *lab)
-                    # new_buffers is populated on bind-context exit
-                    new_b = tuple(
-                        _as_arr(res.new_buffers.get(n, old))
-                        for n, old in zip(bnames, b_arr))
-                return loss_t.data, new_b
+            def loss_and_grad(b_cur, mb_inputs, mb_labels, kidx):
+                def loss_of(p_list):
+                    k_mb = jax.random.fold_in(key, kidx)
+                    with autograd.no_grad(), rng.seed_scope(k_mb):
+                        with bind(model, p_list, list(b_cur)) as res:
+                            out = model(*[Tensor(a) for a in mb_inputs])
+                            lab = [Tensor(a) for a in mb_labels]
+                            loss_t = loss_fn(out, *lab)
+                        # new_buffers is populated on bind-context exit
+                        new_b = tuple(
+                            _as_arr(res.new_buffers.get(n, old))
+                            for n, old in zip(bnames, b_cur))
+                    loss = loss_t.data
+                    scaled = loss * scale if scaler is not None else loss
+                    return scaled, (loss, new_b)
 
-            (loss, new_b), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(list(p_arr))
+                (_, (loss, new_b)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(list(p_arr))
+                return loss, new_b, grads
+
+            if K <= 1:
+                loss, new_b, grads = loss_and_grad(b_arr, inputs, labels, 0)
+            else:
+                # gradient merge: scan over K microbatches, f32 accumulators
+                mb_in = tuple(a.reshape(K, a.shape[0] // K, *a.shape[1:])
+                              for a in inputs)
+                mb_lab = tuple(a.reshape(K, a.shape[0] // K, *a.shape[1:])
+                               for a in labels)
+
+                def mb_body(carry, xs):
+                    b_cur, g_acc, l_acc = carry
+                    idx, ins, labs = xs
+                    loss, new_b, grads = loss_and_grad(b_cur, ins, labs, idx)
+                    g_acc = [ga + g.astype(jnp.float32)
+                             for ga, g in zip(g_acc, grads)]
+                    return (new_b, g_acc, l_acc + loss), None
+
+                g0 = [jnp.zeros(p.shape, jnp.float32) for p in p_arr]
+                (new_b, g_acc, l_sum), _ = jax.lax.scan(
+                    mb_body, (tuple(b_arr), g0, jnp.zeros((), jnp.float32)),
+                    (jnp.arange(K), mb_in, mb_lab))
+                loss = l_sum / K
+                grads = [g / K for g in g_acc]
+
+            if scaler is not None:
+                inv = 1.0 / scale
+                grads = [g * inv for g in grads]
+                finite = jnp.all(jnp.stack(
+                    [jnp.all(jnp.isfinite(g)) for g in grads]))
+                found_inf = jnp.logical_not(finite)
+
+            grads = grad_transform(grads)
             new_p, new_s = opt.functional_update(
                 list(p_arr), grads, opt_state, lr, step_i,
                 params_meta=params_meta)
-            return loss, tuple(new_p), new_b, new_s
 
+            if scaler is not None:
+                # skip the update on non-finite grads (reference:
+                # check_finite_and_unscale) ...
+                new_p = _select(found_inf, list(p_arr), new_p)
+                new_s = _select(found_inf, opt_state, new_s)
+                # ... and adjust the scale in-graph (update_loss_scaling)
+                good, bad = sc_state["good"], sc_state["bad"]
+                if sc["dynamic"]:
+                    good = jnp.where(found_inf, 0, good + 1)
+                    bad = jnp.where(found_inf, bad + 1, 0)
+                    dec = bad >= sc["decr_every"]
+                    new_scale = jnp.where(
+                        dec, jnp.maximum(scale * sc["decr_ratio"], 1.0),
+                        scale)
+                    bad = jnp.where(dec, 0, bad)
+                    inc = good >= sc["incr_every"]
+                    new_scale = jnp.where(inc, new_scale * sc["incr_ratio"],
+                                          new_scale)
+                    good = jnp.where(inc, 0, good)
+                else:
+                    new_scale = scale
+                sc_state = {"scale": new_scale, "good": good, "bad": bad,
+                            "found_inf": found_inf}
+            return loss, tuple(new_p), new_b, new_s, sc_state
+
+        return step_fn
+
+    def _build(self, training: bool):
         donate = (0, 1, 2) if self._donate else ()
-        return jax.jit(step_fn, donate_argnums=donate)
+        return jax.jit(self._make_step_fn(), donate_argnums=donate)
+
+    def _init_scaler_state(self):
+        if self.scaler is None:
+            return {}
+        return {"scale": jnp.asarray(self.scaler._scale, jnp.float32),
+                "good": jnp.asarray(self.scaler._good_steps, jnp.int32),
+                "bad": jnp.asarray(self.scaler._bad_steps, jnp.int32),
+                "found_inf": jnp.asarray(False)}
+
+    @property
+    def loss_scale(self) -> Optional[float]:
+        """Current loss scale (host sync; for logging/checkpoint only)."""
+        if self._scaler_state is None or "scale" not in self._scaler_state:
+            return None
+        return float(self._scaler_state["scale"])
 
     def __call__(self, *batch):
         assert len(batch) >= self.n_inputs, (
             f"TrainStep expects at least {self.n_inputs} input(s)")
         inputs = tuple(_as_arr(b) for b in batch[:self.n_inputs])
         labels = tuple(_as_arr(b) for b in batch[self.n_inputs:])
+        if self.accumulate_steps > 1:
+            bs = inputs[0].shape[0]
+            if bs % self.accumulate_steps:
+                raise ValueError(
+                    f"batch size {bs} is not divisible by "
+                    f"accumulate_steps={self.accumulate_steps}")
         p_arr = tuple(p.data for p in self._params)
         b_arr = tuple(buffer_arrays(self.model))
         if self._opt_state is None:
             self._opt_state = self.optimizer.functional_init(list(p_arr))
-        key = self.optimizer  # noqa: F841 (readability)
+        if self._scaler_state is None:
+            self._scaler_state = self._init_scaler_state()
         training = self.model.training
         compiled = self._compiled.get(training)
         if compiled is None:
@@ -100,9 +235,9 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step_i = jnp.asarray(self.optimizer._step_count, jnp.float32)
         key_data = jax.random.key_data(rng.next_key())
-        loss, new_p, new_b, new_s = compiled(
-            p_arr, b_arr, self._opt_state, lr, step_i, key_data, inputs,
-            labels)
+        loss, new_p, new_b, new_s, new_sc = compiled(
+            p_arr, b_arr, self._opt_state, self._scaler_state, lr, step_i,
+            key_data, inputs, labels)
         # write back (device-side aliasing, no host copies)
         for p, arr in zip(self._params, new_p):
             p.data = arr
@@ -110,6 +245,7 @@ class TrainStep:
         for n, arr in zip(self._bnames, new_b):
             buffers[n].data = arr
         self._opt_state = new_s
+        self._scaler_state = new_sc
         return Tensor(loss)
 
     def eval_step(self, *batch):

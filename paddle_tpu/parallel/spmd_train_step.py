@@ -9,24 +9,29 @@ meta-optimizer (sharding_optimizer.py:33).
 One jit'd step over a ``Mesh`` with explicit in/out shardings:
 - batch sharded over 'dp'  → gradient psum falls out of GSPMD (the DDP
   Reducer's fused allreduce, reducer.cc, becomes compiler-scheduled)
-- ZeRO: optimizer slots (stage≥1) / params (stage 3) sharded over 'dp'
-  (the reference's broadcast+reduce choreography, sharding_optimizer.py:103,
-  becomes GSPMD all-gather/reduce-scatter)
+- ZeRO stage 1: optimizer slots sharded over 'dp'
+- ZeRO stage 2: grads constrained to 'dp' shardings before the update, so
+  XLA reduce-scatters gradients, updates shard-locally, and all-gathers
+  the new params (the reference's broadcast+reduce choreography,
+  sharding_optimizer.py:103-171, becomes three compiler-inserted
+  collectives)
+- ZeRO stage 3: params themselves sharded over 'dp'
 - TP: params carrying placements (parallel/tp_layers.py) partition their
   matmuls over 'mp'.
+- strategy.gradient_merge → in-step microbatch accumulation;
+  strategy.amp (float16) → in-graph dynamic loss scaling
+  (both inherited from jit.TrainStep).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Optional
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ..core.tensor import Tensor
-from ..distributed.mesh import DP_AXIS, MP_AXIS, ensure_mesh
+from ..distributed.mesh import DP_AXIS, ensure_mesh
 from ..distributed.strategy import DistributedStrategy
-from ..jit.train_step import TrainStep, _as_arr
+from ..jit.train_step import TrainStep
 from .tp_layers import get_placement
 
 
@@ -39,12 +44,31 @@ class SpmdTrainStep(TrainStep):
 
     def __init__(self, model, loss_fn, optimizer, mesh=None,
                  strategy: Optional[DistributedStrategy] = None,
-                 n_inputs: int = 1, donate: bool = True):
-        super().__init__(model, loss_fn, optimizer, n_inputs, donate)
+                 n_inputs: int = 1, donate: bool = True, scaler=None,
+                 accumulate_steps: Optional[int] = None):
+        strategy = strategy or DistributedStrategy()
+        if accumulate_steps is None:
+            accumulate_steps = (strategy.gradient_merge_configs.k_steps
+                                if strategy.gradient_merge else 1)
+        if (scaler is None and strategy.amp
+                and strategy.amp_configs.dtype == "float16"):
+            from ..amp import GradScaler
+            c = strategy.amp_configs
+            scaler = GradScaler(
+                init_loss_scaling=c.init_loss_scaling,
+                incr_ratio=c.incr_ratio, decr_ratio=c.decr_ratio,
+                incr_every_n_steps=c.incr_every_n_steps,
+                decr_every_n_nan_or_inf=c.decr_every_n_nan_or_inf,
+                use_dynamic_loss_scaling=c.use_dynamic_loss_scaling)
+        super().__init__(model, loss_fn, optimizer, n_inputs, donate,
+                         scaler=scaler, accumulate_steps=accumulate_steps)
         self.mesh = mesh or ensure_mesh()
-        self.strategy = strategy or DistributedStrategy()
+        self.strategy = strategy
 
     # -- sharding rules ----------------------------------------------------
+    def _dp_size(self) -> int:
+        return self.mesh.shape.get(DP_AXIS, 1)
+
     def _param_spec(self, p) -> PartitionSpec:
         pl = get_placement(p)
         if pl is not None:
@@ -52,7 +76,7 @@ class SpmdTrainStep(TrainStep):
         if (self.strategy.sharding
                 and self.strategy.sharding_configs.stage >= 3
                 and DP_AXIS in self.mesh.shape
-                and _shardable(p.shape_tuple, self.mesh.shape[DP_AXIS])):
+                and _shardable(p.shape_tuple, self._dp_size())):
             return PartitionSpec(DP_AXIS)
         return PartitionSpec()
 
@@ -63,15 +87,33 @@ class SpmdTrainStep(TrainStep):
         if (self.strategy.sharding
                 and self.strategy.sharding_configs.stage >= 1
                 and DP_AXIS in self.mesh.shape
-                and _shardable(slot_shape, self.mesh.shape[DP_AXIS])):
+                and _shardable(slot_shape, self._dp_size())):
             return PartitionSpec(DP_AXIS)
         return PartitionSpec()
 
     def _ns(self, spec) -> NamedSharding:
         return NamedSharding(self.mesh, spec)
 
+    # -- ZeRO-2: reduce-scatter grads + sharded update --------------------
+    def _grad_transform(self, grads):
+        if not (self.strategy.sharding
+                and self.strategy.sharding_configs.stage >= 2
+                and DP_AXIS in self.mesh.shape):
+            return grads
+        n = self._dp_size()
+        out = []
+        for p, g in zip(self._params, grads):
+            if get_placement(p) is None and _shardable(g.shape, n):
+                # constraining the grad to 'dp' makes XLA lower the grad
+                # psum as reduce-scatter, run the optimizer shard-local,
+                # and all-gather the updated params — ZeRO-2 dataflow
+                out.append(jax.lax.with_sharding_constraint(
+                    g, self._ns(PartitionSpec(DP_AXIS))))
+            else:
+                out.append(g)
+        return out
+
     def _build(self, training: bool):
-        # rebuild step_fn exactly as TrainStep does, then jit with shardings
         step_fn = self._make_step_fn()
         p_specs = tuple(self._ns(self._param_spec(p)) for p in self._params)
         b_specs = tuple(self._ns(PartitionSpec())
@@ -82,78 +124,18 @@ class SpmdTrainStep(TrainStep):
             {k: self._ns(self._slot_spec(p, v.shape))
              for k, v in slots.items()}
             for p, slots in zip(self._params, state)]
-        batch_spec = self._ns(PartitionSpec(DP_AXIS))
         scalar = self._ns(PartitionSpec())
+        sc_specs = ({k: scalar for k in self._init_scaler_state()}
+                    if self.scaler is not None else {})
+        batch_spec = self._ns(PartitionSpec(DP_AXIS))
         jitted = jax.jit(
             step_fn,
-            in_shardings=(p_specs, b_specs, s_specs, scalar, scalar,
-                          scalar, None, None),
-            out_shardings=(scalar, p_specs, b_specs, s_specs),
+            in_shardings=(p_specs, b_specs, s_specs, sc_specs, scalar,
+                          scalar, scalar, None, None),
+            out_shardings=(scalar, p_specs, b_specs, s_specs, sc_specs),
             donate_argnums=(0, 1, 2) if self._donate else (),
         )
         return _ShardBatch(jitted, batch_spec, self.n_inputs)
-
-    def _make_step_fn(self):
-        from ..core import autograd, rng
-        from ..jit.bind import bind
-        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
-        params_meta = self._params
-        bnames = self._bnames
-
-        def step_fn(p_arr, b_arr, opt_state, lr, step_i, key_data, inputs,
-                    labels):
-            key = jax.random.wrap_key_data(key_data)
-
-            def loss_of(p_list):
-                with autograd.no_grad(), rng.seed_scope(key):
-                    with bind(model, p_list, list(b_arr)) as res:
-                        out = model(*[Tensor(a) for a in inputs])
-                        lab = [Tensor(a) for a in labels]
-                        loss_t = loss_fn(out, *lab)
-                    # new_buffers is populated on bind-context exit
-                    new_b = tuple(
-                        _as_arr(res.new_buffers.get(n, old))
-                        for n, old in zip(bnames, b_arr))
-                return loss_t.data, new_b
-
-            (loss, new_b), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(list(p_arr))
-            new_p, new_s = opt.functional_update(
-                list(p_arr), grads, opt_state, lr, step_i,
-                params_meta=params_meta)
-            return loss, tuple(new_p), new_b, new_s
-
-        return step_fn
-
-    def __call__(self, *batch):
-        inputs = tuple(_as_arr(b) for b in batch[:self.n_inputs])
-        labels = tuple(_as_arr(b) for b in batch[self.n_inputs:])
-        if self._opt_state is None:
-            self._opt_state = self.optimizer.functional_init(
-                [p.data for p in self._params])
-        training = self.model.training
-        compiled = self._compiled.get(training)
-        if compiled is None:
-            compiled = self._build(training)
-            self._compiled[training] = compiled
-        from ..core import rng
-        self.optimizer._step_count += 1
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        step_i = jnp.asarray(self.optimizer._step_count, jnp.float32)
-        key_data = jax.random.key_data(rng.next_key())
-        p_arr = tuple(p.data for p in self._params)
-        from ..jit.bind import buffer_arrays
-        b_arr = tuple(buffer_arrays(self.model))
-        loss, new_p, new_b, new_s = compiled(
-            p_arr, b_arr, self._opt_state, lr, step_i, key_data, inputs,
-            labels)
-        for p, arr in zip(self._params, new_p):
-            p.data = arr
-        buffers = dict(self.model.named_buffers())
-        for n, arr in zip(self._bnames, new_b):
-            buffers[n].data = arr
-        self._opt_state = new_s
-        return Tensor(loss)
 
 
 class _ShardBatch:
@@ -166,10 +148,13 @@ class _ShardBatch:
         self._spec = batch_spec
         self.n_inputs = n_inputs
 
-    def __call__(self, p_arr, b_arr, opt_state, lr, step_i, key_data,
-                 inputs, labels):
+    def lower(self, *args):
+        return self._jitted.lower(*args)
+
+    def __call__(self, p_arr, b_arr, opt_state, sc_state, lr, step_i,
+                 key_data, inputs, labels):
         put = lambda a: jax.device_put(a, self._spec)
         inputs = tuple(put(a) for a in inputs)
         labels = tuple(put(a) for a in labels)
-        return self._jitted(p_arr, b_arr, opt_state, lr, step_i, key_data,
-                            inputs, labels)
+        return self._jitted(p_arr, b_arr, opt_state, sc_state, lr, step_i,
+                            key_data, inputs, labels)
