@@ -7,7 +7,7 @@ from .collective import (Group, ReduceOp, all_gather,  # noqa: F401
                          all_gather_object, all_reduce, alltoall, barrier,
                          broadcast, collective_permute, get_group, in_spmd,
                          new_group, recv, reduce, reduce_scatter, scatter,
-                         send, spmd)
+                         send, shift, spmd)
 from .env import (ParallelEnv, get_rank, get_world_size,  # noqa: F401
                   init_parallel_env, is_initialized)
 from .fleet import Fleet, fleet  # noqa: F401

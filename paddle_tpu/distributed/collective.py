@@ -10,10 +10,14 @@ TPU-native mapping (SURVEY §2.3): the c_* op zoo collapses into
 - **Inside an SPMD region** (``paddle_tpu.distributed.spmd`` /
   ``shard_map``): ops lower to lax.psum / all_gather / ppermute over ICI —
   this is the performance path, fully fused by XLA.
-- **Eager (global view)**: a single controller sees the *global* array, so
-  cross-rank collectives are identity/reshape transforms of the global
-  value; they exist for API parity (e.g. DataParallel scripts) and are
-  documented as such.
+- **Eager (global view)**: a single controller sees the *global* array —
+  every "rank" logically holds the same replicated value.  Collectives
+  whose result is well-defined under that replication are computed
+  mathematically (all_reduce SUM -> n·x, PROD -> x^n, all_gather -> n
+  stacked copies, broadcast -> x); collectives whose result is
+  *per-rank-divergent* (scatter, reduce_scatter, alltoall, p2p) cannot be
+  represented by one global array and raise UnimplementedError pointing
+  at the spmd()/shard_map path.
 
 The reference's stream-ordering ops (c_sync_calc_stream, c_wait_compute)
 have NO equivalent: XLA schedules communication and compute itself.
@@ -30,8 +34,9 @@ from jax.sharding import PartitionSpec
 from jax import shard_map
 
 from ..core.dispatch import apply, as_array
+from ..core.enforce import UnimplementedError
 from ..core.tensor import Tensor
-from .mesh import DP_AXIS, ensure_mesh, get_mesh
+from .mesh import DP_AXIS, axis_size, ensure_mesh, get_mesh
 
 _tls = threading.local()
 
@@ -145,14 +150,21 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             if op == ReduceOp.AVG:
                 return jax.lax.pmean(a, ax)
             if op == ReduceOp.PROD:
-                return jnp.exp(jax.lax.psum(jnp.log(a), ax))
+                # exact for zero/negative inputs (no exp/log trick)
+                return jnp.prod(jax.lax.all_gather(a, ax), axis=0)
             raise ValueError(op)
         out = apply(_ar, tensor, op_name="all_reduce")
         tensor._rebind(out)
         return tensor
-    # eager global view: values are already global; allreduce(sum) over a
-    # replicated value is identity (each "rank"'s contribution is the same
-    # logical tensor).  Kept for API parity.
+    # eager global view: every rank holds the same replicated value, so
+    # the reduction is computed mathematically (n ranks contribute x)
+    n = axis_size(ax)
+    if n > 1 and op in (ReduceOp.SUM, ReduceOp.PROD):
+        out = (apply(lambda a: a * n, tensor, op_name="all_reduce")
+               if op == ReduceOp.SUM
+               else apply(lambda a: a ** n, tensor, op_name="all_reduce"))
+        tensor._rebind(out)
+    # MAX/MIN/AVG of n equal values is the value itself
     return tensor
 
 
@@ -163,14 +175,17 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         out = apply(lambda a: jax.lax.all_gather(a, ax, tiled=True),
                     tensor, op_name="all_gather")
         if tensor_list is not None:
-            from .mesh import axis_size
             n = axis_size(ax)
             parts = out.split(n, axis=0)
             tensor_list.extend(parts)
         return out
+    # eager: n replicated ranks each contribute the same value
+    n = axis_size(ax)
+    out = apply(lambda a: jnp.concatenate([a] * n, axis=0), tensor,
+                op_name="all_gather") if n > 1 else tensor
     if tensor_list is not None:
-        tensor_list.append(tensor)
-    return tensor
+        tensor_list.extend([tensor] * n)
+    return out
 
 
 def all_gather_object(obj_list, obj, group=None):
@@ -184,19 +199,21 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if in_spmd():
         def _bc(a):
-            # select src's shard on every member: gather then index
-            full = jax.lax.all_gather(a, ax)
-            return full[src]
+            # mask-and-psum: O(|a|) bytes on the wire vs all_gather's
+            # O(n·|a|) received per member
+            mine = jax.lax.axis_index(ax) == src
+            return jax.lax.psum(jnp.where(mine, a, jnp.zeros_like(a)), ax)
         out = apply(_bc, tensor, op_name="broadcast")
         tensor._rebind(out)
         return tensor
+    # eager: replicated global view — every rank already holds src's value
     return tensor
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    if in_spmd():
-        return all_reduce(tensor, op, group)
-    return tensor
+    """Global view cannot express a dst-only result; computed as
+    all_reduce (the value every rank would see on gather)."""
+    return all_reduce(tensor, op, group)
 
 
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
@@ -208,22 +225,31 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
         out = apply(_rs, tensor, op_name="reduce_scatter")
         tensor._rebind(out)
         return tensor
-    return tensor
+    raise UnimplementedError(
+        "reduce_scatter outside an spmd() region: the per-rank result is "
+        "divergent and cannot be represented by one global array — wrap "
+        "the code in paddle_tpu.distributed.spmd(...)")
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if in_spmd():
+        n = axis_size(ax)
+        if tensor.shape[0] % n:
+            raise ValueError(
+                f"scatter: leading dim {tensor.shape[0]} is not divisible "
+                f"by the {ax!r} axis size {n}")
+
         def _sc(a):
             idx = jax.lax.axis_index(ax)
-            from .mesh import axis_size
-            n = axis_size(ax)
             chunk = a.shape[0] // n
             return jax.lax.dynamic_slice_in_dim(a, idx * chunk, chunk, 0)
         out = apply(_sc, tensor, op_name="scatter")
         tensor._rebind(out)
         return tensor
-    return tensor
+    raise UnimplementedError(
+        "scatter outside an spmd() region: the per-rank result is "
+        "divergent — wrap the code in paddle_tpu.distributed.spmd(...)")
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
@@ -240,22 +266,39 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
                 a.shape)
         out = apply(_a2a, t, op_name="alltoall")
         if out_tensor_list is not None:
-            from .mesh import axis_size
             out_tensor_list.extend(out.split(axis_size(ax), axis=0))
         return out
-    if out_tensor_list is not None:
-        out_tensor_list.extend(in_tensor_list)
-    return in_tensor_list
+    raise UnimplementedError(
+        "alltoall outside an spmd() region: the per-rank result is "
+        "divergent — wrap the code in paddle_tpu.distributed.spmd(...)")
+
+
+_P2P_MSG = (
+    "independent point-to-point {} does not exist under single-controller "
+    "SPMD: a matched send/recv pair across a mesh axis IS a collective "
+    "permutation.  Use paddle_tpu.distributed.shift(t, offset) for ring "
+    "hops or collective_permute(t, perm) for general patterns (the "
+    "send_v2/recv_v2 analog used at pipeline stage boundaries).")
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    """p2p send (reference: send_v2).  In SPMD a ring shift via ppermute —
-    pipeline stages use collective_permute below."""
-    return tensor
+    """p2p send (reference: operators/collective/send_v2_op.cc)."""
+    raise UnimplementedError(_P2P_MSG.format("send"))
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    return tensor
+    """p2p recv (reference: operators/collective/recv_v2_op.cc)."""
+    raise UnimplementedError(_P2P_MSG.format("recv"))
+
+
+def shift(tensor, offset: int = 1, group=None):
+    """Ring shift over the axis via ppermute: every member receives the
+    value held by the member ``offset`` positions before it — the
+    SPMD-native form of the send_v2/recv_v2 pipeline hop."""
+    ax = _axis(group)
+    n = axis_size(ax)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return collective_permute(tensor, perm, group)
 
 
 def collective_permute(tensor, perm, group=None):
@@ -265,7 +308,9 @@ def collective_permute(tensor, perm, group=None):
     if in_spmd():
         return apply(lambda a: jax.lax.ppermute(a, ax, perm), tensor,
                      op_name="collective_permute")
-    return tensor
+    raise UnimplementedError(
+        "collective_permute outside an spmd() region: the per-rank result "
+        "is divergent — wrap the code in paddle_tpu.distributed.spmd(...)")
 
 
 def barrier(group=None):

@@ -16,6 +16,8 @@ from paddle_tpu.parallel import (ColumnParallelLinear, RowParallelLinear,
                                  pipelined_fn, recompute, reference_attention,
                                  ring_attention, stack_stage_params)
 from jax.sharding import PartitionSpec
+P = PartitionSpec
+from paddle_tpu.distributed import init_mesh
 
 
 @pytest.fixture(autouse=True)
@@ -289,3 +291,80 @@ def test_data_parallel_wrapper_api():
     dp.apply_collective_grads()
     sd = dp.state_dict()
     assert "weight" in sd
+
+
+# ------------- honest eager collectives (round-2 VERDICT item 5) -----------
+
+def test_eager_all_reduce_replicated_math():
+    init_mesh({"dp": 4})
+    t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    out = dist.all_reduce(t)
+    np.testing.assert_allclose(out.numpy(), [4.0, 8.0])  # n ranks * x
+    t2 = paddle.to_tensor(np.array([2.0], np.float32))
+    out2 = dist.all_reduce(t2, op=dist.ReduceOp.PROD)
+    np.testing.assert_allclose(out2.numpy(), [16.0])  # x^n
+    t3 = paddle.to_tensor(np.array([3.0], np.float32))
+    np.testing.assert_allclose(
+        dist.all_reduce(t3, op=dist.ReduceOp.MAX).numpy(), [3.0])
+
+
+def test_eager_all_gather_stacks_copies():
+    init_mesh({"dp": 4})
+    t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    lst = []
+    out = dist.all_gather(lst, t)
+    assert out.shape[0] == 8 and len(lst) == 4
+
+
+def test_eager_divergent_collectives_raise():
+    from paddle_tpu.core.enforce import UnimplementedError
+    init_mesh({"dp": 4})
+    t = paddle.to_tensor(np.ones(8, np.float32))
+    for fn in (lambda: dist.scatter(t),
+               lambda: dist.reduce_scatter(t),
+               lambda: dist.alltoall(t),
+               lambda: dist.send(t, 1),
+               lambda: dist.recv(t, 0),
+               lambda: dist.collective_permute(t, [(0, 1)])):
+        with pytest.raises((UnimplementedError, NotImplementedError)):
+            fn()
+
+
+def test_spmd_prod_handles_zero_and_negative():
+    mesh = init_mesh({"dp": 4})
+
+    @dist.spmd(in_specs=(P("dp"),), out_specs=P("dp"))
+    def f(t):
+        return dist.all_reduce(t, op=dist.ReduceOp.PROD)
+
+    x = paddle.to_tensor(np.array([2.0, -1.0, 0.0, 3.0], np.float32))
+    out = f(x)
+    np.testing.assert_allclose(out.numpy(), [0.0] * 4)  # exact, no NaN
+
+
+def test_spmd_broadcast_and_shift():
+    mesh = init_mesh({"dp": 4})
+
+    @dist.spmd(in_specs=(P("dp"),), out_specs=P("dp"))
+    def bc(t):
+        return dist.broadcast(t, src=2)
+
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    np.testing.assert_allclose(bc(x).numpy(), [2.0] * 4)
+
+    @dist.spmd(in_specs=(P("dp"),), out_specs=P("dp"))
+    def sh(t):
+        return dist.shift(t, 1)
+
+    np.testing.assert_allclose(sh(x).numpy(), [3.0, 0.0, 1.0, 2.0])
+
+
+def test_spmd_scatter_divisibility_error():
+    mesh = init_mesh({"dp": 4})
+
+    @dist.spmd(in_specs=(P(),), out_specs=P())
+    def f(t):
+        return dist.scatter(t)
+
+    with pytest.raises(ValueError, match="divisible"):
+        f(paddle.to_tensor(np.ones(6, np.float32)))
