@@ -13,6 +13,22 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# PRNG impl: 'rbg' (XLA RngBitGenerator for bits, threefry for split/fold_in)
+# is ~10x cheaper than threefry on the TPU VPU — measured 84 ms/step of pure
+# mask generation on the BERT-base bench.  Must be configured before the
+# first jax.random.key() (core.rng builds the global Generator at import).
+import os as _os
+
+if "JAX_DEFAULT_PRNG_IMPL" not in _os.environ:
+    import jax as _jax
+
+    # respect an explicit programmatic choice; only replace jax's built-in
+    # default ('threefry2x32' never set by a user who wanted rbg semantics
+    # would be indistinguishable — documented limitation)
+    if _jax.config.jax_default_prng_impl == "threefry2x32":
+        _jax.config.update("jax_default_prng_impl",
+                           _os.environ.get("FLAGS_prng_impl", "rbg"))
+
 from .core import (Parameter, Tensor, enable_grad, get_default_dtype,  # noqa
                    get_flags, get_rng_state, grad, no_grad, seed,
                    set_default_dtype, set_flags, set_rng_state, to_tensor)
