@@ -1,19 +1,22 @@
-"""Benchmark harness — BERT-base-shaped masked-LM pretraining step.
+"""Benchmark harness for the BASELINE.json graded configs.
 
-Run:  python bench.py [--steps N] [--profile DIR] [--small]
+Run:  python bench.py [--steps N] [--profile DIR] [--small] [--suite S]
 
-Prints ONE JSON line on stdout:
-  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+Prints ONE JSON line on stdout.  The primary metric is the flagship
+BERT-base masked-LM pretraining step (BASELINE.json configs[2-3]: L=12,
+H=768, A=12, FF=3072, seq=512); secondary suite results (ResNet-50 conv
+path, configs[1]; LeNet dygraph smoke, configs[0]) are embedded under
+``"extra"`` in the same line.
 
-The flagship config matches BASELINE.json configs[2-3] (BERT-base /
-ERNIE-1.0 shapes: L=12, H=768, A=12, FF=3072, seq=512).  The whole train
-step — forward, backward, AdamW update, global-norm clip — is ONE compiled
-XLA program with donated buffers (paddle_tpu.jit.TrainStep), bf16 compute
-with fp32 master weights.  vs_baseline is measured MFU / 0.35 (the
-BASELINE.json north-star floor of 35% MFU).
+Every compiled benchmark runs the whole train step — forward, backward,
+optimizer update, clip — as ONE donated-buffer XLA program
+(paddle_tpu.jit.TrainStep), bf16 compute with fp32 master weights.
+vs_baseline is measured MFU / 0.35 (the BASELINE.json north-star floor).
 """
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -33,6 +36,10 @@ _PEAK = {
     "TPU v6e": 918e12,
     "TPU7x": 2307e12,
 }
+
+# ResNet-50 v1.5 @224x224: ~4.09 GFLOP/image forward (standard accounting);
+# training step counted as 3x forward (fwd + 2x bwd)
+_RESNET50_FWD_FLOPS = 4.089e9
 
 
 def _peak_flops(device) -> float:
@@ -71,21 +78,26 @@ def build_model(vocab, hidden, layers, heads, ffn, seq, dropout):
     return BertMLM()
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=None)
-    ap.add_argument("--warmup", type=int, default=2)
-    ap.add_argument("--profile", type=str, default=None,
-                    help="directory for a jax profiler trace of timed steps")
-    ap.add_argument("--small", action="store_true",
-                    help="force the tiny CPU config")
-    args = ap.parse_args()
+def _timed_steps(step, feeds, warmup, steps, profile_dir=None):
+    for _ in range(max(warmup, 1)):  # >=1: compile outside timed region
+        loss = step(*feeds)
+    float(loss)  # sync
+    if profile_dir:
+        import jax
+        jax.profiler.start_trace(profile_dir)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(*feeds)
+    last = float(loss)  # device sync
+    dt = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
+    return dt, last
 
+
+def bench_bert(args, dev, on_tpu):
     import jax
     import jax.numpy as jnp
-
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu" and not args.small
 
     if on_tpu:
         cfg = dict(vocab=30522, hidden=768, layers=12, heads=12, ffn=3072,
@@ -100,7 +112,7 @@ def main():
 
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
-    from paddle_tpu import amp, nn, optimizer
+    from paddle_tpu import amp, optimizer
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.optimizer.clip import ClipGradByGlobalNorm
 
@@ -126,25 +138,12 @@ def main():
     y = jnp.asarray(rng.randint(0, cfg["vocab"],
                                 (cfg["batch"], cfg["seq"]), dtype=np.int32))
 
-    for _ in range(max(args.warmup, 1)):  # >=1: compile outside timed region
-        loss = step(x, y)
-    float(loss)  # sync
-
-    prof = None
-    if args.profile:
-        jax.profiler.start_trace(args.profile)
-        prof = args.profile
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x, y)
-    last = float(loss)  # device sync
-    dt = time.perf_counter() - t0
-    if prof:
-        jax.profiler.stop_trace()
+    prof = args.profile or None
+    dt, last = _timed_steps(step, (x, y), args.warmup, steps,
+                            profile_dir=prof)
 
     steps_per_sec = steps / dt
     tokens = cfg["batch"] * cfg["seq"]
-    tokens_per_sec = tokens * steps_per_sec
 
     # model FLOPs: 6*N*T for matmuls (fwd+bwd) + 12*L*B*S^2*H attention
     # scores/values (PaLM appendix-B accounting)
@@ -154,14 +153,13 @@ def main():
     flops_per_step = (6 * n_dense * tokens
                       + 12 * cfg["layers"] * cfg["batch"]
                       * cfg["seq"] ** 2 * cfg["hidden"])
-    achieved = flops_per_step * steps_per_sec
     peak = _peak_flops(dev)
-    mfu = achieved / peak if peak else 0.0
+    mfu = flops_per_step * steps_per_sec / peak if peak else 0.0
 
-    result = {
+    return {
         "metric": ("bert_base_pretrain_tokens_per_sec_per_chip" if on_tpu
                    else "bert_tiny_cpu_smoke_tokens_per_sec"),
-        "value": round(tokens_per_sec, 2),
+        "value": round(tokens * steps_per_sec, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.35, 4) if peak else 0.0,
         "mfu": round(mfu, 4),
@@ -169,13 +167,152 @@ def main():
         "step_time_ms": round(1000 * dt / steps, 2),
         "model_flops_per_step": flops_per_step,
         "final_loss": round(last, 4),
-        "device": getattr(dev, "device_kind", dev.platform),
-        "platform": dev.platform,
         "config": cfg,
         "dtype": dtype,
         "donated": True,
         "profile_dir": prof,
     }
+
+
+def bench_resnet50(args, dev, on_tpu):
+    """Conv-path benchmark (BASELINE.json configs[1]): ResNet-50, synthetic
+    ImageNet shapes, SGD+momentum, bf16 with fp32 master weights."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    if on_tpu:
+        batch, hw, steps, dtype = 128, 224, (args.steps or 20), "bfloat16"
+    else:
+        batch, hw, steps, dtype = 4, 64, (args.steps or 3), "float32"
+
+    paddle.seed(2024)
+    model = resnet50()
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters(),
+                             multi_precision=(dtype != "float32"))
+    if dtype != "float32":
+        model, opt = amp.decorate(model, opt, level="O2", dtype=dtype)
+
+    def loss_fn(out, labels):
+        return F.cross_entropy(out, labels)
+
+    step = TrainStep(model, loss_fn, opt, n_inputs=1, donate=True)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.standard_normal((batch, 3, hw, hw)).astype(np.float32))
+    if dtype != "float32":
+        x = x.astype(jnp.bfloat16)  # bf16 input pipeline, standard on TPU
+    y = jnp.asarray(rng.randint(0, 1000, (batch,), dtype=np.int64))
+
+    dt, last = _timed_steps(step, (x, y), args.warmup, steps)
+    steps_per_sec = steps / dt
+    imgs_per_sec = batch * steps_per_sec
+    flops_per_step = 3 * _RESNET50_FWD_FLOPS * batch if hw == 224 else 0
+    peak = _peak_flops(dev)
+    mfu = flops_per_step * steps_per_sec / peak if peak else 0.0
+    return {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/s/chip",
+        "mfu": round(mfu, 4),
+        "step_time_ms": round(1000 * dt / steps, 2),
+        "batch": batch,
+        "image_size": hw,
+        "dtype": dtype,
+        "flops_accounting": "3 x 4.089 GF/img (fwd x3 train)",
+        "final_loss": round(last, 4),
+    }
+
+
+def bench_lenet_dygraph(args):
+    """Dygraph (eager, un-jitted) smoke benchmark (BASELINE.json
+    configs[0]): LeNet/MNIST shapes on CPU, measuring per-op Python
+    dispatch + tape overhead.  Runs in a subprocess so the CPU backend
+    doesn't fight the TPU client in this process."""
+    code = (
+        "import sys, time, json; sys.path.insert(0, %r)\n"
+        "import numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        "import paddle_tpu.nn.functional as F\n"
+        "from paddle_tpu import optimizer\n"
+        "from paddle_tpu.vision.models import LeNet\n"
+        "paddle.seed(0)\n"
+        "model = LeNet()\n"
+        "opt = optimizer.Adam(learning_rate=1e-3,"
+        " parameters=model.parameters())\n"
+        "x = paddle.to_tensor(np.random.randn(64, 1, 28, 28)"
+        ".astype('float32'))\n"
+        "y = paddle.to_tensor(np.random.randint(0, 10, (64,))"
+        ".astype('int64'))\n"
+        "def one_step():\n"
+        "    loss = F.cross_entropy(model(x), y)\n"
+        "    loss.backward(); opt.step(); opt.clear_grad()\n"
+        "    return float(loss)\n"
+        "for _ in range(3): one_step()\n"
+        "t0 = time.perf_counter(); n = 30\n"
+        "for _ in range(n): last = one_step()\n"
+        "dt = time.perf_counter() - t0\n"
+        "print(json.dumps({'step_time_ms': round(1000 * dt / n, 3),"
+        " 'steps_per_sec': round(n / dt, 2), 'final_loss': round(last, 4)}))\n"
+        % os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=600)
+        line = out.stdout.strip().splitlines()[-1]
+        res = json.loads(line)
+    except Exception as e:  # pragma: no cover - defensive
+        return {"metric": "lenet_mnist_dygraph_step_time_ms",
+                "error": f"{type(e).__name__}: {e}"}
+    res.update({"metric": "lenet_mnist_dygraph_step_time_ms",
+                "unit": "ms/step", "batch": 64, "platform": "cpu",
+                "mode": "eager"})
+    return res
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--profile", type=str, default=None,
+                    help="directory for a jax profiler trace of timed steps")
+    ap.add_argument("--small", action="store_true",
+                    help="force the tiny CPU config")
+    ap.add_argument("--suite", type=str, default="all",
+                    choices=["all", "bert", "resnet", "lenet"],
+                    help="which benchmarks to run (default: all)")
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu" and not args.small
+
+    extra = {}
+    if args.suite in ("all", "resnet"):
+        try:
+            extra["resnet50"] = bench_resnet50(args, dev, on_tpu)
+        except Exception as e:
+            extra["resnet50"] = {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "error": f"{type(e).__name__}: {e}"}
+    if args.suite in ("all", "lenet"):
+        extra["lenet_dygraph"] = bench_lenet_dygraph(args)
+
+    if args.suite in ("all", "bert"):
+        result = bench_bert(args, dev, on_tpu)
+    else:
+        k = next(iter(extra))
+        result = extra.pop(k)
+
+    result.setdefault("device", getattr(dev, "device_kind", dev.platform))
+    result.setdefault("platform", dev.platform)
+    if extra:
+        result["extra"] = extra
     print(json.dumps(result))
 
 

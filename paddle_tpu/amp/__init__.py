@@ -121,6 +121,9 @@ def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
     for m in ms:
         if m is not None:
             m.to(dtype=dt)
+            # record the decorated dtype; jit.TrainStep(amp_level=...)
+            # uses it when the caller opts into tracing under auto_cast
+            m._amp_dtype = dt
     if optimizers is not None:
         opts = ([optimizers] if not isinstance(optimizers, (list, tuple))
                 else list(optimizers))

@@ -21,6 +21,7 @@ Also compiled in-graph (zero host syncs per step):
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -59,7 +60,7 @@ class TrainStep:
 
     def __init__(self, model, loss_fn: Callable, optimizer,
                  n_inputs: int = 1, donate: bool = False, scaler=None,
-                 accumulate_steps: int = 1):
+                 accumulate_steps: int = 1, amp_level: Optional[str] = None):
         # donate=False by default: eager user code may alias param arrays
         # (e.g. state_dict sharing); SpmdTrainStep/bench enable donation.
         self.model = model
@@ -74,11 +75,22 @@ class TrainStep:
         self.scaler = (scaler if scaler is not None
                        and getattr(scaler, "_enable", True) else None)
         self.accumulate_steps = int(accumulate_steps)
+        # amp_level: re-enter auto_cast(level, model's decorated dtype)
+        # inside the compiled trace (the reference's train-loop
+        # `with amp.auto_cast(...)`); None = trace ops at their natural
+        # dtypes (pure-bf16 after amp.decorate O2)
+        self.amp_level = amp_level
         self._scaler_state = None
+        self._lr_value = None
+        self._lr_device = None
+        self._buffer_objs = None
         if self.scaler is not None:
             # let scaler.state_dict()/load_state_dict() see the in-graph
             # state (checkpoint correctness)
             self.scaler._bound_step = self
+        # let optimizer.state_dict()/set_state_dict() see / resync the
+        # in-graph step counter (checkpoint correctness)
+        optimizer._bound_train_step = self
 
     # -- hooks for subclasses ---------------------------------------------
     def _grad_transform(self, grads: List[jnp.ndarray]) -> List[jnp.ndarray]:
@@ -101,15 +113,39 @@ class TrainStep:
                       decr_every=scaler._decr_every,
                       dynamic=scaler._dynamic)
 
-        def step_fn(p_arr, b_arr, opt_state, sc_state, lr, step_i, key_data,
-                    inputs, labels):
-            key = jax.random.wrap_key_data(key_data)
-            scale = sc_state["scale"] if scaler is not None else None
+        def step_fn(p_arr, b_arr, opt_state, aux, lr, inputs, labels):
+            # aux carries everything that changes per step but lives on
+            # device: the RNG base key, the effective step counter, and the
+            # loss-scaling state.  Keeping these in-graph means __call__
+            # performs ZERO host->device uploads per step (each tiny
+            # upload costs ~10 ms through a remote-device tunnel and
+            # serialises the pipeline).
+            key = jax.random.wrap_key_data(aux["key"])
+            # 'step' counts only applied updates (non-finite-grad steps
+            # don't advance Adam bias correction — reference GradScaler
+            # semantics where optimizer.step() is skipped); 'draw' advances
+            # every call so RNG draws are never reused after a skip
+            attempt = aux["step"] + 1
+            draw = aux["draw"] + 1
+            step_i = attempt.astype(jnp.float32)
+            key = jax.random.fold_in(key, draw)
+            scale = aux["scale"] if scaler is not None else None
+
+            amp_level = self.amp_level
+
+            def amp_scope():
+                if amp_level is None:
+                    return contextlib.nullcontext()
+                from ..amp import auto_cast
+                return auto_cast(level=amp_level,
+                                 dtype=getattr(model, "_amp_dtype",
+                                               "bfloat16"))
 
             def loss_and_grad(b_cur, mb_inputs, mb_labels, kidx):
                 def loss_of(p_list):
                     k_mb = jax.random.fold_in(key, kidx)
-                    with autograd.no_grad(), rng.seed_scope(k_mb):
+                    with autograd.no_grad(), rng.seed_scope(k_mb), \
+                            amp_scope():
                         with bind(model, p_list, list(b_cur)) as res:
                             out = model(*[Tensor(a) for a in mb_inputs])
                             lab = [Tensor(a) for a in mb_labels]
@@ -162,13 +198,15 @@ class TrainStep:
                 list(p_arr), grads, opt_state, lr, step_i,
                 params_meta=params_meta)
 
+            new_aux = dict(aux)
+            new_aux["draw"] = draw
             if scaler is not None:
                 # skip the update on non-finite grads (reference:
                 # check_finite_and_unscale) ...
                 new_p = _select(found_inf, list(p_arr), new_p)
                 new_s = _select(found_inf, opt_state, new_s)
                 # ... and adjust the scale in-graph (update_loss_scaling)
-                good, bad = sc_state["good"], sc_state["bad"]
+                good, bad = aux["good"], aux["bad"]
                 if sc["dynamic"]:
                     good = jnp.where(found_inf, 0, good + 1)
                     bad = jnp.where(found_inf, bad + 1, 0)
@@ -183,23 +221,43 @@ class TrainStep:
                     good = jnp.where(inc, 0, good)
                 else:
                     new_scale = scale
-                sc_state = {"scale": new_scale, "good": good, "bad": bad,
-                            "found_inf": found_inf}
-            return loss, tuple(new_p), new_b, new_s, sc_state
+                new_aux.update(scale=new_scale, good=good, bad=bad,
+                               found_inf=found_inf,
+                               step=jnp.where(found_inf, aux["step"],
+                                              attempt))
+            else:
+                new_aux["step"] = attempt
+            return loss, tuple(new_p), new_b, new_s, new_aux
 
         return step_fn
 
     def _build(self, training: bool):
-        donate = (0, 1, 2) if self._donate else ()
+        donate = (0, 1, 2, 3) if self._donate else ()
         return jax.jit(self._make_step_fn(), donate_argnums=donate)
 
+    def _aux_keys(self):
+        """Static key set of the aux carry (no side effects — used to
+        build shardings without consuming RNG state)."""
+        keys = ["step", "draw", "key"]
+        if self.scaler is not None:
+            keys += ["scale", "good", "bad", "found_inf"]
+        return keys
+
     def _init_scaler_state(self):
-        if self.scaler is None:
-            return {}
-        return {"scale": jnp.asarray(self.scaler._scale, jnp.float32),
-                "good": jnp.asarray(self.scaler._good_steps, jnp.int32),
-                "bad": jnp.asarray(self.scaler._bad_steps, jnp.int32),
-                "found_inf": jnp.asarray(False)}
+        """Device-resident per-step carry: step/draw counters, RNG base
+        key, and (when a scaler is bound) the dynamic loss-scaling state.
+        The applied-step counter seeds from the optimizer's host count so a
+        set_state_dict before the first step is honored."""
+        aux = {"step": jnp.asarray(self.optimizer._step_count, jnp.int32),
+               "draw": jnp.asarray(0, jnp.int32),
+               "key": jax.random.key_data(rng.next_key())}
+        if self.scaler is not None:
+            aux.update(
+                scale=jnp.asarray(self.scaler._scale, jnp.float32),
+                good=jnp.asarray(self.scaler._good_steps, jnp.int32),
+                bad=jnp.asarray(self.scaler._bad_steps, jnp.int32),
+                found_inf=jnp.asarray(False))
+        return aux
 
     @property
     def loss_scale(self) -> Optional[float]:
@@ -232,18 +290,23 @@ class TrainStep:
             self._compiled[training] = compiled
 
         self.optimizer._step_count += 1
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        step_i = jnp.asarray(self.optimizer._step_count, jnp.float32)
-        key_data = jax.random.key_data(rng.next_key())
+        lr_val = float(self.optimizer.get_lr())
+        if lr_val != self._lr_value:
+            # upload the lr only when the schedule moves it (a tiny
+            # host->device transfer costs ~10 ms over a device tunnel)
+            self._lr_value = lr_val
+            self._lr_device = jnp.asarray(lr_val, jnp.float32)
         loss, new_p, new_b, new_s, new_sc = compiled(
-            p_arr, b_arr, self._opt_state, self._scaler_state, lr, step_i,
-            key_data, inputs, labels)
+            p_arr, b_arr, self._opt_state, self._scaler_state,
+            self._lr_device, inputs, labels)
         # write back (device-side aliasing, no host copies)
         for p, arr in zip(self._params, new_p):
             p.data = arr
-        buffers = dict(self.model.named_buffers())
-        for n, arr in zip(self._bnames, new_b):
-            buffers[n].data = arr
+        if self._buffer_objs is None:
+            buffers = dict(self.model.named_buffers())
+            self._buffer_objs = [buffers[n] for n in self._bnames]
+        for b, arr in zip(self._buffer_objs, new_b):
+            b.data = arr
         self._opt_state = new_s
         self._scaler_state = new_sc
         return Tensor(loss)

@@ -192,8 +192,18 @@ class Optimizer:
         return new_ps, new_ss
 
     # -- state dict --------------------------------------------------------
+    def _effective_step(self):
+        """Applied-update count.  A compiled TrainStep tracks this on
+        device (skipped non-finite steps don't advance it); fall back to
+        the host counter otherwise."""
+        step = getattr(self, "_bound_train_step", None)
+        aux = getattr(step, "_scaler_state", None)
+        if aux and "step" in aux:
+            return int(aux["step"])
+        return self._step_count
+
     def state_dict(self):
-        out = {"step": self._step_count, "slots": {}}
+        out = {"step": self._effective_step(), "slots": {}}
         if self._parameter_list:
             for i, p in enumerate(self._parameter_list):
                 s = self._slots.get(id(p))
@@ -206,6 +216,14 @@ class Optimizer:
 
     def set_state_dict(self, state):
         self._step_count = state.get("step", 0)
+        # resync any compiled TrainStep: preserve its in-graph scaler
+        # values, then drop the aux carry so the next step reinitialises
+        # from the newly loaded counters
+        step = getattr(self, "_bound_train_step", None)
+        if step is not None:
+            if step.scaler is not None:
+                step.scaler._sync_from_bound_step()
+            step._scaler_state = None
         slots = state.get("slots", {})
         if self._parameter_list:
             for i, p in enumerate(self._parameter_list):

@@ -125,15 +125,14 @@ class SpmdTrainStep(TrainStep):
              for k, v in slots.items()}
             for p, slots in zip(self._params, state)]
         scalar = self._ns(PartitionSpec())
-        sc_specs = ({k: scalar for k in self._init_scaler_state()}
-                    if self.scaler is not None else {})
+        aux_specs = {k: scalar for k in self._aux_keys()}
         batch_spec = self._ns(PartitionSpec(DP_AXIS))
         jitted = jax.jit(
             step_fn,
-            in_shardings=(p_specs, b_specs, s_specs, sc_specs, scalar,
-                          scalar, scalar, None, None),
-            out_shardings=(scalar, p_specs, b_specs, s_specs, sc_specs),
-            donate_argnums=(0, 1, 2) if self._donate else (),
+            in_shardings=(p_specs, b_specs, s_specs, aux_specs, scalar,
+                          None, None),
+            out_shardings=(scalar, p_specs, b_specs, s_specs, aux_specs),
+            donate_argnums=(0, 1, 2, 3) if self._donate else (),
         )
         return _ShardBatch(jitted, batch_spec, self.n_inputs)
 
@@ -151,10 +150,9 @@ class _ShardBatch:
     def lower(self, *args):
         return self._jitted.lower(*args)
 
-    def __call__(self, p_arr, b_arr, opt_state, sc_state, lr, step_i,
-                 key_data, inputs, labels):
+    def __call__(self, p_arr, b_arr, opt_state, aux, lr, inputs, labels):
         put = lambda a: jax.device_put(a, self._spec)
         inputs = tuple(put(a) for a in inputs)
         labels = tuple(put(a) for a in labels)
-        return self._jitted(p_arr, b_arr, opt_state, sc_state, lr, step_i,
-                            key_data, inputs, labels)
+        return self._jitted(p_arr, b_arr, opt_state, aux, lr, inputs,
+                            labels)

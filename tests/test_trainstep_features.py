@@ -116,10 +116,8 @@ def test_zero2_parity_and_reduce_scatter():
     compiled = step._compiled[True]
     p_arr = tuple(p.data for p in step._params)
     hlo = compiled.lower(p_arr, tuple(),
-                         step._opt_state, {}, jnp.float32(0.01),
-                         jnp.float32(1), jax.random.key_data(
-                             jax.random.PRNGKey(0)),
-                         (x,), (y,)).compile().as_text()
+                         step._opt_state, step._scaler_state,
+                         jnp.float32(0.01), (x,), (y,)).compile().as_text()
     # TPU lowers the sharded-grad constraint as reduce-scatter; the CPU
     # backend decomposes it to all-reduce + dynamic-slice.  Either way the
     # update must be shard-local with an all-gather of the new params.
