@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import autograd
+from . import autograd, static_hooks
 from .enforce import with_op_hint
 from .flags import get_flag
 
@@ -55,6 +55,25 @@ def apply(fn: Callable, *inputs, op_name: str | None = None,
     from .tensor import Tensor
 
     name = op_name or getattr(fn, "__name__", "op").lstrip("_")
+
+    # static-graph handling.  Replay scope active (inside a compiled
+    # Program / control-flow branch): Variables AND Parameters resolve to
+    # their runtime traced arrays, then the op executes normally.  No
+    # replay + symbolic Variable input: record the op into its Program
+    # (the reference's Block.append_op path, framework.py:4160).
+    replay = static_hooks.current_replay()
+    if replay is not None:
+        from .tensor import Parameter
+        inputs = tuple(
+            Tensor(replay(x))
+            if (getattr(type(x), "_static_var", False)
+                or isinstance(x, Parameter)) else x
+            for x in inputs)
+    elif any(getattr(type(x), "_static_var", False) for x in inputs):
+        prog = next(x for x in inputs
+                    if getattr(type(x), "_static_var", False)).program
+        return prog.record(fn, list(inputs), kw, name)
+
     arrays = [as_array(x) for x in inputs]
 
     # AMP autocast hook — the single cast point shared by eager and traced

@@ -1,0 +1,33 @@
+"""Replay-scope hook shared between core.dispatch and the static package.
+
+Lives in core so the eager op hot path can check it with one function
+call instead of importing the static package.  See
+static/program.py for the design (composite control-flow replay)."""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+_tls = threading.local()
+
+
+class replay_scope:
+    """While active, symbolic Variables (and Parameters, inside a compiled
+    Program) resolve through ``lookup`` at the dispatch point instead of
+    being recorded / read eagerly."""
+
+    def __init__(self, lookup: Callable):
+        self._lookup = lookup
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "replay", None)
+        _tls.replay = self._lookup
+        return self
+
+    def __exit__(self, *exc):
+        _tls.replay = self._prev
+        return False
+
+
+def current_replay() -> Optional[Callable]:
+    return getattr(_tls, "replay", None)

@@ -123,6 +123,15 @@ class Tensor:
         return int(np.asarray(self.data))
 
     def __bool__(self):
+        if isinstance(self.data, jax.core.Tracer):
+            raise TypeError(
+                "[operator < bool > error] Python `if`/`while` tested a "
+                "traced Tensor inside paddle.jit.to_static / a compiled "
+                "step; the branch cannot be resolved at trace time and "
+                "would silently freeze one path into the program. Use "
+                "paddle.cond / paddle.where for branches, "
+                "paddle.while_loop for loops, or mark the function "
+                "non-static.")
         return bool(np.asarray(self.data))
 
     def __index__(self):

@@ -17,6 +17,7 @@ from .reduction import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
 from . import linalg  # noqa: F401
 from .linalg import norm, dist  # noqa: F401
 

@@ -1,0 +1,261 @@
+"""Control-flow ops: cond / case / switch_case / while_loop.
+
+TPU-native re-design of the reference's control-flow operator suite
+(reference: python/paddle/fluid/layers/control_flow.py cond:2326,
+while_loop:1072, case:3075, switch_case:3191; C++ lowering in
+paddle/fluid/operators/controlflow/ conditional_block_op.cc, while_op.cc).
+
+Two execution regimes, matching the framework's dual-mode design:
+
+- **Eager** (concrete predicate): evaluate the predicate on host and run
+  ONLY the chosen branch with normal tape recording — fully differentiable,
+  no wasted compute (the reference's conditional_block runs one block the
+  same way).
+- **Traced** (predicate is a jax tracer, i.e. inside ``paddle.jit.to_static``
+  / ``TrainStep``): lower to ``lax.cond`` / ``lax.switch`` /
+  ``lax.while_loop`` so the compiled program carries real data-dependent
+  control flow.  ``cond``/``case``/``switch_case`` are reverse-mode
+  differentiable; traced ``while_loop`` is forward-only (XLA's While has no
+  reverse-mode adjoint — use a bounded loop or eager mode when you need
+  gradients through a dynamic loop; the reference's while_grad replays the
+  block stack, which XLA cannot express).
+
+Python ``if``/``while`` on a traced Tensor raises a loud error pointing
+here (core/tensor.py ``__bool__``) instead of silently freezing one branch
+into the trace.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["cond", "case", "switch_case", "while_loop"]
+
+
+def _is_static(x) -> bool:
+    return getattr(type(x), "_static_var", False)
+
+
+def _as_arr(x):
+    if _is_static(x):
+        from ..static.program import resolve_variable
+        return resolve_variable(x)
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x) -> bool:
+    return isinstance(_as_arr(x), jax.core.Tracer)
+
+
+def _unwrap(tree):
+    return jax.tree.map(_as_arr, tree,
+                        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap(tree):
+    return jax.tree.map(
+        lambda a: Tensor(a) if isinstance(a, jnp.ndarray) else a, tree)
+
+
+def _traced_branch(fn: Callable) -> Callable:
+    """Wrap a user branch: run paddle ops inside, hand arrays to lax."""
+    def run(*ops):
+        out = fn(*_wrap(list(ops))) if ops else fn()
+        return _unwrap(out)
+    return run
+
+
+def _bool_pred(pred):
+    a = _as_arr(pred)
+    if isinstance(a, jax.core.Tracer):
+        return a
+    return bool(a)
+
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name=None,
+         return_names=None):
+    """Run ``true_fn()`` if ``pred`` else ``false_fn()``.
+
+    Reference: fluid/layers/control_flow.py:2326 (cond),
+    operators/controlflow/conditional_block_op.cc.  Both branches must
+    return the same structure of Tensors.  Differentiable in eager and
+    traced mode (lax.cond has a reverse-mode rule).
+    """
+    true_fn = true_fn if true_fn is not None else (lambda: None)
+    false_fn = false_fn if false_fn is not None else (lambda: None)
+    if _is_static(pred):
+        # record ONE composite node; branches replay at execution with
+        # Variables resolved from the program env (single branch runs —
+        # the reference's conditional_block semantics)
+        def _cond_op(parr):
+            return jax.lax.cond(
+                jnp.asarray(parr).reshape(()).astype(jnp.bool_),
+                _traced_branch(true_fn), _traced_branch(false_fn))
+        return pred.program.record(_cond_op, [pred], {}, "cond")
+    p = _bool_pred(pred)
+    if not isinstance(p, jax.core.Tracer):
+        return true_fn() if p else false_fn()
+    out = jax.lax.cond(p, _traced_branch(true_fn),
+                       _traced_branch(false_fn))
+    return _wrap(out)
+
+
+def case(pred_fn_pairs: Sequence[Tuple[Any, Callable]],
+         default: Optional[Callable] = None, name=None):
+    """First pair whose predicate is True wins (reference:
+    fluid/layers/control_flow.py:3075).  Lowered to nested ``lax.cond`` in
+    traced mode."""
+    if not pred_fn_pairs:
+        raise ValueError("case() expects at least one (pred, fn) pair")
+    preds = [p for p, _ in pred_fn_pairs]
+    if any(_is_static(p) for p in preds):
+        tail0 = default if default is not None else pred_fn_pairs[-1][1]
+        fns = [fn for _, fn in pred_fn_pairs]
+
+        def _case_op(*pred_arrs):
+            def build(i):
+                if i == len(fns):
+                    return _traced_branch(tail0)
+
+                def branch():
+                    return jax.lax.cond(
+                        jnp.asarray(pred_arrs[i]).reshape(()).astype(
+                            jnp.bool_),
+                        _traced_branch(fns[i]), build(i + 1))
+                return branch
+            return build(0)()
+
+        prog = next(p for p in preds if _is_static(p)).program
+        return prog.record(_case_op, list(preds), {}, "case")
+    if not any(_is_traced(p) for p in preds):
+        for p, fn in pred_fn_pairs:
+            if bool(_as_arr(p)):
+                return fn()
+        if default is not None:
+            return default()
+        # reference semantics: no default -> last fn
+        return pred_fn_pairs[-1][1]()
+
+    tail = default if default is not None else pred_fn_pairs[-1][1]
+
+    def build(i):
+        if i == len(pred_fn_pairs):
+            return _traced_branch(tail)
+        p, fn = pred_fn_pairs[i]
+
+        def branch():
+            return jax.lax.cond(jnp.asarray(_as_arr(p), jnp.bool_),
+                                _traced_branch(fn), build(i + 1))
+        return branch
+
+    return _wrap(build(0)())
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name=None):
+    """Dispatch on an integer index (reference:
+    fluid/layers/control_flow.py:3191).  ``branch_fns`` is a list of fns,
+    a list of (int, fn) pairs, or a {int: fn} dict; an out-of-range index
+    runs ``default``.  Lowered to ``lax.switch`` in traced mode."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        branch_fns = list(branch_fns)
+        if branch_fns and not callable(branch_fns[0]):
+            pairs = sorted((int(k), fn) for k, fn in branch_fns)
+        else:
+            pairs = list(enumerate(branch_fns))
+    keys = [k for k, _ in pairs]
+    fns = [fn for _, fn in pairs]
+    if default is None:
+        default = fns[-1]  # reference semantics: fall back to the last fn
+
+    if _is_static(branch_index):
+        def _switch_op(idx_arr):
+            pos = jnp.full((), len(keys), jnp.int32)
+            for slot, k in enumerate(keys):
+                pos = jnp.where(jnp.asarray(idx_arr).reshape(()) == k,
+                                jnp.int32(slot), pos)
+            branches = [_traced_branch(fn) for fn in fns]
+            branches.append(_traced_branch(default))
+            return jax.lax.switch(pos, branches)
+        return branch_index.program.record(_switch_op, [branch_index], {},
+                                           "switch_case")
+
+    idx = _as_arr(branch_index)
+    if not isinstance(idx, jax.core.Tracer):
+        i = int(idx)
+        return dict(pairs).get(i, default)()
+
+    # position of idx among the keys; len(keys) = the default slot
+    pos = jnp.full((), len(keys), jnp.int32)
+    for slot, k in enumerate(keys):
+        pos = jnp.where(idx == k, jnp.int32(slot), pos)
+    branches = [_traced_branch(fn) for fn in fns]
+    branches.append(_traced_branch(default))
+    return _wrap(jax.lax.switch(pos, branches))
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test: bool = False, name=None) -> List:
+    """``while cond(*vars): vars = body(*vars)`` (reference:
+    fluid/layers/control_flow.py:1072, operators/controlflow/while_op.cc).
+
+    Eager: a Python loop with tape recording (differentiable, unrolled).
+    Traced: ``lax.while_loop`` — shapes of loop_vars must be invariant and
+    reverse-mode gradients are unsupported (XLA While has no adjoint).
+    """
+    if not callable(cond) or not callable(body):
+        raise TypeError("while_loop expects callables for cond and body")
+    loop_vars = list(loop_vars)
+
+    def _static_while(prog):
+        def _while_op(*arrs):
+            def c(a):
+                return jnp.asarray(_as_arr(cond(*_wrap(list(a))))
+                                   ).reshape(()).astype(jnp.bool_)
+
+            def b(a):
+                out = body(*_wrap(list(a)))
+                out = (list(out) if isinstance(out, (list, tuple))
+                       else [out])
+                return tuple(_unwrap(out))
+
+            return tuple(jax.lax.while_loop(c, b, tuple(arrs)))
+
+        return list(prog.record(_while_op, loop_vars, {}, "while_loop"))
+
+    for v in loop_vars:
+        if _is_static(v):
+            return _static_while(v.program)
+
+    probe = cond(*loop_vars)
+    if _is_static(probe):
+        # cond closed over a Program Variable (the probe recorded a stray
+        # dead node — harmless): build the loop as a composite node
+        return _static_while(probe.program)
+    if not _is_traced(probe):
+        # eager: genuine Python loop, tape sees every op
+        if not isinstance(probe, bool) and probe is not None:
+            probe = bool(_as_arr(probe))
+        while probe:
+            out = body(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (list, tuple)) else [out]
+            probe = bool(_as_arr(cond(*loop_vars)))
+        return loop_vars
+
+    def c(arrs):
+        return jnp.asarray(_as_arr(cond(*_wrap(arrs))), jnp.bool_)
+
+    def b(arrs):
+        out = body(*_wrap(arrs))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return _unwrap(out)
+
+    res = jax.lax.while_loop(c, b, _unwrap(loop_vars))
+    return list(_wrap(res))

@@ -133,6 +133,13 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        """Static mode: attach this optimizer to the loss's Program
+        (static.Executor compiles backward + update in-graph).  Eager:
+        backward + step (reference: optimizer.py minimize)."""
+        from ..static.program import Variable
+        if isinstance(loss, Variable):
+            loss.program._optimizer = (self, loss, parameters, no_grad_set)
+            return None, None
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in (self._parameter_list or [])]

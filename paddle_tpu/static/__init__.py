@@ -1,0 +1,46 @@
+"""paddle.static — the static-graph facade.
+
+Reference: python/paddle/static/ (Program/Executor/program_guard/data/
+save+load_inference_model).  TPU-native design: a Program is an op list
+recorded through the SAME dispatch point eager mode uses (core/dispatch);
+the Executor interprets it inside one ``jax.jit``, so a whole reference-
+style static training script — data → layers → loss → minimize →
+``exe.run(feed, fetch_list)`` — compiles to a single donated XLA
+computation per feed signature.
+
+Known deviations (documented, by design):
+- random ops (dropout) draw their key at build time — static programs are
+  deterministic per build (reference static dropout has per-run seeds).
+- dygraph Layers with running-stat buffers (BatchNorm) keep their eager
+  buffers constant inside a static program; use static.nn.batch_norm or
+  dygraph mode for running-stat training.
+"""
+from __future__ import annotations
+
+from . import nn  # noqa: F401
+from .executor import Executor, global_scope  # noqa: F401
+from .io import load_inference_model, save_inference_model  # noqa: F401
+from .program import (Program, Variable, data, default_main_program,  # noqa
+                      default_startup_program, program_guard,
+                      reset_default_programs)
+from ..jit.static_function import InputSpec  # noqa: F401
+
+__all__ = [
+    "Program", "Variable", "data", "default_main_program",
+    "default_startup_program", "program_guard", "Executor",
+    "global_scope", "save_inference_model", "load_inference_model",
+    "InputSpec", "nn", "CompiledProgram", "reset_default_programs",
+]
+
+
+class CompiledProgram:
+    """Parity shim (reference: fluid/compiler.py CompiledProgram): the
+    Executor already compiles whole programs; this wrapper exists so
+    reference scripts run unchanged."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)

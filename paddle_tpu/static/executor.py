@@ -1,0 +1,209 @@
+"""Static-graph Executor.
+
+TPU-native re-design of the reference Executor (reference:
+python/paddle/fluid/executor.py Executor:916 run:1391,
+framework/executor.cc:460 op-by-op loop).  Instead of running the op list
+one kernel at a time, the whole Program — forward, backward, and optimizer
+update — is interpreted once under ``jax.jit`` and compiled to a single
+XLA computation per feed signature (the design the reference approaches
+with ParallelExecutor + fuse passes).
+
+Training: ``optimizer.minimize(loss)`` under ``paddle.enable_static()``
+attaches (optimizer, loss) to the Program; ``run`` then computes grads
+with ``jax.grad`` over the program's Parameters and applies the update
+in-graph, writing the new values back into the Parameter objects (the
+scope write-back of the reference's sgd ops into the global Scope).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .program import Program, Variable, default_main_program
+
+__all__ = ["Executor", "global_scope"]
+
+
+class _Scope:
+    """Name → array map shim (reference: framework/scope.h)."""
+
+    def __init__(self):
+        self.vars: Dict[str, object] = {}
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope() -> _Scope:
+    return _global_scope
+
+
+def _interp(nodes, env, pmap):
+    """Run the op list; ``env`` maps Variable name → array, ``pmap`` maps
+    id(Parameter) → array.  Composite control-flow nodes re-run user
+    closures under a replay scope resolving Variables via ``env``."""
+    from ..core import autograd
+    from ..core.tensor import Parameter
+    from .program import replay_scope
+
+    def lookup(v):
+        if isinstance(v, Parameter):
+            return pmap.get(id(v), v.data)
+        return env[v.name]
+
+    with replay_scope(lookup), autograd.no_grad():
+        for node in nodes:
+            args = []
+            for tag, v in node.in_specs:
+                if tag == "v":
+                    args.append(env[v.name])
+                elif tag == "p":
+                    args.append(pmap[id(v)])
+                else:  # const / literal
+                    args.append(v)
+            outs = node.fn(*args, **node.kw)
+            outs = list(outs) if node.multi else [outs]
+            for var, o in zip(node.out_vars, outs):
+                env[var.name] = o
+    return env
+
+
+class Executor:
+    """reference: fluid/executor.py:916.  ``place`` is accepted for parity;
+    XLA owns device placement."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[tuple, object] = {}
+        self._opt_states: Dict[int, list] = {}
+
+    def close(self):
+        self._cache.clear()
+
+    # -- main entry --------------------------------------------------------
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list: Optional[Sequence] = None, return_numpy=True,
+            **unused):
+        # loaded inference programs (load_inference_model) call through
+        if hasattr(program, "_run_loaded"):
+            return program._run_loaded(feed, fetch_list, return_numpy)
+        if program is None:
+            program = default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        if not program.nodes:
+            return []  # startup program: params already initialized eagerly
+
+        fetch_names = []
+        for f in fetch_list:
+            if isinstance(f, Variable):
+                fetch_names.append(f.name)
+            elif isinstance(f, str):
+                fetch_names.append(f)
+            else:
+                raise TypeError(f"fetch_list entry {f!r} is not a Variable")
+
+        params = program.parameters()
+        feed_items = sorted(feed.items())
+        feed_names = tuple(n for n, _ in feed_items)
+        feed_arrays = [jnp.asarray(np.asarray(a)) for _, a in feed_items]
+
+        key = (id(program), program._version, feed_names,
+               tuple((a.shape, str(a.dtype)) for a in feed_arrays),
+               tuple(fetch_names), program._optimizer is not None)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._build(program, params, feed_names, fetch_names)
+            self._cache[key] = compiled
+
+        p_arrays = [p.data for p in params]
+        if program._optimizer is not None:
+            opt = program._optimizer[0]
+            state = self._opt_states.get(id(program))
+            if state is None:
+                state = opt.functional_init(
+                    [p_arrays[i] for i in compiled._t_idx])
+            opt._step_count += 1
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step_i = jnp.asarray(opt._step_count, jnp.float32)
+            fetches, new_p, new_state = compiled(
+                p_arrays, state, lr, step_i, *feed_arrays)
+            self._opt_states[id(program)] = new_state
+            for p, arr in zip(params, new_p):
+                p.data = arr
+        else:
+            fetches = compiled(p_arrays, *feed_arrays)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    # -- compilation -------------------------------------------------------
+    def _build(self, program: Program, params, feed_names, fetch_names):
+        nodes = list(program.nodes)
+        opt_pack = program._optimizer
+
+        def forward_env(p_arrays, feed_arrays):
+            env = {}
+            for name, arr in zip(feed_names, feed_arrays):
+                env[name] = arr
+            pmap = {id(p): a for p, a in zip(params, p_arrays)}
+            return _interp(nodes, env, pmap)
+
+        if opt_pack is None:
+            @jax.jit
+            def run_fn(p_arrays, *feed_arrays):
+                env = forward_env(p_arrays, feed_arrays)
+                return [env[n] for n in fetch_names]
+            return run_fn
+
+        opt, loss_var, param_filter, no_grad_set = (opt_pack + (None,
+                                                                None))[:4]
+        # respect stop_gradient / trainable and minimize's parameters= /
+        # no_grad_set= (reference: append_backward skips no-grad vars)
+        allow = (None if param_filter is None
+                 else {id(p) for p in param_filter})
+        deny = ({id(p) for p in no_grad_set} if no_grad_set else set())
+
+        def trainable(p):
+            return (p.trainable and not p.stop_gradient
+                    and (allow is None or id(p) in allow)
+                    and id(p) not in deny)
+
+        t_idx = [i for i, p in enumerate(params) if trainable(p)]
+        params_meta = [params[i] for i in t_idx]
+
+        @jax.jit
+        def train_fn(p_arrays, opt_state, lr, step_i, *feed_arrays):
+            p_arrays = list(p_arrays)
+
+            def loss_of(tlist):
+                full = list(p_arrays)
+                for j, a in zip(t_idx, tlist):
+                    full[j] = a
+                env = forward_env(full, feed_arrays)
+                return env[loss_var.name], env
+
+            t_arrays = [p_arrays[i] for i in t_idx]
+            (loss, env), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(t_arrays)
+            new_t, new_s = opt.functional_update(
+                t_arrays, grads, opt_state, lr, step_i,
+                params_meta=params_meta)
+            new_p = list(p_arrays)
+            for j, a in zip(t_idx, new_t):
+                new_p[j] = a
+            return [env[n] for n in fetch_names], new_p, new_s
+
+        def compiled(*args):
+            return train_fn(*args)
+
+        compiled._t_idx = t_idx
+        return compiled

@@ -1,0 +1,122 @@
+"""save/load_inference_model for static programs.
+
+Reference: python/paddle/static/io.py save_inference_model:231,
+load_inference_model:434.  TPU-native: the Program is closed over its
+current Parameter values and exported as serialized StableHLO
+(jax.export), the same artifact format as paddle_tpu.jit.save — one
+deployable file family for both dygraph and static sources.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from ..core.tensor import Tensor
+from ..jit.save_load import SUFFIX_MODEL, SUFFIX_PARAMS
+from .executor import _interp
+from .program import Program, Variable
+
+__all__ = ["save_inference_model", "load_inference_model"]
+
+
+def _feed_example(var: Variable, sym_count):
+    shape = var.shape
+    if any(s is None or s < 0 for s in shape):
+        dims = []
+        for s in shape:
+            if s is None or s < 0:
+                sym_count[0] += 1
+                dims.append(f"b{sym_count[0]}")
+            else:
+                dims.append(str(s))
+        sym = jax_export.symbolic_shape(", ".join(dims))
+        return jax.ShapeDtypeStruct(sym, var.data.dtype)
+    return jnp.zeros(tuple(shape), var.data.dtype)
+
+
+def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
+                         fetch_vars: Sequence[Variable], executor=None,
+                         program: Program = None, **kwargs):
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    program = program or feed_vars[0].program
+    fetch_names = [v.name for v in fetch_vars]
+    feed_names = [v.name for v in feed_vars]
+
+    # prune to the backward slice of the fetch targets (reference:
+    # Program._prune_with_input, framework.py:5603) — training-only nodes
+    # (loss, labels) drop out of the inference artifact
+    needed = set(fetch_names)
+    nodes = []
+    for node in reversed(program.nodes):
+        if any(v.name in needed for v in node.out_vars):
+            nodes.append(node)
+            for tag, v in node.in_specs:
+                if tag == "v":
+                    needed.add(v.name)
+    nodes.reverse()
+
+    params = program.parameters()
+    p_arrays = [p.data for p in params]
+
+    def infer_fn(*feed_arrays):
+        env = dict(zip(feed_names, feed_arrays))
+        pmap = {id(p): a for p, a in zip(params, p_arrays)}
+        env = _interp(nodes, env, pmap)
+        return [env[n] for n in fetch_names]
+
+    sym_count = [0]
+    examples = [_feed_example(v, sym_count) for v in feed_vars]
+    exported = jax_export.export(jax.jit(infer_fn))(*examples)
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + SUFFIX_MODEL, "wb") as f:
+        meta = {
+            "format": "paddle_tpu.stablehlo.v1",
+            "source": "static",
+            "feed_names": feed_names,
+            "fetch_names": fetch_names,
+            "in_shapes": [tuple(str(d) for d in e.shape) for e in examples],
+            "in_dtypes": [str(e.dtype) for e in examples],
+        }
+        head = pickle.dumps(meta)
+        f.write(len(head).to_bytes(8, "little"))
+        f.write(head)
+        f.write(exported.serialize())
+
+
+class _LoadedProgram:
+    """Stands in for (inference_program, feed_names, fetch_targets) on the
+    Executor.run path (reference returns a deserialized ProgramDesc)."""
+
+    def __init__(self, exported, meta):
+        self._exported = exported
+        self._meta = meta
+        self.feed_names = meta.get("feed_names", [])
+        self.fetch_names = meta.get("fetch_names", [])
+
+    def _run_loaded(self, feed, fetch_list, return_numpy=True):
+        feed = feed or {}
+        args = [jnp.asarray(np.asarray(feed[n])) for n in self.feed_names]
+        outs = self._exported.call(*args)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Returns [program, feed_target_names, fetch_targets] — run it with
+    ``exe.run(program, feed={...}, fetch_list=program.fetch_names)``."""
+    with open(path_prefix + SUFFIX_MODEL, "rb") as f:
+        n = int.from_bytes(f.read(8), "little")
+        meta = pickle.loads(f.read(n))
+        blob = f.read()
+    exported = jax_export.deserialize(blob)
+    prog = _LoadedProgram(exported, meta)
+    return [prog, prog.feed_names, prog.fetch_names]

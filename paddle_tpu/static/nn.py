@@ -1,0 +1,50 @@
+"""paddle.static.nn — static-graph layer helpers.
+
+Reference: python/paddle/static/nn/common.py (fc:28), control_flow ops
+re-exported from the shared implementation (ops/control_flow.py works in
+both regimes — eager predicates run one branch, symbolic Variables record
+lax.cond/while into the Program via the dispatch point).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtype import get_default_dtype
+from ..core.tensor import Parameter
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..ops.control_flow import case, cond, switch_case, while_loop  # noqa
+
+__all__ = ["fc", "batch_norm", "cond", "case", "switch_case", "while_loop"]
+
+
+def _make_param(shape, is_bias=False, initializer=None):
+    init = initializer or (I.Constant(0.0) if is_bias else I.XavierNormal())
+    return Parameter(init(tuple(shape), get_default_dtype()))
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference: static/nn/common.py fc:28 — creates its own weights."""
+    in_features = int(np.prod(x.shape[num_flatten_dims:]))
+    w = _make_param([in_features, size])
+    b = _make_param([size], is_bias=True)
+    flat = (x.reshape(list(x.shape[:num_flatten_dims]) + [-1])
+            if len(x.shape) > num_flatten_dims + 1 else x)
+    out = F.linear(flat, w, b)
+    if activation is not None:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def batch_norm(input, is_test=False, momentum=0.9, epsilon=1e-5,
+               data_layout="NCHW", **kwargs):
+    """Static BN shim: batch-stat normalization with fresh affine params
+    (running stats are a dygraph-layer feature; use nn.BatchNorm2D in
+    dygraph for the full behavior)."""
+    C = input.shape[1 if data_layout.startswith("NC") else -1]
+    w = _make_param([C], initializer=I.Constant(1.0))
+    b = _make_param([C], is_bias=True)
+    return F.batch_norm(input, None, None, w, b, training=not is_test,
+                        momentum=momentum, epsilon=epsilon,
+                        data_format=data_layout)

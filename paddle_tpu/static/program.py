@@ -1,0 +1,270 @@
+"""Static-graph IR: Program / Variable / op recording.
+
+TPU-native re-design of the reference's ProgramDesc + Block + OpDesc IR
+(reference: paddle/fluid/framework/program_desc.h, block_desc.h,
+python/paddle/fluid/framework.py Program:4722, Variable:1453).
+
+Design: the same single dispatch point used by eager mode
+(core/dispatch.apply) records ops into the current Program whenever an
+input is a symbolic ``Variable``.  A Program is an ordered list of
+``_OpNode`` (pure jnp function + input references + output Variables) —
+the analog of a Block's op list.  ``Executor`` (executor.py) interprets
+the node list inside ONE ``jax.jit``, so a whole static program —
+forward, backward, and optimizer update — compiles to a single XLA
+computation, which is exactly what the reference's graph passes try to
+approximate op-by-op.
+
+Shape semantics: ``data(shape=[None, ...])`` declares dynamic dims; build
+time uses 1 as the abstract placeholder (ops re-execute with the real
+shapes at run time, so only cosmetic metadata depends on it).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Parameter, Tensor
+
+_var_counter = itertools.count(0)
+
+# -- replay scope -----------------------------------------------------------
+# Composite control-flow ops (ops/control_flow.py) record ONE node whose fn
+# re-runs the user's branch/body closures at execution time.  Those closures
+# reference symbolic Variables and Parameters; inside a replay scope the
+# dispatch point resolves each to its runtime (traced) array instead of
+# recording / reading host values.  At record time (shape inference) they
+# resolve to abstract zeros / current values while the Parameters are
+# collected onto the node, so Program.parameters() sees weights used only
+# inside branches.  This is the analog of the reference's
+# conditional_block/while ops executing their sub-Block against the
+# enclosing Scope (operators/controlflow/conditional_block_op.cc:63).
+from ..core.static_hooks import current_replay, replay_scope  # noqa: F401
+
+
+def resolve_variable(v):
+    """Runtime array for a Variable inside a replay scope."""
+    lookup = current_replay()
+    if lookup is None:
+        raise RuntimeError(
+            f"symbolic Variable {v.name} used outside a Program execution")
+    return lookup(v)
+
+
+class Variable(Tensor):
+    """Symbolic tensor inside a Program (reference: framework.py
+    Variable:1453).  ``data`` holds a jax.ShapeDtypeStruct, so all Tensor
+    sugar (operators, .reshape, …) routes through the shared dispatch and
+    gets recorded instead of executed."""
+
+    __slots__ = ("program", "desc_shape")
+    _static_var = True  # checked by core.dispatch.apply
+
+    def __init__(self, aval, program, name=None, desc_shape=None):
+        # bypass Tensor.__init__: aval is not an array
+        self.data = aval
+        self.stop_gradient = True
+        self.name = name or f"var_{next(_var_counter)}"
+        self.persistable = False
+        self._bw_id = 0
+        self._produced = True
+        self._node = None
+        self._grad_data = None
+        self._backward_hooks = []
+        self.trainable = False
+        self.placement = None
+        self.program = program
+        self.desc_shape = list(desc_shape) if desc_shape is not None else None
+
+    @property
+    def shape(self):
+        return (list(self.desc_shape) if self.desc_shape is not None
+                else list(self.data.shape))
+
+    def __bool__(self):
+        raise TypeError(
+            "[operator < bool > error] Python `if`/`while` tested a "
+            "symbolic static.Variable while building a Program; the "
+            "branch cannot be resolved at build time. Use "
+            "paddle.static.nn.cond / paddle.where for branches and "
+            "paddle.static.nn.while_loop for loops.")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self.data.dtype})")
+
+
+class _OpNode:
+    """One recorded op (reference: framework.py Operator / OpDesc)."""
+
+    __slots__ = ("fn", "kw", "op_name", "in_specs", "out_vars",
+                 "multi", "extra_params")
+
+    def __init__(self, fn, kw, op_name, in_specs, out_vars, multi,
+                 extra_params=()):
+        self.fn = fn
+        self.kw = kw
+        self.op_name = op_name
+        self.in_specs = in_specs  # list of ("v", Variable)|("p", Parameter)
+        #                           |("c", jax.Array)|("l", literal)
+        self.out_vars = out_vars
+        self.multi = multi
+        # Parameters referenced only inside composite replay closures
+        # (control-flow branches); resolved via the replay scope at run
+        self.extra_params = list(extra_params)
+
+
+class Program:
+    """An ordered op list + feed/fetch metadata (reference: Program:4722).
+
+    Built implicitly by running layer code on ``static.data`` Variables
+    under ``paddle.enable_static()``; executed by ``static.Executor``."""
+
+    def __init__(self):
+        self.nodes: List[_OpNode] = []
+        self.feed_vars: Dict[str, Variable] = {}
+        self._optimizer = None       # (optimizer, loss Variable)
+        self.random_seed = 0
+        self._version = 0
+
+    # -- recording (called from core.dispatch.apply) ----------------------
+    def _aval_of(self, x):
+        if isinstance(x, Variable):
+            return x.data
+        if isinstance(x, Tensor):
+            return jax.ShapeDtypeStruct(x.shape_tuple,
+                                        np.dtype(x.data.dtype))
+        return x
+
+    def record(self, fn: Callable, inputs: Sequence, kw: dict,
+               op_name: str):
+        seen_params: List[Parameter] = []
+
+        def _abstract_lookup(v):
+            if isinstance(v, Parameter):
+                if not any(v is p for p in seen_params):
+                    seen_params.append(v)
+                return v.data
+            return jnp.zeros(v.data.shape, v.data.dtype)
+
+        with replay_scope(_abstract_lookup):
+            out_avals = jax.eval_shape(lambda *a: fn(*a, **kw),
+                                       *[self._aval_of(x) for x in inputs])
+        in_specs = []
+        for x in inputs:
+            if isinstance(x, Variable):
+                in_specs.append(("v", x))
+            elif isinstance(x, Parameter):
+                in_specs.append(("p", x))
+            elif isinstance(x, Tensor):
+                in_specs.append(("c", x.data))
+            else:
+                in_specs.append(("l", x))
+        multi = isinstance(out_avals, (tuple, list))
+        avals = list(out_avals) if multi else [out_avals]
+        out_vars = [Variable(a, self) for a in avals]
+        self.nodes.append(_OpNode(fn, kw, op_name, in_specs, out_vars,
+                                  multi, extra_params=seen_params))
+        self._version += 1
+        if multi:
+            return tuple(out_vars)
+        return out_vars[0]
+
+    # -- introspection -----------------------------------------------------
+    def parameters(self) -> List[Parameter]:
+        """Parameters referenced by the program (including ones used only
+        inside control-flow branch closures), in first-use order."""
+        seen, out = set(), []
+
+        def add(p):
+            if id(p) not in seen:
+                seen.add(id(p))
+                out.append(p)
+
+        for node in self.nodes:
+            for tag, v in node.in_specs:
+                if tag == "p":
+                    add(v)
+            for p in node.extra_params:
+                add(p)
+        return out
+
+    def global_block(self):
+        return self
+
+    # Block-protocol shims (reference Block API surface)
+    @property
+    def ops(self):
+        return self.nodes
+
+    def all_parameters(self):
+        return self.parameters()
+
+    def __repr__(self):
+        lines = [f"Program({len(self.nodes)} ops)"]
+        for n in self.nodes[:20]:
+            ins = ", ".join(
+                (v.name if tag == "v" else
+                 getattr(v, "name", tag)) for tag, v in n.in_specs)
+            outs = ", ".join(v.name for v in n.out_vars)
+            lines.append(f"  {n.op_name}({ins}) -> {outs}")
+        if len(self.nodes) > 20:
+            lines.append(f"  ... {len(self.nodes) - 20} more")
+        return "\n".join(lines)
+
+
+# -- default programs + guard (reference: framework.py
+#    default_main_program:6660, program_guard:7006) -------------------------
+
+_default_main = Program()
+_default_startup = Program()
+_guard_stack: List[Tuple[Program, Program]] = []
+
+
+def default_main_program() -> Program:
+    if _guard_stack:
+        return _guard_stack[-1][0]
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    if _guard_stack:
+        return _guard_stack[-1][1]
+    return _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self._pair = (main_program, startup_program or Program())
+
+    def __enter__(self):
+        _guard_stack.append(self._pair)
+        return self._pair[0]
+
+    def __exit__(self, *exc):
+        _guard_stack.pop()
+        return False
+
+
+def reset_default_programs():
+    global _default_main, _default_startup
+    _default_main = Program()
+    _default_startup = Program()
+
+
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32",
+         lod_level=0) -> Variable:
+    """Declare a feed placeholder (reference: static/input.py data:26)."""
+    dt = np.dtype(convert_dtype(dtype))
+    concrete = tuple(1 if (s is None or s < 0) else int(s) for s in shape)
+    prog = default_main_program()
+    v = Variable(jax.ShapeDtypeStruct(concrete, dt), prog, name=name,
+                 desc_shape=[-1 if (s is None or s < 0) else int(s)
+                             for s in shape])
+    prog.feed_vars[name] = v
+    return v

@@ -1,0 +1,226 @@
+"""Control-flow API tests (reference suite analog:
+test_cond.py / test_while_loop.py / test_case.py / test_switch_case.py in
+the reference's unittests): eager and traced execution must agree, traced
+programs must carry real data-dependent control flow, and Python `if` on a
+traced Tensor must fail loudly."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+
+
+# ---------------------------------------------------------------------------
+# cond
+# ---------------------------------------------------------------------------
+
+def test_cond_eager_runs_single_branch():
+    ran = []
+
+    def t():
+        ran.append("t")
+        return paddle.ones([2])
+
+    def f():
+        ran.append("f")
+        return paddle.zeros([2])
+
+    out = paddle.cond(paddle.to_tensor(True), t, f)
+    assert ran == ["t"]
+    np.testing.assert_array_equal(out.numpy(), [1.0, 1.0])
+
+
+def test_cond_eager_grad_through_chosen_branch():
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    out = paddle.cond(x.sum() > 4.0, lambda: (x * x).sum(),
+                      lambda: x.sum())
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_cond_traced_switches_at_runtime():
+    @jit.to_static
+    def fn(x):
+        return paddle.cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(fn(a).numpy(), [2.0, 4.0])
+    # same compiled program, other branch taken
+    np.testing.assert_allclose(fn(b).numpy(), [-2.0, -3.0])
+
+
+def test_cond_traced_grad_parity():
+    def raw(x):
+        return paddle.cond(x.sum() > 0, lambda: (x * x).sum(),
+                           lambda: (2 * x).sum())
+
+    fn = jit.to_static(raw)
+    for vals in ([1.0, 2.0], [-1.0, -2.0]):
+        x1 = paddle.to_tensor(np.array(vals, np.float32),
+                              stop_gradient=False)
+        fn(x1).backward()
+        gs = x1.grad.numpy().copy()
+        x2 = paddle.to_tensor(np.array(vals, np.float32),
+                              stop_gradient=False)
+        raw(x2).backward()
+        np.testing.assert_allclose(gs, x2.grad.numpy(), rtol=1e-5)
+
+
+def test_python_if_on_traced_tensor_raises_loudly():
+    @jit.to_static
+    def fn(x):
+        if x.sum() > 0:  # trace-time unresolvable
+            return x * 2
+        return x
+
+    with pytest.raises(TypeError, match="paddle.cond"):
+        fn(paddle.ones([2]))
+
+
+# ---------------------------------------------------------------------------
+# case / switch_case
+# ---------------------------------------------------------------------------
+
+def test_case_eager_first_true_wins():
+    out = paddle.case(
+        [(paddle.to_tensor(False), lambda: paddle.full([1], 1.0)),
+         (paddle.to_tensor(True), lambda: paddle.full([1], 2.0)),
+         (paddle.to_tensor(True), lambda: paddle.full([1], 3.0))],
+        default=lambda: paddle.full([1], 9.0))
+    assert float(out) == 2.0
+
+
+def test_case_eager_default():
+    out = paddle.case(
+        [(paddle.to_tensor(False), lambda: paddle.full([1], 1.0))],
+        default=lambda: paddle.full([1], 9.0))
+    assert float(out) == 9.0
+
+
+def test_case_traced():
+    @jit.to_static
+    def fn(x):
+        s = x.sum()
+        return paddle.case(
+            [(s < 0, lambda: x - 10), (s < 10, lambda: x * 2)],
+            default=lambda: x + 100)
+
+    lo = paddle.to_tensor(np.array([-5.0], np.float32))
+    mid = paddle.to_tensor(np.array([3.0], np.float32))
+    hi = paddle.to_tensor(np.array([50.0], np.float32))
+    assert float(fn(lo)) == -15.0
+    assert float(fn(mid)) == 6.0
+    assert float(fn(hi)) == 150.0
+
+
+def test_switch_case_eager_and_traced():
+    fns = {1: lambda: paddle.full([1], 10.0),
+           3: lambda: paddle.full([1], 30.0)}
+
+    assert float(paddle.switch_case(paddle.to_tensor(3), fns,
+                                    default=lambda: paddle.full([1], -1.0))
+                 ) == 30.0
+    assert float(paddle.switch_case(paddle.to_tensor(7), fns,
+                                    default=lambda: paddle.full([1], -1.0))
+                 ) == -1.0
+
+    @jit.to_static
+    def fn(i):
+        return paddle.switch_case(
+            i, {1: lambda: paddle.full([1], 10.0),
+                3: lambda: paddle.full([1], 30.0)},
+            default=lambda: paddle.full([1], -1.0))
+
+    assert float(fn(paddle.to_tensor(1))) == 10.0
+    assert float(fn(paddle.to_tensor(3))) == 30.0
+    assert float(fn(paddle.to_tensor(2))) == -1.0
+
+
+# ---------------------------------------------------------------------------
+# while_loop
+# ---------------------------------------------------------------------------
+
+def test_while_loop_eager_differentiable():
+    x = paddle.to_tensor(np.array(2.0, np.float32), stop_gradient=False)
+    i = paddle.to_tensor(np.array(0, np.int32))
+
+    def cond(i, acc):
+        return int(i) < 3
+
+    def body(i, acc):
+        return i + 1, acc * x
+
+    _, acc = paddle.while_loop(cond, body,
+                               [i, paddle.ones([], dtype="float32")])
+    assert float(acc) == 8.0  # x^3
+    acc.backward()
+    np.testing.assert_allclose(float(x.grad), 12.0)  # 3x^2
+
+
+def test_while_loop_traced_parity():
+    @jit.to_static
+    def pow_n(x, n):
+        def cond(i, acc):
+            return i < n
+
+        def body(i, acc):
+            return i + 1, acc * x
+
+        _, acc = paddle.while_loop(
+            cond, body, [paddle.zeros([], dtype="int32"),
+                         paddle.ones([], dtype="float32")])
+        return acc
+
+    x = paddle.to_tensor(np.array(3.0, np.float32))
+    assert float(pow_n(x, paddle.to_tensor(np.int32(2)))) == 9.0
+    assert float(pow_n(x, paddle.to_tensor(np.int32(4)))) == 81.0
+
+
+def test_while_loop_dynamic_decode():
+    """Greedy decode with data-dependent early exit (the reference's
+    dynamic_decode / beam-search use case, rnn/dynamic_decode): under
+    to_static the loop must run a runtime-dependent number of steps."""
+    EOS, MAXLEN = 0, 8
+
+    @jit.to_static
+    def decode(logits_seed):
+        # toy "decoder": next token = (prev * 3 + seed) % 5; stop at EOS
+        def cond(t, tok, out):
+            return paddle.logical_and(t < MAXLEN,
+                                      paddle.logical_not(tok == EOS))
+
+        def body(t, tok, out):
+            nxt = paddle.mod(tok * 3 + logits_seed, paddle.full(
+                [], 5, dtype="int64"))
+            out = paddle.scatter(
+                out, t.reshape([1]), nxt.reshape([1, 1]).astype("float32"))
+            return t + 1, nxt, out
+
+        t0 = paddle.zeros([], dtype="int64")
+        tok0 = paddle.full([], 3, dtype="int64")
+        buf = paddle.full([MAXLEN, 1], -1.0)
+        t, tok, out = paddle.while_loop(cond, body, [t0, tok0, buf])
+        return t, out
+
+    t, out = decode(paddle.full([], 1, dtype="int64"))
+    # 3 -> (3*3+1)%5=0 == EOS: one step
+    assert int(t) == 1
+    t2, _ = decode(paddle.full([], 2, dtype="int64"))
+    # 3 -> 1 -> 0: two steps
+    assert int(t2) == 2
+
+
+def test_while_loop_tensor_shapes_preserved():
+    def cond(i, v):
+        return i < 4
+
+    def body(i, v):
+        return i + 1, v + 1.0
+
+    i, v = paddle.while_loop(cond, body,
+                             [paddle.zeros([], dtype="int32"),
+                              paddle.zeros([3, 2])])
+    assert v.shape == [3, 2]
+    np.testing.assert_allclose(v.numpy(), np.full((3, 2), 4.0))

@@ -1,0 +1,226 @@
+"""paddle.static facade tests (reference analog: test_executor_*.py,
+test_program.py, test_inference_model_io.py): a reference-style static
+script must build a Program through the shared dispatch point, train via
+Executor.run, and round-trip through save/load_inference_model."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, optimizer
+from paddle_tpu.vision.models import LeNet
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+    paddle.static.reset_default_programs()
+
+
+def test_program_records_ops():
+    with paddle.static.program_guard(paddle.static.Program()) as main:
+        x = paddle.static.data("x", [None, 4], "float32")
+        y = (x * 2.0 + 1.0).sum()
+    assert len(main.nodes) >= 2
+    assert isinstance(y, paddle.static.Variable)
+    assert "x" in main.feed_vars
+
+
+def test_executor_forward_fetch():
+    with paddle.static.program_guard(paddle.static.Program()) as main:
+        x = paddle.static.data("x", [None, 3], "float32")
+        out = F.relu(x) * 3.0
+    exe = paddle.static.Executor()
+    arr = np.array([[-1.0, 0.5, 2.0]], np.float32)
+    res, = exe.run(main, feed={"x": arr}, fetch_list=[out])
+    np.testing.assert_allclose(res, np.maximum(arr, 0) * 3.0)
+
+
+def test_executor_dynamic_batch():
+    """None dims: the same Program serves any batch size (recompiles per
+    shape, like the reference's feed shape handling)."""
+    with paddle.static.program_guard(paddle.static.Program()) as main:
+        x = paddle.static.data("x", [None, 2], "float32")
+        out = x.sum(axis=1)
+    exe = paddle.static.Executor()
+    for bs in (1, 5):
+        arr = np.ones((bs, 2), np.float32)
+        res, = exe.run(main, feed={"x": arr}, fetch_list=[out])
+        assert res.shape == (bs,)
+
+
+def test_static_lenet_trains():
+    """VERDICT round-2 'done' criterion: a LeNet trains through the static
+    API verbatim from a reference-style script."""
+    paddle.seed(0)
+    main, startup = paddle.static.Program(), paddle.static.Program()
+    with paddle.static.program_guard(main, startup):
+        x = paddle.static.data("x", [None, 1, 28, 28], "float32")
+        y = paddle.static.data("y", [None], "int64")
+        model = LeNet()
+        out = model(x)
+        loss = F.cross_entropy(out, y)
+        opt = optimizer.Adam(learning_rate=1e-3)
+        opt.minimize(loss)
+
+    exe = paddle.static.Executor(paddle.CPUPlace)
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    xs = rng.standard_normal((16, 1, 28, 28)).astype(np.float32)
+    ys = rng.randint(0, 10, (16,)).astype(np.int64)
+    losses = []
+    for _ in range(30):
+        lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_static_fc_and_minimize_sgd():
+    paddle.seed(1)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        pred = paddle.static.nn.fc(x, 1)
+        loss = F.mse_loss(pred, y)
+        optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = paddle.static.Executor()
+    rng = np.random.RandomState(1)
+    w = rng.standard_normal((8, 1)).astype(np.float32)
+    xs = rng.standard_normal((64, 8)).astype(np.float32)
+    ys = xs @ w
+    first = last = None
+    for _ in range(60):
+        lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        first = first if first is not None else float(lv)
+        last = float(lv)
+    assert last < first * 0.1, (first, last)
+
+
+def test_static_cond_records():
+    with paddle.static.program_guard(paddle.static.Program()) as main:
+        x = paddle.static.data("x", [2], "float32")
+        out = paddle.static.nn.cond(x.sum() > 0, lambda: x * 2,
+                                    lambda: x - 1)
+    exe = paddle.static.Executor()
+    res, = exe.run(main, feed={"x": np.array([1.0, 2.0], np.float32)},
+                   fetch_list=[out])
+    np.testing.assert_allclose(res, [2.0, 4.0])
+    res, = exe.run(main, feed={"x": np.array([-1.0, -2.0], np.float32)},
+                   fetch_list=[out])
+    np.testing.assert_allclose(res, [-2.0, -3.0])
+
+
+def test_static_param_inside_cond_branch_trains():
+    """Params referenced only inside a control-flow branch must be seen by
+    the Program and updated by minimize."""
+    paddle.seed(4)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 4], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        lin = nn.Linear(4, 1)
+        pred = paddle.static.nn.cond(x.sum() > -1e9, lambda: lin(x),
+                                     lambda: x.sum(axis=1, keepdim=True))
+        loss = F.mse_loss(pred, y)
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    assert lin.weight in main.parameters()
+    exe = paddle.static.Executor()
+    rng = np.random.RandomState(4)
+    xs = rng.standard_normal((32, 4)).astype(np.float32)
+    ys = xs @ rng.standard_normal((4, 1)).astype(np.float32)
+    l0 = float(exe.run(main, feed={"x": xs, "y": ys},
+                       fetch_list=[loss])[0])
+    for _ in range(40):
+        lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    assert float(lv) < l0 * 0.2, (l0, float(lv))
+
+
+def test_static_stop_gradient_respected():
+    paddle.seed(5)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 4], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        lin = nn.Linear(4, 1)
+        lin.weight.stop_gradient = True
+        lin.weight.trainable = False
+        loss = F.mse_loss(lin(x), y)
+        optimizer.SGD(learning_rate=0.5).minimize(loss)
+    frozen = lin.weight.numpy().copy()
+    exe = paddle.static.Executor()
+    rng = np.random.RandomState(5)
+    xs = rng.standard_normal((8, 4)).astype(np.float32)
+    ys = rng.standard_normal((8, 1)).astype(np.float32)
+    exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    np.testing.assert_array_equal(lin.weight.numpy(), frozen)
+    assert not np.array_equal(lin.bias.numpy(), np.zeros(1))  # bias trained
+
+
+def test_static_eval_then_minimize_recompiles():
+    paddle.seed(6)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 2], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        lin = nn.Linear(2, 1)
+        loss = F.mse_loss(lin(x), y)
+    exe = paddle.static.Executor()
+    xs = np.ones((4, 2), np.float32)
+    ys = np.zeros((4, 1), np.float32)
+    l_eval, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    with paddle.static.program_guard(main):
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    l_train, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    np.testing.assert_allclose(l_train, l_eval, rtol=1e-6)
+    l2, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    assert float(l2) < float(l_train)
+
+
+def test_python_if_on_variable_raises_loudly():
+    with paddle.static.program_guard(paddle.static.Program()):
+        x = paddle.static.data("x", [2], "float32")
+        with pytest.raises(TypeError, match="cond"):
+            if x.sum() > 0:
+                pass
+
+
+def test_static_while_loop_records():
+    with paddle.static.program_guard(paddle.static.Program()) as main:
+        x = paddle.static.data("x", [1], "float32")
+        n = paddle.static.data("n", [], "int32")
+        i, acc = paddle.static.nn.while_loop(
+            lambda i, acc: i < n,
+            lambda i, acc: (i + 1, acc * x),
+            [paddle.zeros([], dtype="int32"), paddle.ones([1])])
+    exe = paddle.static.Executor()
+    res, = exe.run(main, feed={"x": np.array([3.0], np.float32),
+                               "n": np.int32(3)}, fetch_list=[acc])
+    np.testing.assert_allclose(res, [27.0])
+
+
+def test_save_load_inference_model(tmp_path):
+    paddle.seed(2)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 4], "float32")
+        pred = paddle.static.nn.fc(x, 2, activation="relu")
+    exe = paddle.static.Executor()
+    arr = np.random.RandomState(3).standard_normal((5, 4)).astype(np.float32)
+    want, = exe.run(main, feed={"x": arr}, fetch_list=[pred])
+
+    prefix = os.path.join(str(tmp_path), "m")
+    paddle.static.save_inference_model(prefix, [x], [pred], exe)
+    prog, feed_names, fetch_names = paddle.static.load_inference_model(
+        prefix, exe)
+    assert feed_names == ["x"]
+    got, = exe.run(prog, feed={"x": arr}, fetch_list=fetch_names)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # shape polymorphism: another batch size without re-export
+    got2, = exe.run(prog, feed={"x": arr[:2]}, fetch_list=fetch_names)
+    np.testing.assert_allclose(got2, want[:2], rtol=1e-5)
