@@ -235,6 +235,9 @@ def bench_lenet_dygraph(args):
     doesn't fight the TPU client in this process."""
     code = (
         "import sys, time, json; sys.path.insert(0, %r)\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')  # env var alone is "
+        "read too late when a sitecustomize pre-imports jax\n"
         "import numpy as np\n"
         "import paddle_tpu as paddle\n"
         "import paddle_tpu.nn.functional as F\n"
@@ -256,8 +259,23 @@ def bench_lenet_dygraph(args):
         "t0 = time.perf_counter(); n = 30\n"
         "for _ in range(n): last = one_step()\n"
         "dt = time.perf_counter() - t0\n"
+        "import tempfile, os as _os\n"
+        "from paddle_tpu import inference, jit\n"
+        "from paddle_tpu.jit import InputSpec\n"
+        "pfx = _os.path.join(tempfile.mkdtemp(), 'm')\n"
+        "jit.save(model, pfx, input_spec=[InputSpec([None,1,28,28],"
+        " 'float32')])\n"
+        "pred = inference.create_predictor(inference.Config(pfx))\n"
+        "xi = np.zeros((1, 1, 28, 28), 'float32')\n"
+        "pred.run([xi])\n"
+        "t0 = time.perf_counter()\n"
+        "for _ in range(50): outs = pred.run([xi])\n"
+        "float(np.asarray(outs[0]).sum())\n"
+        "infer_ms = (time.perf_counter() - t0) / 50 * 1000\n"
         "print(json.dumps({'step_time_ms': round(1000 * dt / n, 3),"
-        " 'steps_per_sec': round(n / dt, 2), 'final_loss': round(last, 4)}))\n"
+        " 'steps_per_sec': round(n / dt, 2), 'final_loss': round(last, 4),"
+        " 'predictor_latency_ms_bs1': round(infer_ms, 3),"
+        " 'predictor_recompiles': pred.num_compiled_variants()}))\n"
         % os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:

@@ -43,13 +43,57 @@ def _check_nan_inf(op_name, arrays):
                     f"NaN or Inf found in output of operator < {op_name} >")
 
 
+_VJP_CACHE: dict = {}
+_VJP_CACHE_CAP = 4096
+
+
+def _cached_rules(fn, kw, diff_idx, arrays):
+    """Compiled fwd + bwd for a stable op function (the eager fast path —
+    reference analog: the tracer's cached OpKernel lookup,
+    pybind/op_function_generator.cc:492).  Keyed by (fn, kw, shapes):
+    re-tracing ``jax.vjp`` per eager call costs ~3 ms/op in Python; the
+    cached pjit fast path is ~10 us.  The backward recomputes the forward
+    inside its own cached jit (XLA DCEs what the cotangent doesn't need).
+    Returns None when kw isn't hashable."""
+    del arrays  # avals are jit's cache dimension, not ours
+    try:
+        # shapes/dtypes are NOT part of the key: jax.jit already caches
+        # per-aval under each entry, so one entry per (op, kw) suffices
+        key = (id(fn), tuple(diff_idx), tuple(sorted(kw.items())))
+        hash(key)
+    except TypeError:
+        return None
+    entry = _VJP_CACHE.get(key)
+    if entry is None:
+        if len(_VJP_CACHE) >= _VJP_CACHE_CAP:
+            _VJP_CACHE.clear()  # simple flush; steady-state never hits this
+
+        fwd = jax.jit(lambda *a: fn(*a, **kw))
+
+        def bwd_impl(all_args, cts):
+            def f_diff(*diff_args):
+                full = list(all_args)
+                for j, a in zip(diff_idx, diff_args):
+                    full[j] = a
+                return fn(*full, **kw)
+            _, pull = jax.vjp(f_diff, *(all_args[i] for i in diff_idx))
+            return pull(cts)
+
+        entry = (fwd, jax.jit(bwd_impl))
+        _VJP_CACHE[key] = entry
+    return entry
+
+
 def apply(fn: Callable, *inputs, op_name: str | None = None,
-          nondiff: bool = False, **kw):
+          nondiff: bool = False, cacheable: bool = False, **kw):
     """Run a pure op function over Tensor/array inputs.
 
     - Eager + grad needed: runs through ``jax.vjp`` and records a tape Node.
-    - Otherwise: plain call (also the path taken under jit tracing, where the
-      surrounding ``jax.grad`` owns differentiation).
+    - ``cacheable=True`` (opt-in for ops whose ``fn`` is a stable,
+      module-level object): fwd and bwd run through compiled-rule caches,
+      skipping per-call retracing on the eager hot path.
+    - Otherwise: plain call (also the path taken under jit tracing, where
+      the surrounding ``jax.grad`` owns differentiation).
     Returns Tensor or tuple of Tensors mirroring ``fn``'s output structure.
     """
     from .tensor import Tensor
@@ -91,13 +135,22 @@ def apply(fn: Callable, *inputs, op_name: str | None = None,
 
     try:
         if diff_idx:
-            def f(*diff_args):
-                full = list(arrays)
-                for j, a in zip(diff_idx, diff_args):
-                    full[j] = a
-                return fn(*full, **kw)
+            rules = (_cached_rules(fn, kw, diff_idx, arrays)
+                     if cacheable and not isinstance(
+                         arrays[diff_idx[0]], jax.core.Tracer) else None)
+            if rules is not None:
+                fwd, bwd = rules
+                outs = fwd(*arrays)
+                all_args = tuple(arrays)
+                vjp_fn = lambda cts: bwd(all_args, cts)  # noqa: E731
+            else:
+                def f(*diff_args):
+                    full = list(arrays)
+                    for j, a in zip(diff_idx, diff_args):
+                        full[j] = a
+                    return fn(*full, **kw)
 
-            outs, vjp_fn = jax.vjp(f, *(arrays[i] for i in diff_idx))
+                outs, vjp_fn = jax.vjp(f, *(arrays[i] for i in diff_idx))
         else:
             outs = fn(*arrays, **kw)
     except Exception as e:  # attach op attribution like AppendErrorOpHint

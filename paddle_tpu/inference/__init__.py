@@ -1,0 +1,242 @@
+"""paddle.inference — the deployment/serving API.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.h:82 (ctor, Run
+:120, ZeroCopyTensor handles :143-151) and paddle_infer::Config
+(analysis_config.h).  TPU-native design: the artifact is the serialized
+StableHLO written by ``paddle.jit.save`` / ``paddle.static.
+save_inference_model`` (one deployable format for both sources); the
+Predictor deserializes it once, AOT-compiles at load for the declared
+input shapes, and serves each shape bucket from a compile cache with
+donated input buffers — zero recompiles and zero host copies on the hot
+path (the analog of the reference's ZeroCopyTensor path).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..jit.save_load import SUFFIX_MODEL, SUFFIX_PARAMS
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor"]
+
+
+class Config:
+    """reference: inference/api/paddle_analysis_config.h.
+
+    ``Config(prog_file)`` or ``Config(prog_file, params_file)`` — pass the
+    path prefix used at save time (the ``.pdmodel`` suffix is appended if
+    missing).  GPU/IR-pass toggles are accepted for parity; XLA owns
+    optimization on TPU."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and prog_file.endswith(SUFFIX_MODEL):
+            prog_file = prog_file[: -len(SUFFIX_MODEL)]
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._shape_buckets: List[Tuple[Tuple[int, ...], ...]] = []
+        self._aot_on_load = True
+        # parity no-ops (XLA owns these decisions on TPU)
+        self._flags: Dict[str, object] = {}
+
+    def set_prog_file(self, path: str):
+        self.prog_file = path
+
+    def model_dir(self):
+        return self.prog_file
+
+    def add_shape_bucket(self, *input_shapes: Sequence[int]):
+        """Declare an input-shape combination to AOT-compile at load time
+        (the reference's tuned TensorRT shape ranges, analysis_config.h
+        EnableTunedTensorRtDynamicShape)."""
+        self._shape_buckets.append(tuple(tuple(s) for s in input_shapes))
+
+    def disable_aot_compile(self):
+        self._aot_on_load = False
+
+    # -- accepted-for-parity switches -------------------------------------
+    def enable_use_gpu(self, *a, **k):
+        self._flags["use_gpu"] = True
+
+    def disable_gpu(self):
+        self._flags["use_gpu"] = False
+
+    def enable_memory_optim(self, *a, **k):
+        self._flags["memory_optim"] = True
+
+    def switch_ir_optim(self, x=True):
+        self._flags["ir_optim"] = x
+
+    def enable_mkldnn(self, *a, **k):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._flags["cpu_threads"] = n
+
+    def summary(self) -> str:
+        return (f"Config(prog_file={self.prog_file}, "
+                f"buckets={len(self._shape_buckets)}, flags={self._flags})")
+
+
+class Tensor:
+    """IO handle (reference: ZeroCopyTensor, analysis_predictor.h:143-151).
+    ``copy_from_cpu`` stages the next input; ``copy_to_cpu`` fetches an
+    output."""
+
+    def __init__(self, name: str, predictor: "Predictor", is_input: bool):
+        self.name = name
+        self._p = predictor
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        assert self._is_input, f"{self.name} is an output handle"
+        self._p._inputs[self.name] = np.asarray(arr)
+        self._p._external.discard(self.name)
+
+    def share_external_data(self, arr):
+        # zero-copy: caller keeps ownership, so this input is NOT donated
+        self._p._inputs[self.name] = arr
+        self._p._external.add(self.name)
+
+    def reshape(self, shape):
+        pass  # shape follows the staged array
+
+    def copy_to_cpu(self):
+        assert not self._is_input, f"{self.name} is an input handle"
+        out = self._p._outputs[self.name]
+        return np.asarray(out)
+
+    def shape(self):
+        src = (self._p._inputs if self._is_input else self._p._outputs)
+        a = src.get(self.name)
+        return list(a.shape) if a is not None else None
+
+
+class Predictor:
+    """reference: inference/api/analysis_predictor.h:82."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        if config.params_file:
+            # weights are baked into the StableHLO artifact at save time;
+            # a swapped .pdiparams cannot be injected — fail loudly rather
+            # than silently serving stale weights
+            import os
+            sibling = config.prog_file + SUFFIX_PARAMS
+            same = os.path.abspath(config.params_file) == os.path.abspath(
+                sibling)
+            if not same and os.path.exists(sibling):
+                with open(config.params_file, "rb") as a, \
+                        open(sibling, "rb") as b:
+                    same = a.read() == b.read()
+            if not same:
+                raise ValueError(
+                    "params_file differs from the weights captured in "
+                    f"{config.prog_file}{SUFFIX_MODEL}; re-run jit.save/"
+                    "save_inference_model with the new weights (the "
+                    "artifact bakes them at export)")
+        with open(config.prog_file + SUFFIX_MODEL, "rb") as f:
+            n = int.from_bytes(f.read(8), "little")
+            self._meta = pickle.loads(f.read(n))
+            self._exported = jax.export.deserialize(f.read())
+        m = self._meta
+        self._input_names = list(
+            m.get("feed_names")
+            or [f"x{i}" for i in range(len(m["in_shapes"]))])
+        self._output_names: Optional[List[str]] = (
+            list(m["fetch_names"]) if m.get("fetch_names") else None)
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._external: set = set()
+        self._outputs: Dict[str, jnp.ndarray] = {}
+        self._compiled: Dict[tuple, object] = {}
+        self._compile_count = 0
+        if config._aot_on_load:
+            self._aot_compile()
+
+    # -- compile management ------------------------------------------------
+    def _lowered(self, shapes_dtypes, no_donate=frozenset()):
+        key = (tuple(shapes_dtypes), frozenset(no_donate))
+        fn = self._compiled.get(key)
+        if fn is None:
+            self._compile_count += 1
+            call = self._exported.call
+            # donate predictor-staged inputs on TPU (single-use per call);
+            # share_external_data buffers stay caller-owned (CPU backend
+            # can't alias either way and would only warn)
+            donate = (tuple(i for i, n in enumerate(self._input_names)
+                            if n not in no_donate)
+                      if jax.default_backend() == "tpu" else ())
+            fn = jax.jit(lambda *a: call(*a), donate_argnums=donate)
+            avals = [jax.ShapeDtypeStruct(s, d) for s, d in shapes_dtypes]
+            fn = fn.lower(*avals).compile()  # AOT: no trace on serve path
+            self._compiled[key] = fn
+        return fn
+
+    def _aot_compile(self):
+        """Compile at load for declared buckets, plus the saved example
+        shapes when they are fully static."""
+        for bucket in self.config._shape_buckets:
+            sd = [(tuple(s), np.dtype(d)) for s, d in
+                  zip(bucket, self._meta["in_dtypes"])]
+            self._lowered(sd)
+        try:
+            shapes = [tuple(int(d) for d in s)
+                      for s in self._meta["in_shapes"]]
+        except ValueError:
+            return  # symbolic dims: compile per served shape
+        sd = [(s, np.dtype(d))
+              for s, d in zip(shapes, self._meta["in_dtypes"])]
+        self._lowered(sd)
+
+    # -- handle API --------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_output_names(self) -> List[str]:
+        if self._output_names is not None:
+            return list(self._output_names)
+        return [f"out{i}" for i in range(len(self._outputs) or 1)]
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, is_input=True)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, is_input=False)
+
+    # -- execution ---------------------------------------------------------
+    def run(self, inputs: Optional[Sequence] = None):
+        """Serve one batch.  ``run([arr, ...])`` or stage via input
+        handles first.  Returns the output list (also readable through
+        output handles)."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n] = np.asarray(a)
+        args = []
+        for n in self._input_names:
+            if n not in self._inputs:
+                raise ValueError(f"input '{n}' not staged; call "
+                                 f"get_input_handle('{n}').copy_from_cpu()")
+            args.append(jnp.asarray(self._inputs[n]))
+        sd = tuple((tuple(a.shape), np.dtype(a.dtype)) for a in args)
+        fn = self._lowered(sd, no_donate=self._external)
+        outs = fn(*args)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        names = (self._output_names
+                 or [f"out{i}" for i in range(len(outs))])
+        self._outputs = dict(zip(names, outs))
+        self._output_names = names
+        return list(outs)
+
+    def num_compiled_variants(self) -> int:
+        """Observability: distinct shape buckets compiled so far."""
+        return self._compile_count
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: paddle_infer::CreatePredictor."""
+    return Predictor(config)

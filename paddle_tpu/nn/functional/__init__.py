@@ -27,7 +27,7 @@ from ...ops.manipulation import pad as _pad_op
 
 def _act(jfn, name):
     def op(x, name=None):
-        return apply(jfn, x, op_name=name)
+        return apply(jfn, x, op_name=name, cacheable=True)
     op.__name__ = name
     return op
 
@@ -149,13 +149,21 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
 # linear / embedding
 # ---------------------------------------------------------------------------
 
+def _linear_fn(a, w):
+    return jnp.matmul(a, w)
+
+
+def _linear_bias_fn(a, w, b):
+    return jnp.matmul(a, w) + b
+
+
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b; W is [in, out] (reference: operators/matmul_v2 + fc)."""
     if bias is None:
-        return apply(lambda a, w: jnp.matmul(a, w), x, weight,
-                     op_name="linear")
-    return apply(lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias,
-                 op_name="linear")
+        return apply(_linear_fn, x, weight, op_name="linear",
+                     cacheable=True)
+    return apply(_linear_bias_fn, x, weight, bias, op_name="linear",
+                 cacheable=True)
 
 
 def bilinear(x1, x2, weight, bias=None, name=None):
@@ -226,6 +234,16 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
                    data_format, 3)
 
 
+def _conv_fn(a, w, *, stride, pad_spec, dilation, groups, specs):
+    dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, specs)
+    return jax.lax.conv_general_dilated(
+        a, w, window_strides=stride,
+        padding=(pad_spec if isinstance(pad_spec, str)
+                 else [tuple(p) for p in pad_spec]),
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+
+
 def _convnd(x, weight, bias, stride, padding, dilation, groups, data_format,
             n):
     stride = _norm_tuple(stride, n)
@@ -240,17 +258,11 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, data_format,
     # paddle kernel layout: [out_c, in_c/groups, *spatial]
     rhs_spec = "OI" + sp
     out_spec = lhs_spec
-    dn = jax.lax.conv_dimension_numbers(
-        as_array(x).shape, as_array(weight).shape,
-        (lhs_spec, rhs_spec, out_spec))
-
-    def _conv(a, w):
-        return jax.lax.conv_general_dilated(
-            a, w, window_strides=stride, padding=pad_spec,
-            rhs_dilation=dilation, dimension_numbers=dn,
-            feature_group_count=groups)
-
-    out = apply(_conv, x, weight, op_name=f"conv{n}d")
+    pad_hashable = (pad_spec if isinstance(pad_spec, str)
+                    else tuple(tuple(p) for p in pad_spec))
+    out = apply(_conv_fn, x, weight, op_name=f"conv{n}d", cacheable=True,
+                stride=stride, pad_spec=pad_hashable, dilation=dilation,
+                groups=groups, specs=(lhs_spec, rhs_spec, out_spec))
     if bias is not None:
         shape = [1] * (n + 2)
         shape[-1 if channels_last else 1] = -1
@@ -335,20 +347,32 @@ def _pool(x, kernel, stride, padding, n, reducer, init, data_format,
         pad_full = ([(0, 0), (0, 0)] + list(pads)
                     if not isinstance(pads, str) else pads)
 
-    def _run(a):
-        out = jax.lax.reduce_window(a, init, reducer, window, strides,
-                                    pad_full)
-        if average:
-            if count_include_pad or (isinstance(pads, list)
-                                     and all(p == (0, 0) for p in pads)):
-                out = out / float(np.prod(kernel))
-            else:
-                ones = jnp.ones_like(a)
-                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
-                                            strides, pad_full)
-                out = out / cnt
-        return out
-    return apply(_run, x, op_name="pool")
+    no_pad = isinstance(pads, list) and all(p == (0, 0) for p in pads)
+    return apply(
+        _pool_fn, x, op_name="pool", cacheable=True, init=init,
+        max_pool=(reducer is jax.lax.max), window=window, strides=strides,
+        pad_full=(pad_full if isinstance(pad_full, str)
+                  else tuple(tuple(p) for p in pad_full)),
+        average=average, divisor=(float(np.prod(kernel))
+                                  if (count_include_pad or no_pad)
+                                  else None))
+
+
+def _pool_fn(a, *, init, max_pool, window, strides, pad_full, average,
+             divisor):
+    reducer = jax.lax.max if max_pool else jax.lax.add
+    pad = (pad_full if isinstance(pad_full, str)
+           else [tuple(p) for p in pad_full])
+    out = jax.lax.reduce_window(a, init, reducer, window, strides, pad)
+    if average:
+        if divisor is not None:
+            out = out / divisor
+        else:
+            ones = jnp.ones_like(a)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides, pad)
+            out = out / cnt
+    return out
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,

@@ -17,14 +17,14 @@ _prec = None  # set via flags/matmul_precision if needed
 
 def _binop(jfn, name):
     def op(x, y, name=None):
-        return apply(jfn, x, y, op_name=name)
+        return apply(jfn, x, y, op_name=name, cacheable=True)
     op.__name__ = name
     return op
 
 
 def _unop(jfn, name):
     def op(x, name=None):
-        return apply(jfn, x, op_name=name)
+        return apply(jfn, x, op_name=name, cacheable=True)
     op.__name__ = name
     return op
 

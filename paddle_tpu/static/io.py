@@ -60,6 +60,10 @@ def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
             for tag, v in node.in_specs:
                 if tag == "v":
                     needed.add(v.name)
+            # composite control-flow nodes reference upstream Variables
+            # through replay closures — keep their producers too
+            for v in node.extra_vars:
+                needed.add(v.name)
     nodes.reverse()
 
     params = program.parameters()

@@ -29,7 +29,9 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     in_features = int(np.prod(x.shape[num_flatten_dims:]))
     w = _make_param([in_features, size])
     b = _make_param([size], is_bias=True)
-    flat = (x.reshape(list(x.shape[:num_flatten_dims]) + [-1])
+    # trailing dims are concrete (in_features); at most the one dynamic
+    # leading dim may stay -1 in the reshape
+    flat = (x.reshape(list(x.shape[:num_flatten_dims]) + [in_features])
             if len(x.shape) > num_flatten_dims + 1 else x)
     out = F.linear(flat, w, b)
     if activation is not None:

@@ -102,10 +102,10 @@ class _OpNode:
     """One recorded op (reference: framework.py Operator / OpDesc)."""
 
     __slots__ = ("fn", "kw", "op_name", "in_specs", "out_vars",
-                 "multi", "extra_params")
+                 "multi", "extra_params", "extra_vars")
 
     def __init__(self, fn, kw, op_name, in_specs, out_vars, multi,
-                 extra_params=()):
+                 extra_params=(), extra_vars=()):
         self.fn = fn
         self.kw = kw
         self.op_name = op_name
@@ -113,9 +113,12 @@ class _OpNode:
         #                           |("c", jax.Array)|("l", literal)
         self.out_vars = out_vars
         self.multi = multi
-        # Parameters referenced only inside composite replay closures
-        # (control-flow branches); resolved via the replay scope at run
+        # Variables/Parameters referenced only inside composite replay
+        # closures (control-flow branches); resolved via the replay scope
+        # at run time, but recorded here so dependency walks (pruning,
+        # Program.parameters) see them
         self.extra_params = list(extra_params)
+        self.extra_vars = list(extra_vars)
 
 
 class Program:
@@ -143,12 +146,15 @@ class Program:
     def record(self, fn: Callable, inputs: Sequence, kw: dict,
                op_name: str):
         seen_params: List[Parameter] = []
+        seen_vars: List[Variable] = []
 
         def _abstract_lookup(v):
             if isinstance(v, Parameter):
                 if not any(v is p for p in seen_params):
                     seen_params.append(v)
                 return v.data
+            if not any(v is u for u in seen_vars):
+                seen_vars.append(v)
             return jnp.zeros(v.data.shape, v.data.dtype)
 
         with replay_scope(_abstract_lookup):
@@ -168,7 +174,8 @@ class Program:
         avals = list(out_avals) if multi else [out_avals]
         out_vars = [Variable(a, self) for a in avals]
         self.nodes.append(_OpNode(fn, kw, op_name, in_specs, out_vars,
-                                  multi, extra_params=seen_params))
+                                  multi, extra_params=seen_params,
+                                  extra_vars=seen_vars))
         self._version += 1
         if multi:
             return tuple(out_vars)
