@@ -147,10 +147,14 @@ def _sweep(nodes, cot, retain_graph, want=None, results=None,
                 continue
             if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
                 continue
-            for hook in tin._backward_hooks:
-                r = hook(Tensor(g, stop_gradient=True))
-                if r is not None:
-                    g = r.data if isinstance(r, Tensor) else r
+            from .selected_rows import SelectedRows
+            if tin._backward_hooks:
+                if isinstance(g, SelectedRows):
+                    g = g.to_dense()  # hooks keep their dense contract
+                for hook in tin._backward_hooks:
+                    r = hook(Tensor(g, stop_gradient=True))
+                    if r is not None:
+                        g = r.data if isinstance(r, Tensor) else r
             if leaf and deposit_leaf_grad:
                 if tin._grad_data is None:
                     tin._grad_data = g
@@ -227,9 +231,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         if bid in cot and results[i] is None:
             results[i] = cot[bid]
 
+    from .selected_rows import SelectedRows
     out_tensors: List[Optional[Tensor]] = [
         None if (r is None or ins[i]._bw_id in skip_ids)
-        else Tensor(r, stop_gradient=True)
+        else Tensor(r.to_dense() if isinstance(r, SelectedRows) else r,
+                    stop_gradient=True)
         for i, r in enumerate(results)]
     if not allow_unused:
         for i, r in enumerate(out_tensors):

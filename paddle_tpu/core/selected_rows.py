@@ -1,0 +1,78 @@
+"""SelectedRows — the sparse row-gradient representation.
+
+TPU-native analog of the reference's ``framework::SelectedRows``
+(reference: paddle/fluid/framework/selected_rows.h:34: rows_ + value_ +
+height_) used by ``embedding(..., sparse=True)``: the backward of a
+lookup touches only the looked-up rows, so the gradient is (rows, values)
+instead of a mostly-zero [height, dim] dense array.  Optimizers apply it
+with row-wise scatter updates (operators/optimizers/sgd_op.h SelectedRows
+branch; Adam's lazy_mode path, adam_op.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SelectedRows:
+    """rows: int32 [n]; values: [n, *dim]; height: size of the full dim 0."""
+
+    __slots__ = ("rows", "values", "height")
+
+    # make numpy/jax defer `dense + sr` to our __radd__ instead of
+    # broadcasting over the object
+    __array_ufunc__ = None
+    __array_priority__ = 1000
+
+    def __init__(self, rows, values, height: int):
+        self.rows = jnp.asarray(rows).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def merge(self) -> "SelectedRows":
+        """Sum duplicate rows (reference: operators/math/
+        selected_rows_functor.cc MergeAdd).  Keeps the result sparse with
+        one entry per unique touched row."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True)
+        vals = jnp.zeros((uniq.shape[0],) + self.values.shape[1:],
+                         self.values.dtype)
+        vals = vals.at[inv.reshape(-1)].add(self.values)
+        return SelectedRows(uniq, vals, self.height)
+
+    def to_dense(self):
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    # dense/sparse accumulation (tape deposits may mix both)
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            assert other.height == self.height
+            return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                                jnp.concatenate([self.values, other.values]),
+                                self.height)
+        return jnp.asarray(other).at[self.rows].add(self.values)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __mul__(self, s):
+        return SelectedRows(self.rows, self.values * s, self.height)
+
+    __rmul__ = __mul__
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"dim={tuple(self.values.shape[1:])})")

@@ -146,8 +146,14 @@ class Tensor:
     def grad(self) -> Optional["Tensor"]:
         if self._grad_data is None:
             return None
-        return Tensor(self._grad_data, stop_gradient=True,
-                      name=self.name + "@GRAD")
+        g = self._grad_data
+        from .selected_rows import SelectedRows
+        if isinstance(g, SelectedRows):
+            # user-facing view densifies (reference pybind does the same
+            # via get_tensor_from_selected_rows); optimizers read the
+            # sparse _grad_data directly
+            g = g.to_dense()
+        return Tensor(g, stop_gradient=True, name=self.name + "@GRAD")
 
     @grad.setter
     def grad(self, value):

@@ -183,6 +183,37 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             mask = (ids != padding_idx)[..., None].astype(w.dtype)
             out = out * mask
         return out
+
+    from ...core import autograd
+    ids_arr = as_array(x)
+    w_arr = as_array(weight)
+    if (sparse and autograd.grad_enabled()
+            and isinstance(weight, Tensor) and not weight.stop_gradient
+            and not isinstance(ids_arr, jax.core.Tracer)
+            and not isinstance(w_arr, jax.core.Tracer)):
+        # SelectedRows gradient (reference: lookup_table_op.cc
+        # is_sparse branch): the weight cotangent is (rows, values), not
+        # a [vocab, dim]-dense scatter — optimizers apply it row-wise
+        from ...core.selected_rows import SelectedRows
+
+        with autograd.no_grad():
+            out_arr = _embedding(ids_arr, w_arr)
+        out = Tensor(out_arr, stop_gradient=False, _produced=True)
+
+        def vjp_fn(ct):
+            rows = ids_arr.reshape(-1)
+            vals = jnp.asarray(ct).reshape(-1, w_arr.shape[-1])
+            if padding_idx is not None:
+                keep = (rows != padding_idx)[:, None].astype(vals.dtype)
+                vals = vals * keep
+            return (SelectedRows(rows, vals, w_arr.shape[0]),)
+
+        node = autograd.Node(
+            inputs=[weight], vjp_fn=vjp_fn, out_ids=[out._bw_id],
+            out_avals=[(out.shape_tuple, np.dtype(out_arr.dtype))],
+            out_is_tuple=False)
+        out._node = node
+        return out
     return apply(_embedding, x, weight, op_name="embedding")
 
 

@@ -95,6 +95,7 @@ class Embedding(Layer):
         self._padding_idx = (None if padding_idx is None else
                              padding_idx if padding_idx >= 0 else
                              num_embeddings + padding_idx)
+        self._sparse = sparse
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=I.Normal(0.0, 1.0))
@@ -102,7 +103,8 @@ class Embedding(Layer):
             self.weight.data = self.weight.data.at[self._padding_idx].set(0.0)
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

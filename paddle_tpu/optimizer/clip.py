@@ -1,10 +1,24 @@
 """Gradient clipping (reference: python/paddle/fluid/clip.py:152,243,345 —
-ClipGradByValue/ByNorm/ByGlobalNorm, applied inside optimizer apply)."""
+ClipGradByValue/ByNorm/ByGlobalNorm, applied inside optimizer apply).
+
+SelectedRows grads participate like the reference's merge_selected_rows +
+get_tensor_from_selected_rows path (fluid/clip.py:406-414): duplicates are
+merged, the values contribute to norms, and scaling stays sparse."""
 from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..core.selected_rows import SelectedRows
 from ..core.tensor import Tensor
+
+
+def _merged(g):
+    return g.merge() if isinstance(g, SelectedRows) else g
+
+
+def _sq_sum(g):
+    v = g.values if isinstance(g, SelectedRows) else g
+    return jnp.sum(jnp.square(v))
 
 
 class ClipGradBase:
@@ -22,8 +36,13 @@ class ClipGradByValue(ClipGradBase):
         self.min = float(min) if min is not None else -self.max
 
     def __call__(self, params_grads):
-        return [(p, jnp.clip(g, self.min, self.max))
-                for p, g in params_grads]
+        def clip(g):
+            if isinstance(g, SelectedRows):
+                return SelectedRows(g.rows,
+                                    jnp.clip(g.values, self.min, self.max),
+                                    g.height)
+            return jnp.clip(g, self.min, self.max)
+        return [(p, clip(_merged(g))) for p, g in params_grads]
 
 
 class ClipGradByNorm(ClipGradBase):
@@ -35,7 +54,8 @@ class ClipGradByNorm(ClipGradBase):
     def __call__(self, params_grads):
         out = []
         for p, g in params_grads:
-            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            g = _merged(g)
+            norm = jnp.sqrt(_sq_sum(g))
             scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
                                 1.0)
             out.append((p, g * scale))
@@ -51,9 +71,10 @@ class ClipGradByGlobalNorm(ClipGradBase):
     def __call__(self, params_grads):
         if not params_grads:
             return params_grads
+        params_grads = [(p, _merged(g)) for p, g in params_grads]
         needs = [(p, g) for p, g in params_grads
                  if getattr(p, "need_clip", True)]
-        sq = sum(jnp.sum(jnp.square(g)) for _, g in needs)
+        sq = sum(_sq_sum(g) for _, g in needs)
         global_norm = jnp.sqrt(sq)
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
         return [(p, g * scale if getattr(p, "need_clip", True) else g)

@@ -101,11 +101,16 @@ class Optimizer:
         # clip raw grads first, THEN regularize — matching the reference's
         # apply_gradients order (python/paddle/optimizer/optimizer.py:746-757)
         # and this file's functional_update.
+        from ..core.selected_rows import SelectedRows
         pg = [(p, p._grad_data) for p in self._parameter_list
               if p.trainable and p._grad_data is not None]
         if self._grad_clip is not None:
-            pg = self._grad_clip(pg)
-        pg = [(p, self._apply_decay(p, g)) for p, g in pg]
+            pg = self._grad_clip(pg)  # SelectedRows-aware (clip.py)
+        # weight decay skips SelectedRows (regularizing only touched rows
+        # would bias the decay; the reference's sparse tables decay via
+        # table-side accessors instead)
+        pg = [(p, g if isinstance(g, SelectedRows)
+               else self._apply_decay(p, g)) for p, g in pg]
         lr = self.get_lr()
         for p, g in pg:
             slots = self._slots.get(id(p))
@@ -117,6 +122,16 @@ class Optimizer:
                 self._slots[id(p)] = slots
             plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             plr = plr * self._param_lr_ratio(p)
+            if isinstance(g, SelectedRows):
+                g = g.merge()
+                if "master" not in slots and self._sparse_supported():
+                    # true SelectedRows semantics: only touched rows (and
+                    # their optimizer slots) are updated
+                    p.data, new_slots = self._sparse_update_param(
+                        p.data, g, slots, plr, self._step_count)
+                    self._slots[id(p)] = new_slots
+                    continue
+                g = g.to_dense()  # optimizers without a sparse kernel
             if "master" in slots:
                 master = self._decoupled_decay(slots["master"], plr, p.name)
                 new_master, new_slots = self.update_param(
@@ -130,6 +145,15 @@ class Optimizer:
                 p.data, new_slots = self.update_param(
                     pdata, g, slots, plr, self._step_count)
             self._slots[id(p)] = new_slots
+
+    # -- sparse (SelectedRows) updates -------------------------------------
+    def _sparse_supported(self) -> bool:
+        """Whether this optimizer has a row-wise SelectedRows kernel
+        (reference: sgd_op.h SelectedRows branch, adam_op.h lazy_mode)."""
+        return False
+
+    def _sparse_update_param(self, p, sr, slots, lr, step):
+        raise NotImplementedError
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
@@ -247,6 +271,14 @@ class SGD(Optimizer):
     def update_param(self, p, g, slots, lr, step):
         return p - lr * g.astype(p.dtype), slots
 
+    def _sparse_supported(self):
+        return True
+
+    def _sparse_update_param(self, p, sr, slots, lr, step):
+        """Row-wise scatter update (reference: sgd_op.h SelectedRows
+        kernel): untouched rows are never read or written."""
+        return p.at[sr.rows].add(-lr * sr.values.astype(p.dtype)), slots
+
 
 class Momentum(Optimizer):
     """reference: operators/optimizers/momentum_op.h."""
@@ -283,6 +315,27 @@ class Adam(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lazy = bool(lazy_mode)
+
+    def _sparse_supported(self):
+        return self._lazy
+
+    def _sparse_update_param(self, p, sr, slots, lr, step):
+        """lazy_mode Adam (reference: adam_op.h lazy_mode branch): moments
+        and params update ONLY on touched rows; untouched rows keep stale
+        moments — the documented lazy semantics for huge embeddings."""
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        rows = sr.rows
+        g = sr.values.astype(slots["m"].dtype)
+        m_r = b1 * slots["m"][rows] + (1 - b1) * g
+        v_r = b2 * slots["v"][rows] + (1 - b2) * g * g
+        step_f = jnp.asarray(step, jnp.float32)
+        mhat = m_r / (1 - b1 ** step_f)
+        vhat = v_r / (1 - b2 ** step_f)
+        upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+        return (p.at[rows].add(-upd.astype(p.dtype)),
+                {"m": slots["m"].at[rows].set(m_r),
+                 "v": slots["v"].at[rows].set(v_r)})
 
     def init_slots(self, p):
         dt = jnp.float32 if p.dtype in (jnp.bfloat16, jnp.float16) else p.dtype

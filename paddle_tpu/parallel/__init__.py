@@ -8,6 +8,7 @@ from .pipeline import (Pipeline, PipelineStage, pipelined_fn,  # noqa
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .ring_attention import (reference_attention, ring_attention,  # noqa
                              ring_attention_per_device)
+from .sharded_embedding import ShardedEmbedding  # noqa: F401
 from .spmd_train_step import SpmdTrainStep  # noqa: F401
 from .tp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa
                         RowParallelLinear, VocabParallelEmbedding,
