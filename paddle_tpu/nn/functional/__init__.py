@@ -189,6 +189,8 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     w_arr = as_array(weight)
     if (sparse and autograd.grad_enabled()
             and isinstance(weight, Tensor) and not weight.stop_gradient
+            and weight._node is None  # leaf only: an upstream dense vjp
+            #                           cannot consume SelectedRows
             and not isinstance(ids_arr, jax.core.Tracer)
             and not isinstance(w_arr, jax.core.Tracer)):
         # SelectedRows gradient (reference: lookup_table_op.cc

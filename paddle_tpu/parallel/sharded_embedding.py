@@ -29,7 +29,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ..core.tensor import Parameter
 from ..distributed.mesh import get_mesh
 from ..nn import functional as F
 from ..nn import initializer as I
@@ -40,7 +39,7 @@ from .tp_layers import set_placement
 def _row_sharded_lookup(w, ids, mesh, axis):
     """Shard-local gather + psum over ``axis``; differentiable (shard_map
     has full AD support), grads land as shard-local scatter-adds."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     n = mesh.shape[axis]
     rows_per = w.shape[0] // n
@@ -80,6 +79,14 @@ class ShardedEmbedding(Layer):
         self._embedding_dim = embedding_dim
         self._axis = axis
         self._sparse = sparse
+        mesh = get_mesh()
+        if (mesh is not None and axis in mesh.shape
+                and num_embeddings % mesh.shape[axis] != 0):
+            raise ValueError(
+                f"num_embeddings ({num_embeddings}) must be divisible by "
+                f"mesh axis '{axis}' size ({mesh.shape[axis]}) — otherwise "
+                f"the table would silently replicate onto every chip; pad "
+                f"the vocab to a multiple")
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=I.Normal(0.0, 0.02))

@@ -368,3 +368,88 @@ def test_spmd_scatter_divisibility_error():
 
     with pytest.raises(ValueError, match="divisible"):
         f(paddle.to_tensor(np.ones(6, np.float32)))
+
+
+def test_pipeline_dp_sharded_with_embed_head():
+    """Round-3 pipeline: dp x pp grid, pp-sharded microbatch streams, and
+    non-uniform first/last stages (embedding in, head out)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.jit.bind import param_list
+
+    mesh = dist.init_mesh({"pp": 4, "dp": 2})
+    paddle.seed(9)
+    H, V = 8, 32
+    stages = [nn.Linear(H, H) for _ in range(4)]
+    template = nn.Linear(H, H)
+    embed = nn.Embedding(V, H)
+    head = nn.Linear(H, V)
+    stacked, _ = stack_stage_params(stages)
+    e_params = tuple(p.data for p in param_list(embed))
+    h_params = tuple(p.data for p in param_list(head))
+
+    fn = pipelined_fn(template, n_stages=4, num_microbatches=4, mesh=mesh,
+                      dp_axis="dp", embed_layer=embed, head_layer=head)
+    ids = np.random.RandomState(0).randint(0, V, (16, 6)).astype(np.int32)
+    out = fn(stacked, jnp.asarray(ids), e_params, h_params)
+    assert out.shape == (16, 6, V)
+
+    # oracle: embed -> stages -> head sequentially
+    h = embed(paddle.to_tensor(ids))
+    for s in stages:
+        h = s(h)
+    expect = head(h).numpy()
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4,
+                               atol=1e-5)
+
+    # gradients flow to stage, embed AND head params
+    def loss(sp, ep, hp):
+        return jnp.sum(fn(sp, jnp.asarray(ids), ep, hp) ** 2)
+
+    gs, ge, gh = jax.grad(loss, argnums=(0, 1, 2))(stacked, e_params,
+                                                   h_params)
+    assert all(float(jnp.abs(g).sum()) > 0 for g in ge)
+    assert all(float(jnp.abs(g).sum()) > 0 for g in gh)
+    assert all(float(jnp.abs(g).sum()) > 0 for g in gs)
+
+
+def test_zero3_param_sharding_parity():
+    """ZeRO stage 3: params themselves sharded over 'dp'; losses must
+    match the single-device oracle (VERDICT round-2: stage 3 was dead
+    code by test coverage)."""
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.strategy import DistributedStrategy
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.parallel import SpmdTrainStep
+
+    paddle.seed(21)
+    net = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 8))
+    r = np.random.RandomState(21)
+    x = jnp.asarray(r.randn(8, 8), jnp.float32)
+    y = jnp.asarray(r.randn(8, 8), jnp.float32)
+    import paddle_tpu.nn.functional as F
+    loss_fn = lambda out, lab: F.mse_loss(out, lab)
+    init = {k: np.asarray(v.data).copy()
+            for k, v in net.state_dict().items()}
+
+    mesh = dist.init_mesh({"dp": 4})
+    strat = DistributedStrategy()
+    strat.sharding = True
+    strat.sharding_configs = {"stage": 3}
+    opt = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    step = SpmdTrainStep(net, loss_fn, opt, mesh=mesh, strategy=strat)
+    z3_losses = [float(step(x, y)) for _ in range(3)]
+
+    # params actually sharded over dp
+    from paddle_tpu.parallel.tp_layers import get_placement
+    from jax.sharding import PartitionSpec
+    sharded = [p for p in step._params
+               if p.data.shape and p.data.shape[0] % 4 == 0]
+    specs = [step._param_spec(p) for p in sharded]
+    assert any(s == PartitionSpec("dp") for s in specs), specs
+
+    net.set_state_dict(init)
+    opt2 = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    local = TrainStep(net, loss_fn, opt2)
+    local_losses = [float(local(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(z3_losses, local_losses, rtol=2e-4)
