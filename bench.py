@@ -86,8 +86,14 @@ def _timed_steps(step, feeds, warmup, steps, profile_dir=None):
         import jax
         jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(*feeds)
+    if profile_dir:
+        from paddle_tpu.profiler import RecordEvent
+        for i in range(steps):
+            with RecordEvent(f"train_step#{i}"):  # named host-track span
+                loss = step(*feeds)
+    else:  # unprofiled timing: no annotation overhead in the numbers
+        for _ in range(steps):
+            loss = step(*feeds)
     last = float(loss)  # device sync
     dt = time.perf_counter() - t0
     if profile_dir:
@@ -259,6 +265,12 @@ def bench_lenet_dygraph(args):
         "t0 = time.perf_counter(); n = 30\n"
         "for _ in range(n): last = one_step()\n"
         "dt = time.perf_counter() - t0\n"
+        "from paddle_tpu import profiler as _prof\n"
+        "p = _prof.Profiler(timer_only=True); p.start()\n"
+        "for _ in range(5): one_step()  # separate profiled pass\n"
+        "p.stop()\n"
+        "top_ops = [[nm, c, round(ms, 2)]"
+        " for nm, c, ms in p.key_averages()[:5]]\n"
         "import tempfile, os as _os\n"
         "from paddle_tpu import inference, jit\n"
         "from paddle_tpu.jit import InputSpec\n"
@@ -275,7 +287,8 @@ def bench_lenet_dygraph(args):
         "print(json.dumps({'step_time_ms': round(1000 * dt / n, 3),"
         " 'steps_per_sec': round(n / dt, 2), 'final_loss': round(last, 4),"
         " 'predictor_latency_ms_bs1': round(infer_ms, 3),"
-        " 'predictor_recompiles': pred.num_compiled_variants()}))\n"
+        " 'predictor_recompiles': pred.num_compiled_variants(),"
+        " 'top_host_ops_ms': top_ops}))\n"
         % os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:

@@ -10,13 +10,14 @@ transforms handle differentiation).
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import autograd, static_hooks
+from . import autograd, profiler_hook, static_hooks
 from .enforce import with_op_hint
 from .flags import get_flag
 
@@ -133,6 +134,10 @@ def apply(fn: Callable, *inputs, op_name: str | None = None,
                     np.dtype(x.data.dtype), np.inexact):
                 diff_idx.append(i)
 
+    # host-op profiling (reference: RecordEvent inside Tracer::TraceOp)
+    prof = profiler_hook.current()
+    t_prof = time.perf_counter() if prof is not None else None
+
     try:
         if diff_idx:
             rules = (_cached_rules(fn, kw, diff_idx, arrays)
@@ -155,6 +160,9 @@ def apply(fn: Callable, *inputs, op_name: str | None = None,
             outs = fn(*arrays, **kw)
     except Exception as e:  # attach op attribution like AppendErrorOpHint
         raise with_op_hint(e, name)
+
+    if prof is not None:
+        prof._record(name, time.perf_counter() - t_prof)
 
     multi = isinstance(outs, (tuple, list))
     out_seq = list(outs) if multi else [outs]

@@ -1,0 +1,19 @@
+"""Profiler hook shared between core.dispatch and the profiler package.
+
+Lives in core so the eager op hot path pays ONE None-check when profiling
+is off (the reference gates the same way on g_state in
+platform/profiler.cc)."""
+from __future__ import annotations
+
+from typing import Optional
+
+_active = None
+
+
+def set_active(profiler) -> None:
+    global _active
+    _active = profiler
+
+
+def current() -> Optional[object]:
+    return _active

@@ -737,57 +737,62 @@ def _reduce(out, reduction):
     return out
 
 
+def _ce_fn(logits, lab, *w, use_softmax, axis, soft_label,
+       label_smoothing, ignore_index, reduction):
+    wgt = w[0] if w else None
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(logits, 1e-30))
+    nclass = logits.shape[axis]
+    if soft_label:
+        tgt = lab
+    else:
+        lab_ = lab
+        if lab_.ndim == logp.ndim and lab_.shape[axis] == 1:
+            lab_ = jnp.squeeze(lab_, axis)
+        tgt = jax.nn.one_hot(lab_, nclass, axis=axis, dtype=logp.dtype)
+    if label_smoothing > 0.0:
+        tgt = tgt * (1.0 - label_smoothing) + label_smoothing / nclass
+    loss = -jnp.sum(tgt * logp, axis=axis)
+    w_row = None
+    if wgt is not None and not soft_label:
+        lab_ = lab
+        if lab_.ndim == logp.ndim and lab_.shape[axis] == 1:
+            lab_ = jnp.squeeze(lab_, axis)
+        # ignore_index (e.g. -100) is out of range for the weight
+        # table — jnp.take would fill NaN; ignored rows are masked to
+        # zero below, so any in-range index works here
+        safe = jnp.where(lab_ == ignore_index, 0, lab_)
+        w_row = jnp.take(wgt, safe)
+        loss = loss * w_row
+    if not soft_label:
+        lab_ = lab
+        if lab_.ndim == logp.ndim and lab_.shape[axis] == 1:
+            lab_ = jnp.squeeze(lab_, axis)
+        mask = (lab_ != ignore_index).astype(loss.dtype)
+        loss = loss * mask
+        if reduction == "mean":
+            if w_row is not None:
+                # weighted mean divides by the sum of selected class
+                # weights (reference: nn/functional/loss.py weighted CE)
+                denom = jnp.sum(mask * w_row)
+            else:
+                denom = jnp.sum(mask)
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+    return _reduce(loss, reduction)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
-    def _ce(logits, lab, *w):
-        wgt = w[0] if w else None
-        if use_softmax:
-            logp = jax.nn.log_softmax(logits, axis=axis)
-        else:
-            logp = jnp.log(jnp.maximum(logits, 1e-30))
-        nclass = logits.shape[axis]
-        if soft_label:
-            tgt = lab
-        else:
-            lab_ = lab
-            if lab_.ndim == logp.ndim and lab_.shape[axis] == 1:
-                lab_ = jnp.squeeze(lab_, axis)
-            tgt = jax.nn.one_hot(lab_, nclass, axis=axis, dtype=logp.dtype)
-        if label_smoothing > 0.0:
-            tgt = tgt * (1.0 - label_smoothing) + label_smoothing / nclass
-        loss = -jnp.sum(tgt * logp, axis=axis)
-        w_row = None
-        if wgt is not None and not soft_label:
-            lab_ = lab
-            if lab_.ndim == logp.ndim and lab_.shape[axis] == 1:
-                lab_ = jnp.squeeze(lab_, axis)
-            # ignore_index (e.g. -100) is out of range for the weight
-            # table — jnp.take would fill NaN; ignored rows are masked to
-            # zero below, so any in-range index works here
-            safe = jnp.where(lab_ == ignore_index, 0, lab_)
-            w_row = jnp.take(wgt, safe)
-            loss = loss * w_row
-        if not soft_label:
-            lab_ = lab
-            if lab_.ndim == logp.ndim and lab_.shape[axis] == 1:
-                lab_ = jnp.squeeze(lab_, axis)
-            mask = (lab_ != ignore_index).astype(loss.dtype)
-            loss = loss * mask
-            if reduction == "mean":
-                if w_row is not None:
-                    # weighted mean divides by the sum of selected class
-                    # weights (reference: nn/functional/loss.py weighted CE)
-                    denom = jnp.sum(mask * w_row)
-                else:
-                    denom = jnp.sum(mask)
-                return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
-        return _reduce(loss, reduction)
-
     args = [input, label]
     if weight is not None:
         args.append(weight)
-    return apply(_ce, *args, op_name="cross_entropy")
+    return apply(_ce_fn, *args, op_name="cross_entropy", cacheable=True,
+                 use_softmax=use_softmax, axis=axis, soft_label=soft_label,
+                 label_smoothing=float(label_smoothing),
+                 ignore_index=ignore_index, reduction=reduction)
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,

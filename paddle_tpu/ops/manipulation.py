@@ -17,10 +17,15 @@ def cast(x, dtype):
     return apply(lambda a: a.astype(d), x, op_name="cast")
 
 
+def _reshape_fn(a, *, shape):
+    return jnp.reshape(a, shape)
+
+
 def reshape(x, shape, name=None):
     shape = tuple(int(s) if not hasattr(s, "item") else int(s.item())
                   for s in shape)
-    return apply(lambda a: jnp.reshape(a, shape), x, op_name="reshape")
+    return apply(_reshape_fn, x, op_name="reshape", cacheable=True,
+                 shape=shape)
 
 
 def reshape_(x, shape, name=None):
@@ -29,9 +34,14 @@ def reshape_(x, shape, name=None):
     return x
 
 
+def _transpose_fn(a, *, perm):
+    return jnp.transpose(a, perm)
+
+
 def transpose(x, perm, name=None):
     perm = tuple(int(p) for p in perm)
-    return apply(lambda a: jnp.transpose(a, perm), x, op_name="transpose")
+    return apply(_transpose_fn, x, op_name="transpose", cacheable=True,
+                 perm=perm)
 
 
 def t(x, name=None):
@@ -48,14 +58,17 @@ def swapaxes(x, axis1, axis2, name=None):
                  op_name="swapaxes")
 
 
+def _flatten_fn(a, *, start_axis, stop_axis):
+    nd = a.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+    return jnp.reshape(a, new_shape)
+
+
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
-    def _flatten(a):
-        nd = a.ndim
-        s = start_axis % nd if nd else 0
-        e = stop_axis % nd if nd else 0
-        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
-        return jnp.reshape(a, new_shape)
-    return apply(_flatten, x, op_name="flatten")
+    return apply(_flatten_fn, x, op_name="flatten", cacheable=True,
+                 start_axis=int(start_axis), stop_axis=int(stop_axis))
 
 
 def squeeze(x, axis=None, name=None):

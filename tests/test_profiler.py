@@ -1,0 +1,74 @@
+"""paddle.profiler tests (reference analog: test_profiler.py): RecordEvent
+spans, per-op host-time accounting, summary table, legacy fluid API."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, optimizer, profiler
+
+
+def _steps(model, opt, n=3):
+    x = paddle.randn([8, 4])
+    y = paddle.randn([8, 1])
+    for _ in range(n):
+        loss = F.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+
+def test_profiler_collects_op_stats_and_summary():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = optimizer.SGD(learning_rate=0.01, parameters=model.parameters())
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    with profiler.RecordEvent("train_phase"):
+        _steps(model, opt)
+    p.stop()
+
+    ops = dict((n, c) for n, c, _ in p.key_averages())
+    assert ops.get("linear", 0) >= 6  # 2 linears x 3 steps
+    assert "relu" in ops
+    text = p.summary(top_k=5)
+    assert "train_phase" in text
+    assert "linear" in text
+
+
+def test_profiler_off_means_no_collection():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 1))
+    opt = optimizer.SGD(learning_rate=0.01, parameters=model.parameters())
+    p = profiler.Profiler(timer_only=True)
+    _steps(model, opt)          # not started: nothing recorded
+    assert p.key_averages() == []
+
+
+def test_profiler_step_spans():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 1))
+    opt = optimizer.SGD(learning_rate=0.01, parameters=model.parameters())
+    with profiler.Profiler(timer_only=True) as p:
+        for _ in range(3):
+            p.step()
+            _steps(model, opt, n=1)
+    spans = [n for n in p._span_stats if n.startswith("ProfileStep#")]
+    assert len(spans) == 3
+
+
+def test_legacy_fluid_profiler_api(tmp_path):
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 1))
+    opt = optimizer.SGD(learning_rate=0.01, parameters=model.parameters())
+    out = str(tmp_path / "prof.txt")
+    with profiler.profiler_guard(profile_path=out):
+        _steps(model, opt, n=2)
+    content = open(out).read()
+    assert "linear" in content
+
+
+def test_record_event_nests_without_profiler():
+    # spans must be harmless when no profiler is active
+    with profiler.RecordEvent("outer"):
+        with profiler.RecordEvent("inner"):
+            pass
