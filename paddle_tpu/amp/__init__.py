@@ -169,6 +169,11 @@ class GradScaler:
             raise RuntimeError(
                 "unscale_() has already been called on this optimizer "
                 "since the last update()")
+        if not self._unscaled:
+            # first unscale of this step: recompute found_inf fresh so a
+            # stale inf from a prior skipped-update iteration can't leak
+            # into this step's decision
+            self._found_inf = False
         inv = 1.0 / self._scale
         checks = []
         for p in optimizer._parameter_list or []:

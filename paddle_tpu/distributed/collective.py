@@ -184,7 +184,9 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     out = apply(lambda a: jnp.concatenate([a] * n, axis=0), tensor,
                 op_name="all_gather") if n > 1 else tensor
     if tensor_list is not None:
-        tensor_list.extend([tensor] * n)
+        # independent per-rank tensors: mutating one entry must not alias
+        # the others (or the source), matching a real all_gather
+        tensor_list.extend(Tensor(tensor.data) for _ in range(n))
     return out
 
 

@@ -1,0 +1,161 @@
+"""Multi-process launch tooling (``python -m paddle_tpu.distributed.launch``
+/ the ``paddle-tpu-launch`` console script / ``spawn``).
+
+Reference: python/paddle/distributed/fleet/launch.py (fleetrun),
+launch_utils.py (Pod/Trainer env construction, child watch + terminate),
+python/paddle/distributed/spawn.py.
+
+TPU-native process model: ONE controller process per host, all local
+devices visible to it (jax); the launcher starts one worker per host
+entry (``--ips``) or ``--nproc_per_node`` local workers for CPU-backend
+testing, wiring the ``jax.distributed.initialize`` bootstrap env
+(coordinator address / process count / process id — the
+gen_comm_id_helper.cc TCP-rendezvous analog) that
+``init_parallel_env`` consumes.  Children are watched; any non-zero exit
+terminates the rest (launch_utils.py watch_local_trainers parity).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "spawn", "main"]
+
+
+def _worker_env(rank: int, nproc: int, coordinator: str, base=None):
+    env = dict(base or os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nproc),
+        "COORDINATOR_ADDRESS": coordinator,
+        # jax-native names too, for user code calling jax.distributed
+        # directly
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+        "JAX_NUM_PROCESSES": str(nproc),
+        "JAX_PROCESS_ID": str(rank),
+    })
+    return env
+
+
+def launch(training_script: str, script_args: Optional[List[str]] = None,
+           nproc_per_node: int = 1, ips: Optional[str] = None,
+           master_port: int = 6170, log_dir: Optional[str] = None) -> int:
+    """Start ``nproc_per_node`` worker processes running
+    ``training_script`` with the distributed bootstrap env set; watch
+    them, and on any failure terminate the rest (reference:
+    launch_utils.py TrainerProc watch loop).  Returns the first non-zero
+    exit code, or 0."""
+    script_args = script_args or []
+    host = (ips.split(",")[0] if ips else "127.0.0.1")
+    coordinator = f"{host}:{master_port}"
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    procs: List[subprocess.Popen] = []
+    logs = []
+    for rank in range(nproc_per_node):
+        env = _worker_env(rank, nproc_per_node, coordinator)
+        out = (open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
+               if log_dir else None)
+        if out is not None:
+            logs.append(out)
+        procs.append(subprocess.Popen(
+            [sys.executable, training_script, *script_args], env=env,
+            stdout=out, stderr=(subprocess.STDOUT if out else None)))
+
+    rc = 0
+    try:
+        alive = set(range(nproc_per_node))
+        while alive:
+            for rank in list(alive):
+                code = procs[rank].poll()
+                if code is None:
+                    continue
+                alive.discard(rank)
+                if code != 0:
+                    rc = rc or code
+                    # one worker died: take the rest down (reference:
+                    # terminate_local_procs)
+                    for r in alive:
+                        procs[r].terminate()
+                    for r in alive:
+                        try:
+                            procs[r].wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            procs[r].kill()
+                    alive.clear()
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        rc = 130
+    finally:
+        for f in logs:
+            f.close()
+    return rc
+
+
+def _spawn_entry(rank, nprocs, coordinator, func, args):
+    # module-level: the 'spawn' mp context pickles the target
+    os.environ.update(_worker_env(rank, nprocs, coordinator, base={}))
+    func(*args)
+
+
+def spawn(func, args=(), nprocs: int = 1, join: bool = True, daemon=False,
+          **options):
+    """paddle.distributed.spawn parity (reference: spawn.py): run ``func``
+    in ``nprocs`` processes with the bootstrap env set.  ``func`` must be
+    picklable (module-level), as with the reference's spawn."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    port = int(options.get("master_port", 6170))
+    coordinator = f"127.0.0.1:{port}"
+    _entry = _spawn_entry
+
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_entry,
+                        args=(rank, nprocs, coordinator, func, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    rc = 0
+    for p in procs:
+        p.join()
+        rc = rc or (p.exitcode or 0)
+    if rc:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        raise RuntimeError(f"spawned worker failed with exit code {rc}")
+    return procs
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        prog="paddle-tpu-launch",
+        description="fleetrun/launch parity: start distributed workers "
+                    "with the jax bootstrap env wired")
+    ap.add_argument("--nproc_per_node", type=int, default=1)
+    ap.add_argument("--ips", type=str, default=None,
+                    help="comma-separated host list; first is coordinator")
+    ap.add_argument("--master_port", type=int, default=6170)
+    ap.add_argument("--log_dir", type=str, default=None)
+    ap.add_argument("training_script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    ns = ap.parse_args()
+    sys.exit(launch(ns.training_script, ns.script_args,
+                    nproc_per_node=ns.nproc_per_node, ips=ns.ips,
+                    master_port=ns.master_port, log_dir=ns.log_dir))
+
+
+if __name__ == "__main__":
+    main()

@@ -960,13 +960,18 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, name=None):
+                                 training=True, name=None,
+                                 return_weights=False):
     """[B, L, H, D] attention (paddle incubate layout).  The Pallas
     flash-attention kernel (paddle_tpu.ops.pallas) replaces the jnp path
     when FLAGS_use_pallas_kernels is on and shapes allow (reference analog:
-    operators/math/bert_encoder_functor.cu fused attention)."""
+    operators/math/bert_encoder_functor.cu fused attention).
+
+    ``return_weights=True`` forces the unfused path and returns
+    ``(out, weights [B, H, Lq, Lk])`` — the post-softmax, pre-dropout
+    probabilities (MultiHeadAttention's need_weights)."""
     from ...core.flags import get_flag
-    if get_flag("use_pallas_kernels"):
+    if get_flag("use_pallas_kernels") and not return_weights:
         from ...ops.pallas import flash_attention, flash_attention_supported
         q_shape = tuple(query.shape)
         k_shape = tuple(key.shape)
@@ -994,11 +999,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             else:
                 qt = qt + mask
         w = jax.nn.softmax(qt, axis=-1)
+        w_used = w
         if dkey is not None:
             mask = _u16_dropout_mask(dkey, w.shape, dropout_p, w.dtype)
             if mask is not None:
-                w = w * mask
-        return jnp.einsum("bhls,bshd->blhd", w, v)
+                w_used = w * mask
+        out = jnp.einsum("bhls,bshd->blhd", w_used, v)
+        if return_weights:
+            return out, w
+        return out
 
     args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
     return apply(_sdpa, *args, op_name="scaled_dot_product_attention")

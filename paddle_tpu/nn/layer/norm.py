@@ -106,6 +106,19 @@ class SyncBatchNorm(_BatchNormBase):
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):
+        """Under the SPMD train step, batch norm statistics are computed
+        over the GLOBAL (dp-sharded) batch by GSPMD, so conversion is the
+        identity.  Under eager multi-process DataParallel there is no
+        cross-process stat sync — warn so the silent-identity isn't
+        mistaken for NCCL SyncBatchNorm."""
+        import warnings
+        from ...distributed.env import get_world_size
+        if get_world_size() > 1:
+            warnings.warn(
+                "convert_sync_batchnorm: running stats are NOT synced "
+                "across eager DataParallel processes; use the SPMD train "
+                "step (batch sharded over 'dp') for global-batch BN "
+                "statistics")
         return layer
 
 

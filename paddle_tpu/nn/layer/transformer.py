@@ -88,15 +88,24 @@ class MultiHeadAttention(Layer):
         key = query if key is None else key
         value = key if value is None else value
         q, k, v, cache = self._prepare_qkv(query, key, value, cache)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=_convert_attn_mask(attn_mask, q.dtype),
-            dropout_p=self.dropout, training=self.training)
+        mask = _convert_attn_mask(attn_mask, q.dtype)
+        weights = None
+        if self.need_weights:
+            # the shared unfused path materialises [B, H, Lq, Lk]
+            # probabilities (reference: transformer.py weights output)
+            out, weights = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask, dropout_p=self.dropout,
+                training=self.training, return_weights=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask,
+                dropout_p=self.dropout, training=self.training)
         B = out.shape[0]
         out = out.reshape([B, -1, self.embed_dim])
         out = self.out_proj(out)
         outs = [out]
         if self.need_weights:
-            outs.append(None)  # flash path doesn't materialise weights
+            outs.append(weights)
         if cache is not None:
             outs.append(cache)
         return out if len(outs) == 1 else tuple(outs)

@@ -3,6 +3,8 @@ operators/optimizers/ kernel zoo — SURVEY §2.1 'Optimizer ops')."""
 from . import lr  # noqa: F401
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
                    ClipGradByValue, clip_grad_norm_)
+from .extras import (ExponentialMovingAverage, Lookahead,  # noqa: F401
+                     LookaheadOptimizer, ModelAverage)
 from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,  # noqa
                         Lamb, LarsMomentum, Momentum, Optimizer, RMSProp)
 from .regularizer import L1Decay, L2Decay  # noqa: F401

@@ -1,0 +1,193 @@
+"""Optimizer extras: EMA, ModelAverage, Lookahead.
+
+Reference: python/paddle/fluid/optimizer.py ExponentialMovingAverage:3466,
+ModelAverage:3157, LookaheadOptimizer:5499 (2.x surface:
+paddle.incubate.ExponentialMovingAverage etc.).  TPU-native: shadow
+states are plain device arrays updated functionally — under a compiled
+step they fuse into the update program; eagerly they are a handful of
+fused element-wise kernels per parameter.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter
+
+__all__ = ["ExponentialMovingAverage", "ModelAverage",
+           "LookaheadOptimizer", "Lookahead"]
+
+
+class ExponentialMovingAverage:
+    """shadow = decay * shadow + (1 - decay) * param
+    (reference: fluid/optimizer.py:3466; thres_steps debiasing included).
+
+    Usage::
+
+        ema = ExponentialMovingAverage(0.999, parameters=model.parameters())
+        for batch in data:
+            train_step(...)
+            ema.update()
+        with ema.apply(model):      # evaluate with averaged weights
+            evaluate(model)
+    """
+
+    def __init__(self, decay: float = 0.999, thres_steps: bool = True,
+                 parameters: Optional[List[Parameter]] = None, name=None):
+        self._decay = float(decay)
+        self._thres = bool(thres_steps)
+        self._params = list(parameters or [])
+        self._shadow: Dict[int, jnp.ndarray] = {
+            id(p): jnp.asarray(p.data) for p in self._params}
+        self._step = 0
+
+    def update(self):
+        self._step += 1
+        d = self._decay
+        if self._thres:
+            # debiased decay ramp (reference: min(decay, (1+t)/(10+t)))
+            d = min(d, (1.0 + self._step) / (10.0 + self._step))
+        for p in self._params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1.0 - d) * p.data.astype(s.dtype)
+
+    class _Applied:
+        def __init__(self, ema, restore):
+            self._ema, self._restore = ema, restore
+
+        def __enter__(self):
+            return self._ema
+
+        def __exit__(self, *exc):
+            if self._restore:
+                self._ema.restore()
+            return False
+
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap shadow weights in (context manager; reference apply())."""
+        self._backup = {id(p): p.data for p in self._params}
+        for p in self._params:
+            p.data = self._shadow[id(p)].astype(p.data.dtype)
+        return self._Applied(self, need_restore)
+
+    def restore(self, executor=None):
+        for p in self._params:
+            p.data = self._backup[id(p)]
+
+    def state_dict(self):
+        return {"step": self._step,
+                "shadow": [self._shadow[id(p)] for p in self._params]}
+
+    def set_state_dict(self, sd):
+        self._step = sd["step"]
+        for p, s in zip(self._params, sd["shadow"]):
+            self._shadow[id(p)] = jnp.asarray(s)
+
+
+class ModelAverage:
+    """Running average of parameters over a trailing window (reference:
+    fluid/optimizer.py:3157).  Two-block rotation like the reference's
+    sum accumulators: the current block accumulates up to
+    ``max_average_window`` steps, then rotates into the previous block —
+    the effective window stays between max_w and 2*max_w instead of ever
+    collapsing to a single step."""
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 parameters: Optional[List[Parameter]] = None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        self._params = list(parameters or [])
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        zeros = lambda p: jnp.zeros_like(p.data, dtype=jnp.float32)
+        self._sum = {id(p): zeros(p) for p in self._params}
+        self._prev = {id(p): zeros(p) for p in self._params}
+        self._count = 0
+        self._prev_count = 0
+
+    def step(self):
+        if self._count >= self._max_w:
+            # rotate blocks (reference: num_accumulates rollover)
+            self._prev = self._sum
+            self._prev_count = self._count
+            self._sum = {id(p): jnp.zeros_like(p.data, dtype=jnp.float32)
+                         for p in self._params}
+            self._count = 0
+        self._count += 1
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p.data.astype(jnp.float32)
+
+    minimize = step  # fluid-era call-site parity
+
+    class _Applied:
+        def __init__(self, ma, restore):
+            self._ma, self._restore = ma, restore
+
+        def __enter__(self):
+            return self._ma
+
+        def __exit__(self, *exc):
+            if self._restore:
+                self._ma.restore()
+            return False
+
+    def apply(self, executor=None, need_restore: bool = True):
+        total = self._count + self._prev_count
+        assert total > 0, "ModelAverage.apply before any step()"
+        self._backup = {id(p): p.data for p in self._params}
+        for p in self._params:
+            avg = (self._sum[id(p)] + self._prev[id(p)]) / float(total)
+            p.data = avg.astype(p.data.dtype)
+        return self._Applied(self, need_restore)
+
+    def restore(self, executor=None):
+        for p in self._params:
+            p.data = self._backup[id(p)]
+
+
+class LookaheadOptimizer:
+    """k-step lookahead wrapper (reference: fluid/optimizer.py:5499):
+    the inner (fast) optimizer runs k steps, then slow weights move
+    ``alpha`` of the way toward the fast weights and the fast weights
+    reset to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5):
+        assert 0.0 < alpha <= 1.0 and k >= 1
+        self.inner_optimizer = inner_optimizer
+        self._alpha = float(alpha)
+        self._k = int(k)
+        self._params = list(inner_optimizer._parameter_list or [])
+        self._slow = {id(p): jnp.asarray(p.data) for p in self._params}
+        self._i = 0
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._i += 1
+        if self._i % self._k == 0:
+            a = self._alpha
+            for p in self._params:
+                slow = self._slow[id(p)]
+                slow = slow + a * (p.data.astype(slow.dtype) - slow)
+                self._slow[id(p)] = slow
+                p.data = slow.astype(p.data.dtype)
+
+    def clear_grad(self, *a, **k):
+        return self.inner_optimizer.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+
+Lookahead = LookaheadOptimizer

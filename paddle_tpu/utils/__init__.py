@@ -1,0 +1,13 @@
+"""paddle_tpu.utils — extension and observability utilities.
+
+- :mod:`custom_op` — user custom-op registration (the reference's
+  utils/cpp_extension C++ custom-op path, re-designed: a custom op is a
+  pure jnp/pallas function, optionally with a custom VJP).
+- :mod:`monitor` — process-wide stat gauges (reference:
+  platform/monitor.h StatRegistry).
+- :mod:`checkpoint` — auto-checkpointed epoch ranges (reference:
+  incubate/checkpoint/auto_checkpoint.py train_epoch_range).
+"""
+from . import checkpoint, custom_op, monitor  # noqa: F401
+from .checkpoint import train_epoch_range  # noqa: F401
+from .custom_op import register_custom_op  # noqa: F401

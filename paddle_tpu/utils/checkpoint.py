@@ -1,0 +1,103 @@
+"""Auto-checkpointed training ranges.
+
+Reference: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py
+(train_epoch_range:71, TrainEpochRange save/restore:265) — epoch loops
+that snapshot registered state and resume transparently after a restart.
+
+TPU-native: state is whatever exposes ``state_dict``/``set_state_dict``
+(Layers, optimizers, GradScalers, LR schedules); snapshots go through
+``paddle_tpu.save`` (npz pytrees) plus a small json meta, written
+atomically (tmp + rename) so a preemption mid-save can't corrupt the
+latest checkpoint.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterator, Optional
+
+from ..framework_io import load as _load
+from ..framework_io import save as _save
+
+__all__ = ["TrainEpochRange", "train_epoch_range"]
+
+
+class TrainEpochRange:
+    """Iterable of epoch indices with save-on-epoch-end and auto-resume.
+
+    Usage::
+
+        r = TrainEpochRange(10, "ckpt/run1", model=model, opt=opt)
+        for epoch in r:          # resumes after the last finished epoch
+            train_one_epoch(...)
+    """
+
+    def __init__(self, max_epoch_num: int, checkpoint_dir: str,
+                 save_checkpoint_inter: int = 1, **objects):
+        self.max_epoch = int(max_epoch_num)
+        self.dir = checkpoint_dir
+        self.interval = max(1, int(save_checkpoint_inter))
+        self._objects: Dict[str, object] = dict(objects)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def register(self, name: str, obj):
+        """Add a state_dict-bearing object to the snapshot set."""
+        self._objects[name] = obj
+        return self
+
+    # -- persistence -------------------------------------------------------
+    def _meta_path(self):
+        return os.path.join(self.dir, "range_meta.json")
+
+    def _state_path(self, name):
+        return os.path.join(self.dir, f"{name}.pdparams")
+
+    def _load_meta(self) -> Optional[dict]:
+        try:
+            with open(self._meta_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _save(self, epoch: int):
+        for name, obj in self._objects.items():
+            tmp = self._state_path(name) + ".tmp"
+            _save(obj.state_dict(), tmp)
+            os.replace(tmp, self._state_path(name))  # atomic per file
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"finished_epoch": epoch,
+                       "objects": sorted(self._objects)}, f)
+        os.replace(tmp, self._meta_path())  # atomic publish
+
+    def _restore(self) -> int:
+        meta = self._load_meta()
+        if meta is None:
+            return 0
+        for name, obj in self._objects.items():
+            path = self._state_path(name)
+            if os.path.exists(path):
+                obj.set_state_dict(_load(path))
+        return int(meta.get("finished_epoch", -1)) + 1
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self) -> Iterator[int]:
+        start = self._restore()
+        for epoch in range(start, self.max_epoch):
+            yield epoch
+            # body finished without raising: snapshot this epoch
+            if (epoch + 1) % self.interval == 0 or epoch == self.max_epoch - 1:
+                self._save(epoch)
+
+    @property
+    def next_epoch(self) -> int:
+        meta = self._load_meta()
+        return 0 if meta is None else int(meta["finished_epoch"]) + 1
+
+
+def train_epoch_range(max_epoch_num: int, checkpoint_dir: str = "./acp",
+                      save_checkpoint_inter: int = 1,
+                      **objects) -> TrainEpochRange:
+    """reference: auto_checkpoint.py train_epoch_range:71."""
+    return TrainEpochRange(max_epoch_num, checkpoint_dir,
+                           save_checkpoint_inter, **objects)

@@ -1,0 +1,59 @@
+"""Custom-op registration.
+
+Reference: the C++ custom-operator extension path
+(paddle/fluid/framework/custom_operator.cc, python/paddle/utils/
+cpp_extension) where users compile kernels against the framework ABI.
+TPU-native re-design: a custom op is a PURE jnp/lax/Pallas function —
+no ABI, no compilation step; registering it wires it through the shared
+dispatch point so it gets tape recording, AMP casting, profiling, and
+static-graph capture exactly like built-in ops.  A custom backward is a
+``jax.custom_vjp`` pair, usable for ops whose gradient XLA cannot derive
+(e.g. external Pallas kernels).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from ..core.dispatch import apply
+
+__all__ = ["register_custom_op"]
+
+_registry = {}
+
+
+def register_custom_op(name: str, forward: Callable,
+                       backward: Optional[Callable] = None) -> Callable:
+    """Register ``forward(*arrays, **attrs) -> array(s)`` as op ``name``.
+
+    ``backward(res, grad_out) -> tuple(grads)`` with ``res`` the tuple of
+    forward inputs, if given, overrides autodiff via jax.custom_vjp —
+    the analog of defining a GradOpMaker for a C++ custom op.
+
+    Returns the op callable (Tensor in / Tensor out); also registered
+    under ``name`` for lookup via :func:`get_custom_op`.
+    """
+    if backward is not None:
+        core = jax.custom_vjp(forward)
+
+        def fwd(*args, **kw):
+            return forward(*args, **kw), args
+
+        def bwd(res, ct):
+            return tuple(backward(res, ct))
+
+        core.defvjp(fwd, bwd)
+    else:
+        core = forward
+
+    def op(*tensors, **attrs):
+        return apply(core, *tensors, op_name=name, **attrs)
+
+    op.__name__ = name
+    _registry[name] = op
+    return op
+
+
+def get_custom_op(name: str) -> Callable:
+    return _registry[name]

@@ -1,0 +1,64 @@
+"""Process-wide stat gauges.
+
+Reference: paddle/fluid/platform/monitor.h StatRegistry / STAT_ADD —
+integer/float gauges keyed by name, readable for logging and tests."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Union
+
+__all__ = ["StatRegistry", "get_stat", "stat_add", "stat_set",
+           "stat_reset", "all_stats"]
+
+
+class StatRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Union[int, float]] = {}
+
+    def add(self, name: str, v: Union[int, float] = 1):
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0) + v
+            return self._stats[name]
+
+    def set(self, name: str, v: Union[int, float]):
+        with self._lock:
+            self._stats[name] = v
+
+    def get(self, name: str) -> Union[int, float]:
+        with self._lock:
+            return self._stats.get(name, 0)
+
+    def reset(self, name: str = None):
+        with self._lock:
+            if name is None:
+                self._stats.clear()
+            else:
+                self._stats.pop(name, None)
+
+    def snapshot(self) -> Dict[str, Union[int, float]]:
+        with self._lock:
+            return dict(self._stats)
+
+
+_default = StatRegistry()
+
+
+def get_stat(name):
+    return _default.get(name)
+
+
+def stat_add(name, v=1):
+    return _default.add(name, v)
+
+
+def stat_set(name, v):
+    _default.set(name, v)
+
+
+def stat_reset(name=None):
+    _default.reset(name)
+
+
+def all_stats():
+    return _default.snapshot()
