@@ -56,7 +56,10 @@ def build_model(vocab, hidden, layers, heads, ffn, seq, dropout):
 
     class BertMLM(nn.Layer):
         """BERT-base-shaped encoder LM (reference shapes:
-        nn/layer/transformer.py TransformerEncoder; PaddleNLP bert-base)."""
+        nn/layer/transformer.py TransformerEncoder; PaddleNLP bert-base).
+        Forward returns the normalized hidden states; the vocab
+        projection fuses into the loss (F.linear_cross_entropy) so the
+        [tokens, vocab] logits never materialize."""
 
         def __init__(self):
             super().__init__()
@@ -73,7 +76,7 @@ def build_model(vocab, hidden, layers, heads, ffn, seq, dropout):
             pos_ids = paddle.arange(ids.shape[1]).unsqueeze(0)
             x = self.tok(ids) + self.pos(pos_ids)
             x = self.encoder(x)
-            return self.head(self.norm(x))
+            return self.norm(x)
 
     return BertMLM()
 
@@ -107,7 +110,9 @@ def bench_bert(args, dev, on_tpu):
 
     if on_tpu:
         cfg = dict(vocab=30522, hidden=768, layers=12, heads=12, ffn=3072,
-                   seq=512, batch=64, dropout=0.1, attn_dropout=0.1)
+                   seq=512,
+                   batch=int(os.environ.get("BENCH_BERT_BATCH", "64")),
+                   dropout=0.1, attn_dropout=0.1)
         steps = args.steps or 20
         dtype = "bfloat16"
     else:
@@ -133,8 +138,11 @@ def bench_bert(args, dev, on_tpu):
         model, opt = amp.decorate(model, opt, level="O2", dtype=dtype)
 
     def loss_fn(out, labels):
-        return F.cross_entropy(out.reshape([-1, cfg["vocab"]]),
-                               labels.reshape([-1]))
+        # fused chunked head+CE: same math as
+        # cross_entropy(head(out), labels), logits stay chunk-local
+        return F.linear_cross_entropy(
+            out.reshape([-1, cfg["hidden"]]), model.head.weight,
+            model.head.bias, labels.reshape([-1]))
 
     step = TrainStep(model, loss_fn, opt, n_inputs=1, donate=True)
 

@@ -65,6 +65,7 @@ from . import static  # noqa: E402
 from . import inference  # noqa: E402
 from . import profiler  # noqa: E402
 from . import utils  # noqa: E402
+from . import quantization  # noqa: E402
 from .parallel import DataParallel  # noqa: E402
 from .optimizer import regularizer  # noqa: E402
 from .nn.layer_base import ParamAttr  # noqa: E402

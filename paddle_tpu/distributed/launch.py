@@ -44,22 +44,31 @@ def _worker_env(rank: int, nproc: int, coordinator: str, base=None):
 
 def launch(training_script: str, script_args: Optional[List[str]] = None,
            nproc_per_node: int = 1, ips: Optional[str] = None,
-           master_port: int = 6170, log_dir: Optional[str] = None) -> int:
-    """Start ``nproc_per_node`` worker processes running
-    ``training_script`` with the distributed bootstrap env set; watch
-    them, and on any failure terminate the rest (reference:
-    launch_utils.py TrainerProc watch loop).  Returns the first non-zero
-    exit code, or 0."""
+           node_rank: int = 0, master_port: int = 6170,
+           log_dir: Optional[str] = None) -> int:
+    """Start ``nproc_per_node`` LOCAL worker processes of a (possibly
+    multi-host) job with the distributed bootstrap env set; watch them,
+    and on any failure terminate the rest (reference: launch_utils.py
+    TrainerProc watch loop).  Multi-host: run this on every host in
+    ``--ips`` with its own ``--node_rank``; global process ids are
+    ``node_rank * nproc_per_node + local`` over a world of
+    ``len(ips) * nproc_per_node``.  Returns the first non-zero exit
+    code, or 0."""
     script_args = script_args or []
-    host = (ips.split(",")[0] if ips else "127.0.0.1")
-    coordinator = f"{host}:{master_port}"
+    hosts = ips.split(",") if ips else ["127.0.0.1"]
+    coordinator = f"{hosts[0]}:{master_port}"
+    world = len(hosts) * nproc_per_node
+    if not (0 <= node_rank < len(hosts)):
+        raise ValueError(f"node_rank {node_rank} out of range for "
+                         f"{len(hosts)} hosts")
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
 
     procs: List[subprocess.Popen] = []
     logs = []
-    for rank in range(nproc_per_node):
-        env = _worker_env(rank, nproc_per_node, coordinator)
+    for local in range(nproc_per_node):
+        rank = node_rank * nproc_per_node + local
+        env = _worker_env(rank, world, coordinator)
         out = (open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
                if log_dir else None)
         if out is not None:
@@ -67,6 +76,7 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
         procs.append(subprocess.Popen(
             [sys.executable, training_script, *script_args], env=env,
             stdout=out, stderr=(subprocess.STDOUT if out else None)))
+    nproc_per_node = len(procs)
 
     rc = 0
     try:
@@ -127,14 +137,26 @@ def spawn(func, args=(), nprocs: int = 1, join: bool = True, daemon=False,
         procs.append(p)
     if not join:
         return procs
+    # watch ALL children (same discipline as launch()): a failed rank
+    # terminates the rest instead of a sequential join hanging on a peer
+    # blocked in a collective
     rc = 0
-    for p in procs:
-        p.join()
-        rc = rc or (p.exitcode or 0)
+    alive = set(range(nprocs))
+    while alive:
+        for i in list(alive):
+            code = procs[i].exitcode
+            if code is None:
+                continue
+            alive.discard(i)
+            if code != 0:
+                rc = rc or code
+                for j in alive:
+                    procs[j].terminate()
+                for j in alive:
+                    procs[j].join()
+                alive.clear()
+        time.sleep(0.1)
     if rc:
-        for p in procs:
-            if p.is_alive():
-                p.terminate()
         raise RuntimeError(f"spawned worker failed with exit code {rc}")
     return procs
 
@@ -147,6 +169,8 @@ def main():
     ap.add_argument("--nproc_per_node", type=int, default=1)
     ap.add_argument("--ips", type=str, default=None,
                     help="comma-separated host list; first is coordinator")
+    ap.add_argument("--node_rank", type=int, default=0,
+                    help="this host's index into --ips")
     ap.add_argument("--master_port", type=int, default=6170)
     ap.add_argument("--log_dir", type=str, default=None)
     ap.add_argument("training_script")
@@ -154,7 +178,8 @@ def main():
     ns = ap.parse_args()
     sys.exit(launch(ns.training_script, ns.script_args,
                     nproc_per_node=ns.nproc_per_node, ips=ns.ips,
-                    master_port=ns.master_port, log_dir=ns.log_dir))
+                    node_rank=ns.node_rank, master_port=ns.master_port,
+                    log_dir=ns.log_dir))
 
 
 if __name__ == "__main__":

@@ -33,9 +33,11 @@ class ExponentialMovingAverage:
             evaluate(model)
     """
 
-    def __init__(self, decay: float = 0.999, thres_steps: bool = True,
+    def __init__(self, decay: float = 0.999, thres_steps=None,
                  parameters: Optional[List[Parameter]] = None, name=None):
         self._decay = float(decay)
+        # reference default: constant decay (thres_steps=None); truthy
+        # enables the debiasing ramp min(decay, (1+t)/(10+t))
         self._thres = bool(thres_steps)
         self._params = list(parameters or [])
         self._shadow: Dict[int, jnp.ndarray] = {

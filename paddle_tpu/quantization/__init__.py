@@ -1,0 +1,224 @@
+"""paddle.quantization — QAT and PTQ.
+
+Reference: python/paddle/quantization/ (QuantConfig, QAT:quanter.py,
+PTQ:ptq.py) and the slim fake-quant op zoo
+(operators/fake_quantize_op.cc: FakeQuantizeAbsMax,
+FakeChannelWiseQuantizeAbsMax, moving-average abs-max observers).
+
+TPU-native design: quantization is SIMULATED in the graph (quantize →
+dequantize with a straight-through estimator), exactly like the
+reference's fake-quant training ops; the int8 execution engine is XLA's
+(int8 dots lower to the MXU natively).  PTQ observers are plain
+abs-max/moving-average statistics collected during calibration forwards.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = [
+    "fake_quantize_abs_max", "fake_channel_wise_quantize_abs_max",
+    "QuantConfig", "QAT", "PTQ", "QuantizedLinear", "QuantizedConv2D",
+]
+
+
+# ---------------------------------------------------------------------------
+# fake-quant ops (reference: operators/fake_quantize_op.cc)
+# ---------------------------------------------------------------------------
+
+def _ste(a, quantized):
+    """Straight-through estimator over the whole quantize step: the
+    reference's FakeQuantize*Grad ops are pure identity (dx = dout)."""
+    return a + jax.lax.stop_gradient(quantized - a)
+
+
+def _fq_fn(a, *, bits, axis):
+    qmax = float(2 ** (bits - 1) - 1)
+    if axis is None:
+        scale = jnp.max(jnp.abs(a))
+    else:
+        red = tuple(i for i in range(a.ndim) if i != axis)
+        scale = jnp.max(jnp.abs(a), axis=red, keepdims=True)
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(a / scale * qmax, -qmax, qmax))
+    return _ste(a, q * scale / qmax)
+
+
+def fake_quantize_abs_max(x, bit_length: int = 8, name=None):
+    """Per-tensor abs-max fake quant (reference: FakeQuantizeAbsMax)."""
+    return apply(_fq_fn, x, op_name="fake_quantize_abs_max",
+                 cacheable=True, bits=int(bit_length), axis=None)
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length: int = 8,
+                                       quant_axis: int = 0, name=None):
+    """Per-channel abs-max fake quant (reference:
+    FakeChannelWiseQuantizeAbsMax)."""
+    return apply(_fq_fn, x, op_name="fake_channel_wise_quantize_abs_max",
+                 cacheable=True, bits=int(bit_length),
+                 axis=int(quant_axis))
+
+
+def _fq_with_scale_fn(a, scale, *, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(a / scale * qmax, -qmax, qmax))
+    return _ste(a, q * scale / qmax)
+
+
+# ---------------------------------------------------------------------------
+# config + quantized layers
+# ---------------------------------------------------------------------------
+
+class QuantConfig:
+    """reference: quantization/config.py QuantConfig.
+
+    Custom quanter objects (the reference's activation=/weight= quanters)
+    are not supported — the built-in scheme is moving-average abs-max
+    activations + channel-wise abs-max weights; passing quanters raises
+    rather than silently running the wrong scheme."""
+
+    def __init__(self, activation=None, weight=None, weight_bits: int = 8,
+                 activation_bits: int = 8, moving_rate: float = 0.9):
+        if activation is not None or weight is not None:
+            raise NotImplementedError(
+                "custom activation/weight quanters are not supported; use "
+                "weight_bits/activation_bits/moving_rate to configure the "
+                "built-in abs-max scheme")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+
+
+class _QuantWrapper(nn.Layer):
+    """Wraps a layer: fake-quants activations (moving-average abs-max
+    observer, reference: FakeQuantizeMovingAverageAbsMax) and weights
+    (channel-wise abs-max) around the wrapped forward."""
+
+    def __init__(self, layer: nn.Layer, config: QuantConfig,
+                 weight_name: str = "weight"):
+        super().__init__()
+        self._inner = layer
+        self._cfg = config
+        self._weight_name = weight_name
+        self.register_buffer("act_scale", Tensor(jnp.zeros((),
+                                                           jnp.float32)))
+        self._observing = False
+
+    def observe(self, flag: bool = True):
+        self._observing = flag
+        return self
+
+    def forward(self, x):
+        cfg = self._cfg
+        if self._observing:
+            cur = float(jnp.max(jnp.abs(x.data)))
+            prev = float(self.act_scale.data)
+            r = cfg.moving_rate
+            new = cur if prev == 0.0 else (r * prev + (1 - r) * cur)
+            self.act_scale.data = jnp.asarray(new, jnp.float32)
+        if self.training or not self._observing:
+            if float(self.act_scale.data) > 0:
+                x = apply(_fq_with_scale_fn, x, self.act_scale,
+                          op_name="fake_quantize_moving_average_abs_max",
+                          bits=cfg.activation_bits)
+            else:
+                x = fake_quantize_abs_max(x, cfg.activation_bits)
+        w = getattr(self._inner, self._weight_name)
+        w_q = fake_channel_wise_quantize_abs_max(
+            w, cfg.weight_bits,
+            quant_axis=(1 if isinstance(self._inner, nn.Linear) else 0))
+        # run the inner layer with the fake-quantized weight
+        orig = w.data
+        try:
+            w.data = w_q.data
+            return self._inner(x)
+        finally:
+            w.data = orig
+
+
+class QuantizedLinear(_QuantWrapper):
+    def __init__(self, layer: nn.Linear, config: Optional[QuantConfig] = None):
+        super().__init__(layer, config or QuantConfig())
+
+
+class QuantizedConv2D(_QuantWrapper):
+    def __init__(self, layer: nn.Conv2D, config: Optional[QuantConfig] = None):
+        super().__init__(layer, config or QuantConfig())
+
+
+def _swap_quantable(model: nn.Layer, config: QuantConfig) -> List[str]:
+    """Replace Linear/Conv2D sublayers with quant wrappers, in place."""
+    swapped = []
+    for name, child in list(model.named_children()):
+        if isinstance(child, _QuantWrapper):
+            continue
+        if isinstance(child, nn.Linear):
+            setattr(model, name, QuantizedLinear(child, config))
+            swapped.append(name)
+        elif isinstance(child, nn.Conv2D):
+            setattr(model, name, QuantizedConv2D(child, config))
+            swapped.append(name)
+        else:
+            swapped += [f"{name}.{s}" for s in
+                        _swap_quantable(child, config)]
+    return swapped
+
+
+class QAT:
+    """Quantization-aware training (reference: quantization/qat.py).
+
+    ``quanted = QAT(config).quantize(model)`` swaps Linear/Conv2D for
+    fake-quant wrappers; train as usual (STE gradients flow through the
+    rounding), then deploy through jit.save — the fake-quant ops are part
+    of the exported program."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        assert inplace, "QAT.quantize is in-place (pass the model you train)"
+        _swap_quantable(model, self.config)
+        return model
+
+    convert = staticmethod(lambda model: model)  # fake-quant stays in-graph
+
+
+class PTQ:
+    """Post-training quantization (reference: quantization/ptq.py).
+
+    ``q = PTQ(config).quantize(model)`` inserts observers;
+    run calibration batches through the model, then ``PTQ.convert(q)``
+    freezes the observed activation scales (weights quantize from their
+    values directly)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        assert inplace, "PTQ.quantize is in-place"
+        _swap_quantable(model, self.config)
+        for w in _wrappers(model):
+            w.observe(True)
+        model.eval()
+        return model
+
+    @staticmethod
+    def convert(model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        for w in _wrappers(model):
+            w.observe(False)
+        return model
+
+
+def _wrappers(model):
+    out = []
+    for child in model.sublayers(include_self=True):
+        if isinstance(child, _QuantWrapper):
+            out.append(child)
+    return out

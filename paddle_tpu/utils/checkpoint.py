@@ -49,9 +49,6 @@ class TrainEpochRange:
     def _meta_path(self):
         return os.path.join(self.dir, "range_meta.json")
 
-    def _state_path(self, name):
-        return os.path.join(self.dir, f"{name}.pdparams")
-
     def _load_meta(self) -> Optional[dict]:
         try:
             with open(self._meta_path()) as f:
@@ -60,22 +57,34 @@ class TrainEpochRange:
             return None
 
     def _save(self, epoch: int):
+        # stage the WHOLE snapshot in an epoch directory, then publish it
+        # atomically through the meta: a preemption at any point leaves
+        # either the previous complete snapshot or the new complete one —
+        # never a mixed-epoch state
+        snap = f"epoch_{epoch}"
+        sdir = os.path.join(self.dir, snap)
+        os.makedirs(sdir, exist_ok=True)
         for name, obj in self._objects.items():
-            tmp = self._state_path(name) + ".tmp"
-            _save(obj.state_dict(), tmp)
-            os.replace(tmp, self._state_path(name))  # atomic per file
+            _save(obj.state_dict(), os.path.join(sdir, f"{name}.pdparams"))
         tmp = self._meta_path() + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"finished_epoch": epoch,
+            json.dump({"finished_epoch": epoch, "snapshot": snap,
                        "objects": sorted(self._objects)}, f)
         os.replace(tmp, self._meta_path())  # atomic publish
+        # prune superseded snapshots
+        import shutil
+        for d in os.listdir(self.dir):
+            if d.startswith("epoch_") and d != snap:
+                shutil.rmtree(os.path.join(self.dir, d),
+                              ignore_errors=True)
 
     def _restore(self) -> int:
         meta = self._load_meta()
         if meta is None:
             return 0
+        sdir = os.path.join(self.dir, meta.get("snapshot", ""))
         for name, obj in self._objects.items():
-            path = self._state_path(name)
+            path = os.path.join(sdir, f"{name}.pdparams")
             if os.path.exists(path):
                 obj.set_state_dict(_load(path))
         return int(meta.get("finished_epoch", -1)) + 1

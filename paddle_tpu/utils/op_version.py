@@ -1,0 +1,39 @@
+"""Op version registry.
+
+Reference: paddle/fluid/framework/op_version_registry.h — per-op version
+numbers + change notes consumed by model-compat checks at load time.
+Here versions ride in the jit.save / save_inference_model meta (StableHLO
+itself is the version-stable serialization layer, so this registry is
+metadata for humans and compat tooling, not a kernel selector)."""
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple
+
+__all__ = ["OpVersion", "register_op_version", "get_op_version",
+           "all_op_versions"]
+
+
+class OpVersion(NamedTuple):
+    version: int
+    notes: List[str]
+
+
+_registry: Dict[str, OpVersion] = {}
+
+
+def register_op_version(op_name: str, version: int = 1, note: str = ""):
+    prev = _registry.get(op_name)
+    notes = (list(prev.notes) if prev else [])
+    if note:
+        notes.append(note)
+    _registry[op_name] = OpVersion(version, notes)
+    return _registry[op_name]
+
+
+def get_op_version(op_name: str) -> int:
+    v = _registry.get(op_name)
+    return v.version if v else 0
+
+
+def all_op_versions() -> Dict[str, int]:
+    return {k: v.version for k, v in _registry.items()}

@@ -330,3 +330,40 @@ def test_sublayer_non_persistable_buffer_excluded():
     sd = Top().state_dict()
     assert "sub.scratch" not in sd
     assert "sub.kept" in sd and "kept" in sd
+
+
+def test_linear_cross_entropy_matches_unfused():
+    import numpy as np
+    paddle.seed(33)
+    T, H, V = 32, 16, 50
+    h = paddle.randn([T, H]); h.stop_gradient = False
+    w = paddle.randn([H, V]); w.stop_gradient = False
+    b = paddle.zeros([V]); b.stop_gradient = False
+    lab = paddle.to_tensor(np.random.RandomState(0).randint(0, V, (T,)))
+
+    fused = F.linear_cross_entropy(h, w, b, lab, chunk=8)
+    ref = F.cross_entropy(h.matmul(w) + b, lab)
+    np.testing.assert_allclose(float(fused), float(ref), rtol=1e-5)
+
+    fused.backward()
+    gh, gw = h.grad.numpy().copy(), w.grad.numpy().copy()
+    h2 = h.detach(); h2.stop_gradient = False
+    w2 = w.detach(); w2.stop_gradient = False
+    b2 = b.detach(); b2.stop_gradient = False
+    F.cross_entropy(h2.matmul(w2) + b2, lab).backward()
+    np.testing.assert_allclose(gh, h2.grad.numpy(), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gw, w2.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+
+def test_linear_cross_entropy_ignore_index():
+    import numpy as np
+    paddle.seed(34)
+    h = paddle.randn([8, 4])
+    w = paddle.randn([4, 10])
+    b = paddle.zeros([10])
+    lab = np.random.RandomState(1).randint(0, 10, (8,))
+    lab[::2] = -100
+    fused = F.linear_cross_entropy(h, w, b, paddle.to_tensor(lab), chunk=4)
+    ref = F.cross_entropy(h.matmul(w) + b, paddle.to_tensor(lab),
+                          ignore_index=-100)
+    np.testing.assert_allclose(float(fused), float(ref), rtol=1e-5)

@@ -1,0 +1,71 @@
+"""paddle.quantization tests (reference analogs: test_quant_aware.py,
+test_post_training_quantization_*): fake-quant numerics, STE gradients,
+QAT training, PTQ calibrate->convert accuracy."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, optimizer
+from paddle_tpu.quantization import (PTQ, QAT, QuantConfig,
+                                     fake_quantize_abs_max)
+
+
+def test_fake_quant_roundtrip_accuracy():
+    paddle.seed(0)
+    x = paddle.randn([64, 32])
+    q = fake_quantize_abs_max(x, bit_length=8)
+    err = np.abs(q.numpy() - x.numpy()).max()
+    step = np.abs(x.numpy()).max() / 127
+    assert err <= step * 0.51 + 1e-7  # within half a quant step
+
+
+def test_fake_quant_ste_gradient():
+    x = paddle.to_tensor(np.linspace(-1, 1, 16).astype(np.float32),
+                         stop_gradient=False)
+    fake_quantize_abs_max(x).sum().backward()
+    # straight-through: gradient of round is identity inside the range
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(16), rtol=1e-5)
+
+
+def test_qat_model_trains():
+    paddle.seed(1)
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 1))
+    QAT(QuantConfig()).quantize(model)
+    opt = optimizer.Adam(learning_rate=0.01,
+                         parameters=model.parameters())
+    x = paddle.randn([64, 8])
+    y = x.matmul(paddle.randn([8, 1]))
+    losses = []
+    for _ in range(50):
+        loss = F.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+def test_ptq_calibrate_convert_close_to_float():
+    paddle.seed(2)
+    fl = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    x = paddle.randn([32, 16])
+    ref = fl(x).numpy()
+
+    q = PTQ().quantize(fl)
+    for _ in range(4):   # calibration forwards
+        q(x)
+    PTQ.convert(q)
+    got = q(x).numpy()
+    denom = np.abs(ref).max()
+    assert np.abs(got - ref).max() / denom < 0.1, (
+        np.abs(got - ref).max() / denom)
+
+
+def test_qat_conv_swap():
+    paddle.seed(3)
+    from paddle_tpu.quantization import QuantizedConv2D
+    m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU())
+    QAT().quantize(m)
+    assert isinstance(m[0], QuantizedConv2D)
+    out = m(paddle.randn([2, 3, 8, 8]))
+    assert out.shape == [2, 8, 8, 8]
