@@ -49,10 +49,10 @@ class Node:
 
     __slots__ = ("seq", "inputs", "in_ids", "in_leaf", "in_nodes", "vjp_fn",
                  "out_ids", "out_avals", "n_outs", "out_is_tuple",
-                 "__weakref__")
+                 "replay", "__weakref__")
 
     def __init__(self, inputs, vjp_fn, out_ids, out_avals,
-                 out_is_tuple=False):
+                 out_is_tuple=False, replay=None):
         self.seq = next(_seq)
         self.inputs = inputs            # strong refs: leaves need .grad deposit
         self.in_ids = [t._bw_id for t in inputs]
@@ -63,6 +63,11 @@ class Node:
         self.out_avals = out_avals      # (shape, dtype) per output
         self.n_outs = len(out_ids)
         self.out_is_tuple = out_is_tuple
+        # (fn, kw, diff_idx, arrays): enough to re-derive this op's vjp as
+        # a recordable op — the create_graph/double-backward path
+        # (reference analog: partial_grad_engine.cc re-runs grad ops
+        # through the tracer)
+        self.replay = replay
 
 
 _tls = threading.local()
@@ -93,11 +98,18 @@ def enable_grad():
         _tls.grad_enabled = prev
 
 
-def _zero_cotangent(shape, dtype):
+def _is_float_dtype(dtype) -> bool:
+    # jax.dtypes covers ml_dtypes extended floats (bfloat16/fp8), which
+    # np.issubdtype misclassifies as non-float
     d = np.dtype(dtype)
-    if not (np.issubdtype(d, np.floating) or np.issubdtype(d, np.complexfloating)):
+    return (jax.dtypes.issubdtype(d, np.floating)
+            or jax.dtypes.issubdtype(d, np.complexfloating))
+
+
+def _zero_cotangent(shape, dtype):
+    if not _is_float_dtype(dtype):
         return np.zeros(shape, jax.dtypes.float0)
-    return np.zeros(shape, d)
+    return np.zeros(shape, np.dtype(dtype))
 
 
 def _collect(roots) -> List[Node]:
@@ -141,6 +153,7 @@ def _sweep(nodes, cot, retain_graph, want=None, results=None,
                    else node.vjp_fn(out_cots[0]))
         if not retain_graph:
             node.vjp_fn = None
+            node.replay = None  # frees the pinned input arrays too
         for tin, bid, leaf, g in zip(node.inputs, node.in_ids,
                                      node.in_leaf, in_cots):
             if g is None or tin is None:
@@ -162,6 +175,96 @@ def _sweep(nodes, cot, retain_graph, want=None, results=None,
                     tin._grad_data = tin._grad_data + g
             if not leaf or want is not None:
                 cot[bid] = (cot[bid] + g) if bid in cot else g
+
+
+def _make_grad_op(node):
+    """Build a pure op computing node's input cotangents from (diff
+    inputs, float-output cotangents) — recordable through the dispatch
+    point, which is what makes grad-of-grad work."""
+    import jax.numpy as jnp
+
+    fn, kw, diff_idx, arrays = node.replay
+    k = len(diff_idx)
+    float_out = [_is_float_dtype(d) for _, d in node.out_avals]
+
+    def grad_op(*args):
+        diff_arrays = args[:k]
+        ct_in = list(args[k:])
+        cts = []
+        for (shape, dt), is_f in zip(node.out_avals, float_out):
+            if is_f:
+                cts.append(jnp.asarray(ct_in.pop(0), dt))
+            else:
+                cts.append(np.zeros(shape, jax.dtypes.float0))
+
+        def f(*d):
+            full = list(arrays)
+            for j, a in zip(diff_idx, d):
+                full[j] = a
+            return fn(*full, **kw)
+
+        _, pull = jax.vjp(f, *diff_arrays)
+        gs = pull(tuple(cts) if node.out_is_tuple else cts[0])
+        return gs if len(gs) > 1 else gs[0]
+
+    return grad_op, float_out
+
+
+def _sweep_higher(nodes, cot, want, results):
+    """create_graph sweep: cotangents are TENSORS and every vjp runs as a
+    recorded op, so the result carries its own grad graph (reference:
+    imperative/partial_grad_engine.cc create_graph mode)."""
+    import jax.numpy as jnp
+    from .dispatch import apply
+    from .tensor import Tensor
+
+    for node in nodes:
+        if not any(oid in cot for oid in node.out_ids):
+            continue
+        if node.replay is None:
+            raise UnimplementedError(
+                f"create_graph=True through op without a replayable "
+                f"gradient (custom sparse/manual node)")
+        for oid in node.out_ids:
+            if oid in want and oid in cot:
+                i = want[oid]
+                results[i] = (cot[oid] if results[i] is None
+                              else results[i] + cot[oid])
+        grad_op, float_out = _make_grad_op(node)
+        ct_args = []
+        for (shape, dt), oid, is_f in zip(node.out_avals, node.out_ids,
+                                          float_out):
+            if not is_f:
+                continue
+            t = cot.pop(oid, None)
+            ct_args.append(t if t is not None
+                           else Tensor(jnp.zeros(shape, dt)))
+        # differentiate at the SNAPSHOTTED forward values (an in-place
+        # _rebind may have repointed the live tensors), while keeping the
+        # originals' autograd identity so third-order chains route
+        _, _, diff_idx, arrays = node.replay
+        snap_inputs, orig_of = [], {}
+        for tin, j in zip(node.inputs, diff_idx):
+            t = Tensor(arrays[j], stop_gradient=tin.stop_gradient,
+                       _produced=tin._produced)
+            t._bw_id = tin._bw_id
+            t._node = tin._node
+            snap_inputs.append(t)
+            orig_of[id(t)] = tin
+        with enable_grad():
+            outs = apply(grad_op, *(snap_inputs + ct_args),
+                         op_name="grad_of_grad")
+        # the recorded grad node must deposit into the ORIGINAL tensors
+        # (the snapshots only pin the forward-time values)
+        first = outs[0] if isinstance(outs, tuple) else outs
+        if first._node is not None:
+            first._node.inputs = [orig_of.get(id(t), t)
+                                  for t in first._node.inputs]
+        in_cots = list(outs) if isinstance(outs, tuple) else [outs]
+        for tin, bid, g in zip(node.inputs, node.in_ids, in_cots):
+            if g is None:
+                continue
+            cot[bid] = (cot[bid] + g) if bid in cot else g
 
 
 def backward(tensor, grad=None, retain_graph: bool = False):
@@ -199,12 +302,6 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     from .tensor import Tensor
     import jax.numpy as jnp
 
-    if create_graph:
-        raise UnimplementedError(
-            "create_graph=True (double backward) is not supported by the "
-            "eager tape; use the functional jit path (paddle_tpu.jit) with "
-            "jax.grad composition for higher-order derivatives.")
-
     outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     gouts = grad_outputs if isinstance(grad_outputs, (list, tuple)) else (
@@ -223,6 +320,26 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     results: List[Optional[Any]] = [None] * len(ins)
 
     nodes = _collect([o._node for o in outs])
+    if create_graph:
+        # cotangents become Tensors and every vjp is a recorded op — the
+        # returned grads carry their own graph for a second backward
+        cot_t = {bid: Tensor(g, stop_gradient=True)
+                 for bid, g in cot.items()}
+        _sweep_higher(nodes, cot_t, want, results)
+        for bid, i in want.items():
+            if bid in cot_t and results[i] is None:
+                results[i] = cot_t[bid]
+        out_tensors = [None if (r is None or ins[i]._bw_id in skip_ids)
+                       else r for i, r in enumerate(results)]
+        if not allow_unused:
+            for i, r in enumerate(out_tensors):
+                if r is None:
+                    raise RuntimeError(
+                        f"Input {i} is unreachable from outputs; pass "
+                        f"allow_unused=True to get None instead.")
+        return (out_tensors if isinstance(inputs, (list, tuple))
+                else out_tensors[0])
+
     with no_grad():
         _sweep(nodes, cot, retain_graph, want=want, results=results)
 

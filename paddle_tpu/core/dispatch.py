@@ -180,6 +180,7 @@ def apply(fn: Callable, *inputs, op_name: str | None = None,
             out_ids=[t._bw_id for t in out_tensors],
             out_avals=[(t.shape_tuple, np.dtype(t.data.dtype)) for t in out_tensors],
             out_is_tuple=multi,
+            replay=(fn, kw, tuple(diff_idx), tuple(arrays)),
         )
         for t in out_tensors:
             t._node = node

@@ -125,3 +125,6 @@ class _DatasetsNS:
 
 
 datasets = _DatasetsNS()
+
+
+from .fast_tokenizer import FastWordPieceTokenizer  # noqa: F401,E402

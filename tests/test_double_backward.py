@@ -1,0 +1,83 @@
+"""Double-backward (create_graph) tests — the reference's
+partial_grad_engine create_graph mode (WGAN-GP-style gradient penalties)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, optimizer
+
+
+def test_second_derivative_scalar():
+    x = paddle.to_tensor(np.array(3.0, np.float32), stop_gradient=False)
+    y = x * x * x                       # y = x^3
+    g1, = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(float(g1), 27.0)      # 3x^2
+    g2, = paddle.grad(g1, [x])
+    np.testing.assert_allclose(float(g2), 18.0)      # 6x
+
+
+def test_grad_of_grad_through_network():
+    paddle.seed(0)
+    lin = nn.Linear(4, 1)
+    x = paddle.randn([8, 4]); x.stop_gradient = False
+    out = F.tanh(lin(x)).sum()
+    gx, = paddle.grad(out, [x], create_graph=True)
+    gp = (gx * gx).sum()                # gradient penalty
+    gw, = paddle.grad(gp, [lin.weight])
+    assert gw is not None and np.isfinite(gw.numpy()).all()
+    assert float(np.abs(gw.numpy()).sum()) > 0
+
+
+def test_gradient_penalty_training_step():
+    """WGAN-GP-shaped loss actually trains (the VERDICT round-2 use case
+    that previously raised Unimplemented)."""
+    paddle.seed(1)
+    critic = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = optimizer.Adam(learning_rate=1e-3,
+                         parameters=critic.parameters())
+    for i in range(5):
+        x = paddle.randn([16, 4]); x.stop_gradient = False
+        score = critic(x).sum()
+        gx, = paddle.grad(score, [x], create_graph=True)
+        norm = (gx * gx).sum(axis=1).sqrt()
+        loss = -score / 16.0 + ((norm - 1.0) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.isfinite(float(loss))
+
+
+def test_create_graph_matches_jax_oracle():
+    import jax
+    import jax.numpy as jnp
+    a = np.random.RandomState(0).randn(6).astype(np.float32)
+
+    def f(v):
+        return jnp.sum(jnp.sin(v) * v)
+
+    expect = jax.grad(lambda v: jnp.sum(jax.grad(f)(v) ** 2))(a)
+
+    x = paddle.to_tensor(a, stop_gradient=False)
+    out = (x.sin() * x).sum()
+    g1, = paddle.grad(out, [x], create_graph=True)
+    (g1 * g1).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.asarray(expect),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_create_graph_bf16_intermediate():
+    """bf16 intermediates (TPU AMP) must not break double backward."""
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x * x).astype("bfloat16").astype("float32").sum()
+    g1, = paddle.grad(y, [x], create_graph=True)
+    g2, = paddle.grad(g1.sum(), [x])
+    np.testing.assert_allclose(float(g2), 2.0, rtol=1e-2)
+
+
+def test_first_backward_frees_replay():
+    x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    y = (x * 3.0).sum()
+    node = y._node
+    assert node.replay is not None
+    y.backward()
+    assert node.replay is None and node.vjp_fn is None

@@ -1,0 +1,75 @@
+"""Native fast tokenizer tests (reference analog: fast_tokenizer /
+faster_tokenizer op tests): C++/Python parity, framing, threading."""
+import numpy as np
+import pytest
+
+from paddle_tpu.text import FastWordPieceTokenizer
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "brown",
+         "fox", "jump", "##ed", "##s", "over", "lazy", "dog", ",", ".",
+         "un", "##believ", "##able"]
+
+
+def _tok(**kw):
+    return FastWordPieceTokenizer(VOCAB, **kw)
+
+
+def test_native_builds_and_matches_python_oracle():
+    native = _tok()
+    py = _tok(use_native=False)
+    texts = ["The quick brown fox jumped over the lazy dog.",
+             "unbelievable, jumps!",
+             "",
+             "THE UNBELIEVABLE FOX",
+             "xyzzy plugh"]
+    a, la = native.encode_batch(texts, max_len=16)
+    b, lb = py.encode_batch(texts, max_len=16)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(la, lb)
+
+
+def test_wordpiece_continuation_and_framing():
+    t = _tok(use_native=False)
+    ids, lens = t.encode_batch(["unbelievable"], max_len=8)
+    v = t.vocab
+    assert ids[0, 0] == v["[CLS]"]
+    assert list(ids[0, 1:4]) == [v["un"], v["##believ"], v["##able"]]
+    assert ids[0, 4] == v["[SEP]"]
+    assert ids[0, 5] == v["[PAD]"]
+    assert lens[0] == 5
+
+
+def test_unknown_word_is_unk():
+    t = _tok(use_native=False)
+    ids, _ = t.encode_batch(["xyzzy"], max_len=8)
+    assert ids[0, 1] == t.unk_id
+
+
+def test_truncation():
+    t = _tok()
+    long = " ".join(["fox"] * 100)
+    ids, lens = t.encode_batch([long], max_len=16)
+    assert lens[0] == 16
+    assert ids[0, -1] == t.vocab["[SEP]"]
+
+
+def test_multithreaded_batch_consistent():
+    t = _tok()
+    if not t.is_native:
+        pytest.skip("no native tokenizer on this machine")
+    texts = ["the quick brown fox"] * 257 + ["unbelievable dog ."] * 255
+    a, _ = t.encode_batch(texts, max_len=12, n_threads=8)
+    b, _ = t.encode_batch(texts, max_len=12, n_threads=1)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_empty_batch_and_unicode_parity():
+    t = _tok()
+    ids, lens = t.encode_batch([], max_len=8)
+    assert ids.shape == (0, 8)
+    py = _tok(use_native=False)
+    texts = ["a\xa0b", "café FOX", "Énorme"]
+    if t.is_native:
+        a, _ = t.encode_batch(texts, max_len=8)
+        b, _ = py.encode_batch(texts, max_len=8)
+        np.testing.assert_array_equal(a, b)
