@@ -8,7 +8,8 @@ plays — while keeping the dygraph-style API."""
 from .model import Model  # noqa: F401
 from . import callbacks  # noqa: F401
 from .callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa
-                        ModelCheckpoint, ProgBarLogger)
+                        ModelCheckpoint, ProgBarLogger,
+                        ReduceLROnPlateau, VisualDL)
 
 
 def summary(net, input_size=None, dtypes=None):

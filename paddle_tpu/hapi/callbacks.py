@@ -158,6 +158,117 @@ class LRScheduler(Callback):
             s.step()
 
 
+class ReduceLROnPlateau(Callback):
+    """Reduce lr when a monitored metric stops improving (reference:
+    hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="min", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._best = None
+        self._wait = 0
+        self._cool = 0
+        self._saw_eval = False
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self.mode == "min":
+            return cur < self._best - self.min_delta
+        return cur > self._best + self.min_delta
+
+    # check ONCE per epoch: eval logs when evaluation runs, else train
+    # logs (the reference checks a single monitored stream)
+    def on_eval_end(self, logs=None):
+        self._saw_eval = True
+        self._check(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self._saw_eval:
+            self._check(logs)
+
+    def _check(self, logs):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        if self._cool > 0:
+            self._cool -= 1
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                sched = getattr(opt, "_lr_scheduler", None)
+                if sched is not None:
+                    import warnings
+                    warnings.warn(
+                        "ReduceLROnPlateau callback skipped: the "
+                        "optimizer drives an LRScheduler; use "
+                        "optimizer.lr.ReduceOnPlateau as the scheduler "
+                        "instead")
+                else:
+                    old = float(opt.get_lr())
+                    new = max(old * self.factor, self.min_lr)
+                    if new < old:
+                        opt._learning_rate = new
+                        if self.verbose:
+                            print(f"ReduceLROnPlateau: lr {old:g} -> "
+                                  f"{new:g}")
+            self._wait = 0
+            self._cool = self.cooldown
+
+
+class VisualDL(Callback):
+    """Scalar logger (reference: hapi VisualDL callback).  The VisualDL
+    writer is GPU-ecosystem tooling; here scalars append to a JSONL file
+    readable by any dashboard."""
+
+    def __init__(self, log_dir="./vdl_log"):
+        super().__init__()
+        import os
+        os.makedirs(log_dir, exist_ok=True)
+        self._path = os.path.join(log_dir, "scalars.jsonl")
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+        logs = logs or {}
+        rows = []
+        for k, v in logs.items():
+            try:
+                v = float(v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+            rows.append({"tag": f"{tag}/{k}", "step": self._step,
+                         "value": v})
+        if rows:
+            with open(self._path, "a") as f:
+                for r in rows:
+                    f.write(json.dumps(r) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
                      verbose=2, metrics=None):
     cbks = list(callbacks or [])

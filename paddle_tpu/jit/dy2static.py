@@ -1,0 +1,224 @@
+"""dy2static AST conversion: Python ``if`` on tensor predicates → cond.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/ — the reference
+ships 20+ AST transformers (ifelse_transformer.py,
+loop_transformer.py, ...) rewriting user Python into ProgramDesc ops.
+TPU-native stance: tracing handles everything EXCEPT genuine
+data-dependent Python control flow, so only that needs rewriting.  This
+module converts the two ubiquitous patterns:
+
+1. ``if cond: <assignments>  else: <assignments>`` where both branches
+   assign the same simple names → both branches become closures returning
+   those names, dispatched through :func:`_jst_cond`;
+2. ``if cond: return A`` followed by ``return B`` (and the two-armed
+   ``if/else`` with lone returns) → ``return _jst_cond(cond, ...)``.
+
+``_jst_cond`` preserves EAGER semantics exactly (a concrete/bool
+predicate runs one branch in Python); only traced tensor predicates lower
+to ``lax.cond``.  Anything the transformer cannot prove convertible is
+left untouched — an unconverted tensor ``if`` still raises the loud
+trace-time error pointing at paddle.cond (no silent mistracing).
+``while`` loops are not converted (use paddle.while_loop; XLA's While has
+no reverse-mode adjoint, so auto-converting could silently break
+training).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable, List, Optional, Set
+
+__all__ = ["convert_control_flow", "_jst_cond"]
+
+
+def _jst_cond(pred, true_fn, false_fn):
+    """Runtime dispatch for converted ifs: Python branch when the
+    predicate is concrete, paddle.cond when traced."""
+    from ..core.tensor import Tensor
+    import jax
+
+    p = pred.data if isinstance(pred, Tensor) else pred
+    if isinstance(p, jax.core.Tracer):
+        from ..ops.control_flow import cond
+        return cond(pred, true_fn, false_fn)
+    return true_fn() if p else false_fn()
+
+
+def _loads(node) -> Set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _assigned_names(stmts: List[ast.stmt]) -> Optional[Set[str]]:
+    """Simple names assigned by ``stmts``; None if anything non-trivial
+    (aug-assign, attribute/subscript targets, nested control flow, or a
+    read of a to-be-assigned name before its assignment — which would
+    become an UnboundLocalError inside the branch closure)."""
+    names: Set[str] = set()
+    all_assigned: Set[str] = set()
+    for s in stmts:
+        if isinstance(s, ast.Assign):
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    all_assigned.add(t.id)
+                elif isinstance(t, ast.Tuple) and all(
+                        isinstance(e, ast.Name) for e in t.elts):
+                    all_assigned.update(e.id for e in t.elts)
+                else:
+                    return None
+        elif not isinstance(s, ast.Expr):
+            return None
+    assigned_so_far: Set[str] = set()
+    for s in stmts:
+        if isinstance(s, ast.Assign):
+            # reading a name this branch assigns LATER (incl. this stmt's
+            # own target, `x = x + 1`) would hit the closure-local unbound
+            if (_loads(s.value) & all_assigned) - assigned_so_far:
+                return None
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    assigned_so_far.add(t.id)
+                else:
+                    assigned_so_far.update(e.id for e in t.elts)
+            names = assigned_so_far
+        elif isinstance(s, ast.Expr):
+            if (_loads(s) & all_assigned) - assigned_so_far:
+                return None
+    return set(names)
+
+
+class _IfElseTransformer(ast.NodeTransformer):
+    """reference: dygraph_to_static/ifelse_transformer.py."""
+
+    def __init__(self):
+        self.count = 0
+        self.converted = 0
+
+    # -- pattern 2: early return --------------------------------------------
+    def _convert_return_pair(self, test, a_ret, b_ret):
+        self.converted += 1
+        t = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=a_ret.value or ast.Constant(None))
+        f = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=b_ret.value or ast.Constant(None))
+        call = ast.Call(func=ast.Name("_jst_cond", ast.Load()),
+                        args=[test, t, f], keywords=[])
+        return ast.Return(value=call)
+
+    def _rewrite_body(self, body: List[ast.stmt]) -> List[ast.stmt]:
+        out: List[ast.stmt] = []
+        i = 0
+        while i < len(body):
+            s = body[i]
+            if isinstance(s, ast.If):
+                nxt = body[i + 1] if i + 1 < len(body) else None
+                # `if c: return A` / `return B`  (tail follows the if)
+                if (len(s.body) == 1 and isinstance(s.body[0], ast.Return)
+                        and not s.orelse and isinstance(nxt, ast.Return)):
+                    out.append(self._convert_return_pair(
+                        s.test, s.body[0], nxt))
+                    i += 2
+                    continue
+                # `if c: return A else: return B`
+                if (len(s.body) == 1 and isinstance(s.body[0], ast.Return)
+                        and len(s.orelse) == 1
+                        and isinstance(s.orelse[0], ast.Return)):
+                    out.append(self._convert_return_pair(
+                        s.test, s.body[0], s.orelse[0]))
+                    i += 1
+                    continue
+                conv = self._convert_assign_if(s)
+                if conv is not None:
+                    out.extend(conv)
+                    i += 1
+                    continue
+            out.append(s)
+            i += 1
+        return out
+
+    # -- pattern 1: both-branch assignments ---------------------------------
+    def _convert_assign_if(self, node: ast.If) -> Optional[List[ast.stmt]]:
+        if not node.orelse:
+            return None
+        a = _assigned_names(node.body)
+        b = _assigned_names(node.orelse)
+        if not a or a != b:
+            return None
+        targets = sorted(a)
+        self.count += 1
+        n = self.count
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(t, ast.Load()) for t in targets],
+            ctx=ast.Load()))
+
+        def mk(name, stmts):
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=list(stmts) + [ret], decorator_list=[])
+
+        call = ast.Call(func=ast.Name("_jst_cond", ast.Load()),
+                        args=[node.test,
+                              ast.Name(f"__jst_true_{n}", ast.Load()),
+                              ast.Name(f"__jst_false_{n}", ast.Load())],
+                        keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(t, ast.Store()) for t in targets],
+                ctx=ast.Store())],
+            value=call)
+        self.converted += 1
+        return [mk(f"__jst_true_{n}", node.body),
+                mk(f"__jst_false_{n}", node.orelse), assign]
+
+    def visit_FunctionDef(self, node):
+        self.generic_visit(node)
+        node.body = self._rewrite_body(node.body)
+        return node
+
+
+def convert_control_flow(fn: Callable) -> Callable:
+    """Return ``fn`` with convertible tensor-``if`` patterns rewritten to
+    paddle.cond dispatch; returns ``fn`` unchanged when no pattern
+    converts or the source is unavailable (lambdas, C funcs, REPL)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []  # run undecorated (to_static wraps us)
+    tr = _IfElseTransformer()
+    tr.visit(tree)
+    if not tr.converted:
+        return fn
+    ast.fix_missing_locations(tree)
+    try:
+        code = compile(tree, f"<dy2static {fn.__qualname__}>", "exec")
+    except (ValueError, SyntaxError):  # pragma: no cover - defensive
+        return fn
+    glb = dict(fn.__globals__)
+    glb["_jst_cond"] = _jst_cond
+    # snapshot closure cells into globals (documented limitation: the
+    # converted function sees decoration-time closure values)
+    if fn.__closure__:
+        try:
+            glb.update({k: c.cell_contents
+                        for k, c in zip(fn.__code__.co_freevars,
+                                        fn.__closure__)})
+        except ValueError:  # empty cell (helper defined later): skip
+            return fn
+    loc: dict = {}
+    exec(code, glb, loc)
+    new_fn = loc[fdef.name]
+    functools.update_wrapper(new_fn, fn)
+    return new_fn

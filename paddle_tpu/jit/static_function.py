@@ -60,7 +60,24 @@ class StaticFunction:
         self._layer = layer if layer is not None else _find_layer(fn)
         self._input_spec = input_spec
         self._cache: Dict[Any, Callable] = {}
+        self._fn = self._convert_control_flow(self._fn)
         functools.update_wrapper(self, self._fn)
+
+    @staticmethod
+    def _convert_control_flow(fn):
+        """AST-convert data-dependent Python `if` patterns to paddle.cond
+        (dy2static.py); unconvertible code is left untouched and still
+        fails loudly at trace time rather than mistracing."""
+        import inspect
+
+        from .dy2static import convert_control_flow
+        raw = fn.__func__ if inspect.ismethod(fn) else fn
+        conv = convert_control_flow(raw)
+        if conv is raw:
+            return fn
+        if inspect.ismethod(fn):
+            return conv.__get__(fn.__self__)
+        return conv
 
     @property
     def layer(self):

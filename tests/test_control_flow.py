@@ -71,11 +71,13 @@ def test_cond_traced_grad_parity():
 def test_python_if_on_traced_tensor_raises_loudly():
     @jit.to_static
     def fn(x):
-        if x.sum() > 0:  # trace-time unresolvable
-            return x * 2
-        return x
+        out = x
+        while x.sum() > 0:  # dy2static does not convert while: loud error
+            out = out * 2
+            x = x - 1
+        return out
 
-    with pytest.raises(TypeError, match="paddle.cond"):
+    with pytest.raises(TypeError, match="paddle.while_loop"):
         fn(paddle.ones([2]))
 
 

@@ -1,0 +1,165 @@
+"""dy2static AST conversion tests (reference analog:
+dygraph_to_static/test_ifelse.py): Python `if` on tensor predicates is
+rewritten to cond inside to_static; eager semantics are untouched."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+
+
+def test_if_else_assignment_pattern_converts():
+    @jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y + 10
+
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(f(a).numpy(), [12.0, 14.0])
+    np.testing.assert_allclose(f(b).numpy(), [8.0, 7.0])  # same compiled fn
+
+
+def test_early_return_pattern_converts():
+    @jit.to_static
+    def relu_ish(x):
+        if x.sum() > 0:
+            return x
+        return -x
+
+    a = paddle.to_tensor(np.array([3.0], np.float32))
+    b = paddle.to_tensor(np.array([-3.0], np.float32))
+    assert float(relu_ish(a)) == 3.0
+    assert float(relu_ish(b)) == 3.0
+
+
+def test_if_return_else_return_converts():
+    @jit.to_static
+    def pick(x):
+        if x.mean() > 0:
+            return x * 10
+        else:
+            return x * 100
+
+    assert float(pick(paddle.to_tensor(np.array([1.0], np.float32)))) == 10.0
+    assert float(pick(paddle.to_tensor(np.array([-1.0], np.float32)))) == -100.0
+
+
+def test_multi_assign_both_branches():
+    @jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            a = x + 1
+            b = x * 2
+        else:
+            a = x - 1
+            b = x / 2
+        return a + b
+
+    v = paddle.to_tensor(np.array([2.0], np.float32))
+    np.testing.assert_allclose(float(f(v)), 7.0)
+    v2 = paddle.to_tensor(np.array([-2.0], np.float32))
+    np.testing.assert_allclose(float(f(v2)), -4.0)
+
+
+def test_static_if_on_python_value_untouched():
+    @jit.to_static
+    def f(x, flag=True):
+        if flag:                # plain Python bool: normal trace-time if
+            return x * 2
+        return x
+
+    assert float(f(paddle.to_tensor(np.array([2.0], np.float32)))) == 4.0
+
+
+def test_unconvertible_pattern_still_fails_loudly():
+    @jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            x = x * 2          # assigned in one branch only: no convert
+        return x
+
+    with pytest.raises(TypeError, match="paddle.cond"):
+        f(paddle.ones([2]))
+
+
+def test_converted_if_differentiable():
+    @jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            y = (x * x).sum()
+        else:
+            y = x.sum()
+        return y
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    f(x).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+
+
+def test_layer_forward_with_tensor_if():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        @jit.to_static
+        def forward(self, x):
+            h = self.fc(x)
+            if h.sum() > 1e9:   # never true, but must trace both
+                out = h * 0
+            else:
+                out = h + 1
+            return out
+
+    net = Net()
+    x = paddle.randn([2, 4])
+    expect = (net.fc(x) + 1).numpy()
+    np.testing.assert_allclose(net(x).numpy(), expect, rtol=1e-6)
+
+
+def test_branch_self_assignment_not_converted():
+    """`x = x + 1` inside a branch reads its own target: must NOT convert
+    (would be UnboundLocalError in the branch closure); plain-Python
+    predicates keep working, tensor predicates fail loudly."""
+    @jit.to_static
+    def g(x, flag=True):
+        if flag:
+            x = x + 1
+        else:
+            x = x - 1
+        return x
+
+    assert float(g(paddle.to_tensor(np.array([1.0], np.float32)))) == 2.0
+
+    @jit.to_static
+    def h(x):
+        if x.sum() > 0:
+            x = x * 2
+        else:
+            x = x - 1
+        return x
+
+    with pytest.raises(TypeError, match="paddle.cond"):
+        h(paddle.ones([2]))
+
+
+def test_chained_assign_after_define_converts():
+    @jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            a = x * 2
+            b = a + 1      # reads `a` AFTER assigning it: fine
+        else:
+            a = x - 1
+            b = a * 3
+        return b
+
+    np.testing.assert_allclose(
+        float(f(paddle.to_tensor(np.array([1.0], np.float32)))), 3.0)
+    np.testing.assert_allclose(
+        float(f(paddle.to_tensor(np.array([-1.0], np.float32)))), -6.0)
