@@ -23,18 +23,27 @@ import jax.numpy as jnp
 
 
 class Generator:
-    """Stateful key source (reference: framework/generator.h)."""
+    """Stateful key source (reference: framework/generator.h).
+
+    The base key materialises LAZILY: creating it touches the XLA
+    backend, and importing the framework must not do that (multi-process
+    jobs need jax.distributed.initialize to run first)."""
 
     def __init__(self, seed: int = 0):
         self._seed = seed
-        self._key = jax.random.key(seed)
+        self._key = None
         self._counter = 0
         self._lock = threading.Lock()
+
+    def _base(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
 
     def manual_seed(self, seed: int):
         with self._lock:
             self._seed = seed
-            self._key = jax.random.key(seed)
+            self._key = None
             self._counter = 0
         return self
 
@@ -46,7 +55,8 @@ class Generator:
         with self._lock:
             self._counter += 1
             c = self._counter
-        return jax.random.fold_in(self._key, c)
+            base = self._base()
+        return jax.random.fold_in(base, c)  # dispatch outside the lock
 
 
 _global_generator = Generator(0)

@@ -9,7 +9,7 @@ from .collective import (Group, ReduceOp, all_gather,  # noqa: F401
                          new_group, recv, reduce, reduce_scatter, scatter,
                          send, shift, spmd)
 from .env import (ParallelEnv, get_rank, get_world_size,  # noqa: F401
-                  init_parallel_env, is_initialized)
+                  early_init, init_parallel_env, is_initialized)
 from .fleet import Fleet, fleet  # noqa: F401
 from .mesh import (DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS, axis_size,  # noqa
                    ensure_mesh, get_mesh, init_mesh, set_mesh, sharding)

@@ -17,6 +17,26 @@ from .mesh import ensure_mesh, init_mesh
 _initialized = False
 
 
+def early_init():
+    """Run the jax.distributed TCP rendezvous NOW, before anything
+    initialises the XLA backend.  Importing paddle_tpu itself touches
+    jax.random, so multi-process entrypoints that import the framework at
+    module top must call this first (the launcher's env provides the
+    coordinator parameters).  Safe no-op when not under a launcher or
+    already initialised."""
+    coord = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get(
+        "PADDLE_MASTER")
+    n_proc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    # NB: probe with is_initialized(), NOT jax.process_count() — the
+    # latter initialises the backend, which would itself make the
+    # rendezvous impossible
+    if coord and n_proc > 1 and not jax.distributed.is_initialized():
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=n_proc,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+
+
 def init_parallel_env(mesh_shape=None):
     """paddle.distributed.init_parallel_env parity.
 
@@ -27,14 +47,7 @@ def init_parallel_env(mesh_shape=None):
     global _initialized
     if _initialized:
         return ensure_mesh()
-    coord = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get(
-        "PADDLE_MASTER")
-    n_proc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    if coord and n_proc > 1 and jax.process_count() == 1:
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=n_proc,
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    early_init()
     mesh = init_mesh(mesh_shape)
     _initialized = True
     return mesh

@@ -45,7 +45,8 @@ def _worker_env(rank: int, nproc: int, coordinator: str, base=None):
 def launch(training_script: str, script_args: Optional[List[str]] = None,
            nproc_per_node: int = 1, ips: Optional[str] = None,
            node_rank: int = 0, master_port: int = 6170,
-           log_dir: Optional[str] = None) -> int:
+           log_dir: Optional[str] = None,
+           timeout: Optional[float] = None) -> int:
     """Start ``nproc_per_node`` LOCAL worker processes of a (possibly
     multi-host) job with the distributed bootstrap env set; watch them,
     and on any failure terminate the rest (reference: launch_utils.py
@@ -79,9 +80,25 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
     nproc_per_node = len(procs)
 
     rc = 0
+    deadline = time.monotonic() + timeout if timeout else None
+
+    def _kill_all(remaining):
+        for r in remaining:
+            procs[r].terminate()
+        for r in remaining:
+            try:
+                procs[r].wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                procs[r].kill()
+        remaining.clear()
+
     try:
         alive = set(range(nproc_per_node))
         while alive:
+            if deadline is not None and time.monotonic() > deadline:
+                rc = rc or 124  # job deadline exceeded (hung rendezvous?)
+                _kill_all(alive)
+                break
             for rank in list(alive):
                 code = procs[rank].poll()
                 if code is None:
@@ -91,14 +108,7 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
                     rc = rc or code
                     # one worker died: take the rest down (reference:
                     # terminate_local_procs)
-                    for r in alive:
-                        procs[r].terminate()
-                    for r in alive:
-                        try:
-                            procs[r].wait(timeout=10)
-                        except subprocess.TimeoutExpired:
-                            procs[r].kill()
-                    alive.clear()
+                    _kill_all(alive)
             time.sleep(0.2)
     except KeyboardInterrupt:
         for p in procs:

@@ -1,0 +1,57 @@
+"""Multi-process (multi-"host") bootstrap test: paddle-tpu-launch starts
+2 workers, jax.distributed rendezvous over the launcher's coordinator
+env, a global 4-device mesh spans both processes, collectives cross the
+process boundary, and SPMD training matches a single-process oracle.
+
+Reference analog: the fleet launch + gen_comm_id TCP rendezvous +
+multi-node allreduce path (test_dist_base.py's subprocess pattern)."""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_process_bootstrap_and_training():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.jit import TrainStep
+
+    # single-process oracle for the worker's training losses
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    r = np.random.RandomState(7)
+    x = jnp.asarray(r.randn(8, 8), jnp.float32)
+    y = jnp.asarray(r.randint(0, 4, (8,)), jnp.int32)
+    opt = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    local = TrainStep(net, lambda o, l: F.cross_entropy(o, l), opt)
+    expect = [float(local(x, y)) for _ in range(2)]
+
+    from paddle_tpu.distributed.launch import launch
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_worker.py")
+    env_backup = dict(os.environ)
+    os.environ["EXPECT_LOSSES"] = ",".join(f"{v:.8f}" for v in expect)
+    # workers must not inherit this process's single-chip/cpu jax state
+    os.environ.pop("XLA_FLAGS", None)
+    try:
+        rc = launch(worker, nproc_per_node=2,
+                    master_port=_free_port(), timeout=240)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+    assert rc == 0, f"multihost workers failed (exit {rc})"
