@@ -203,9 +203,15 @@ def bench_resnet50(args, dev, on_tpu):
         batch, hw, steps, dtype = 128, 224, (args.steps or 20), "bfloat16"
     else:
         batch, hw, steps, dtype = 4, 64, (args.steps or 3), "float32"
+    # NCHW vs NHWC measure identically on v5e (XLA's layout assignment
+    # normalizes conv layouts); keep the paddle-default NCHW
+    data_format = os.environ.get("BENCH_RESNET_FORMAT", "NCHW").upper()
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"BENCH_RESNET_FORMAT must be NCHW or NHWC, "
+                         f"got {data_format!r}")
 
     paddle.seed(2024)
-    model = resnet50()
+    model = resnet50(data_format=data_format)
     opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                              parameters=model.parameters(),
                              multi_precision=(dtype != "float32"))
@@ -217,7 +223,9 @@ def bench_resnet50(args, dev, on_tpu):
 
     step = TrainStep(model, loss_fn, opt, n_inputs=1, donate=True)
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.standard_normal((batch, 3, hw, hw)).astype(np.float32))
+    shape = ((batch, hw, hw, 3) if data_format == "NHWC"
+             else (batch, 3, hw, hw))
+    x = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
     if dtype != "float32":
         x = x.astype(jnp.bfloat16)  # bf16 input pipeline, standard on TPU
     y = jnp.asarray(rng.randint(0, 1000, (batch,), dtype=np.int64))
@@ -236,6 +244,7 @@ def bench_resnet50(args, dev, on_tpu):
         "step_time_ms": round(1000 * dt / steps, 2),
         "batch": batch,
         "image_size": hw,
+        "data_format": data_format,
         "dtype": dtype,
         "flops_accounting": "3 x 4.089 GF/img (fwd x3 train)",
         "final_loss": round(last, 4),

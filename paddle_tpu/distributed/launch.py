@@ -80,7 +80,8 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
     nproc_per_node = len(procs)
 
     rc = 0
-    deadline = time.monotonic() + timeout if timeout else None
+    deadline = (time.monotonic() + timeout if timeout is not None
+                else None)
 
     def _kill_all(remaining):
         for r in remaining:

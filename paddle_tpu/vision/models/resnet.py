@@ -6,15 +6,18 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
+        df = data_format
+        norm_layer = norm_layer or (
+            lambda c: nn.BatchNorm2D(c, data_format=df))
         self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride,
-                               bias_attr=False)
+                               bias_attr=False, data_format=df)
         self.bn1 = norm_layer(planes)
         self.relu = nn.ReLU()
         self.conv2 = nn.Conv2D(planes, planes, 3, padding=1,
-                               bias_attr=False)
+                               bias_attr=False, data_format=df)
         self.bn2 = norm_layer(planes)
         self.downsample = downsample
         self.stride = stride
@@ -32,18 +35,23 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
+        df = data_format
+        norm_layer = norm_layer or (
+            lambda c: nn.BatchNorm2D(c, data_format=df))
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
+                               data_format=df)
         self.bn1 = norm_layer(width)
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation,
                                stride=stride, groups=groups,
-                               dilation=dilation, bias_attr=False)
+                               dilation=dilation, bias_attr=False,
+                               data_format=df)
         self.bn2 = norm_layer(width)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
-                               bias_attr=False)
+                               bias_attr=False, data_format=df)
         self.bn3 = norm_layer(planes * self.expansion)
         self.relu = nn.ReLU()
         self.downsample = downsample
@@ -61,28 +69,32 @@ class BottleneckBlock(nn.Layer):
 class ResNet(nn.Layer):
     """reference: vision/models/resnet.py ResNet."""
 
-    def __init__(self, block, depth=50, num_classes=1000, with_pool=True):
+    def __init__(self, block, depth=50, num_classes=1000, with_pool=True,
+                 data_format="NCHW"):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
         layers = layer_cfg[depth]
         self.num_classes = num_classes
         self.with_pool = with_pool
-        self._norm_layer = nn.BatchNorm2D
+        self.data_format = data_format
+        df = data_format
+        self._norm_layer = lambda c: nn.BatchNorm2D(c, data_format=df)
         self.inplanes = 64
         self.dilation = 1
 
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                               bias_attr=False)
+                               bias_attr=False, data_format=df)
         self.bn1 = self._norm_layer(self.inplanes)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1,
+                                    data_format=df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), data_format=df)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
@@ -92,14 +104,17 @@ class ResNet(nn.Layer):
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
+                          stride=stride, bias_attr=False,
+                          data_format=self.data_format),
                 norm_layer(planes * block.expansion))
         layers = [block(self.inplanes, planes, stride, downsample,
-                        norm_layer=norm_layer)]
+                        norm_layer=norm_layer,
+                        data_format=self.data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes,
-                                norm_layer=norm_layer))
+                                norm_layer=norm_layer,
+                                data_format=self.data_format))
         return nn.Sequential(*layers)
 
     def forward(self, x):

@@ -372,3 +372,18 @@ def test_visualdl_callback_writes_scalars(tmp_path):
             open(str(tmp_path / "scalars.jsonl"))]
     tags = {r["tag"] for r in rows}
     assert tags == {"train/loss", "eval/acc"}
+
+
+def test_resnet_data_format_parity():
+    """data_format='NHWC' threads through the whole ResNet and matches
+    the NCHW model with identical weights."""
+    paddle.seed(11)
+    m_nchw = vmodels.resnet18(num_classes=7)
+    m_nhwc = vmodels.resnet18(num_classes=7, data_format="NHWC")
+    m_nhwc.set_state_dict(m_nchw.state_dict())  # same weight layouts
+    m_nchw.eval(); m_nhwc.eval()
+    x = np.random.RandomState(0).standard_normal((2, 3, 32, 32)).astype(
+        np.float32)
+    a = m_nchw(paddle.to_tensor(x)).numpy()
+    b = m_nhwc(paddle.to_tensor(x.transpose(0, 2, 3, 1))).numpy()
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)

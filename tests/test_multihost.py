@@ -7,15 +7,13 @@ Reference analog: the fleet launch + gen_comm_id TCP rendezvous +
 multi-node allreduce path (test_dist_base.py's subprocess pattern)."""
 import os
 import socket
-import subprocess
-import sys
 
 import numpy as np
-import pytest
 
 
 def _free_port():
     s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind(("127.0.0.1", 0))
     p = s.getsockname()[1]
     s.close()
@@ -49,8 +47,13 @@ def test_two_process_bootstrap_and_training():
     # workers must not inherit this process's single-chip/cpu jax state
     os.environ.pop("XLA_FLAGS", None)
     try:
+        # retry once with a fresh port: _free_port has a TOCTOU window
+        # under parallel test runs
         rc = launch(worker, nproc_per_node=2,
                     master_port=_free_port(), timeout=240)
+        if rc != 0:
+            rc = launch(worker, nproc_per_node=2,
+                        master_port=_free_port(), timeout=240)
     finally:
         os.environ.clear()
         os.environ.update(env_backup)
