@@ -32,3 +32,5 @@ from .layer.rnn import (BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN,  # noqa
 from .layer.transformer import (MultiHeadAttention, Transformer,  # noqa
                                 TransformerDecoder, TransformerDecoderLayer,
                                 TransformerEncoder, TransformerEncoderLayer)
+
+from .decode import BeamSearchDecoder, dynamic_decode, gather_tree  # noqa

@@ -1,0 +1,174 @@
+"""Dynamic decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference: python/paddle/nn/decode.py (BeamSearchDecoder:64,
+dynamic_decode:997) lowering to the while/beam-search op stack
+(operators/controlflow/while_op.cc, beam_search_op, gather_tree).
+
+TPU-native design: the decode loop is ``lax.while_loop`` with
+static-shape state — scores [B, K], token history [B, K, T_max] written
+by step index — so one compiled program serves any actual decode length;
+early exit is the loop predicate (all beams finished), the reference's
+is_finished plumbing.  ``gather_tree`` (backtracking predecessors into
+final beams) is a reverse ``lax.scan``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+
+
+def _gather_tree_impl(step_ids, parent_ids):
+    """[T, B, K] ids + parent beam indices -> backtracked [T, B, K]."""
+    T = step_ids.shape[0]
+
+    def back(carry, xs):
+        beams = carry                       # [B, K] current beam index
+        ids_t, par_t = xs
+        tok = jnp.take_along_axis(ids_t, beams, axis=1)
+        beams = jnp.take_along_axis(par_t, beams, axis=1)
+        return beams, tok
+
+    B, K = step_ids.shape[1:]
+    init = jnp.tile(jnp.arange(K)[None, :], (B, 1))
+    _, toks = jax.lax.scan(back, init, (step_ids[::-1], parent_ids[::-1]))
+    return toks[::-1]
+
+
+def gather_tree(step_ids, parent_ids):
+    """reference: paddle.nn.functional.gather_tree / gather_tree_op.cc."""
+    return apply(_gather_tree_impl, step_ids, parent_ids,
+                 op_name="gather_tree", nondiff=True)
+
+
+class BeamSearchDecoder:
+    """reference: nn/decode.py BeamSearchDecoder:64.
+
+    ``cell(inputs, states) -> (logits_or_out, new_states)``;
+    ``output_fn`` maps cell output to vocab logits (e.g. the projection
+    layer) when the cell itself doesn't."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn: Optional[Callable] = None,
+                 output_fn: Optional[Callable] = None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
+                   max_step_num: int = 64, is_test: bool = True,
+                   return_length: bool = False, **kwargs):
+    """Beam-search decode loop (reference: nn/decode.py
+    dynamic_decode:997).  ``inits``: initial cell state pytree of
+    Tensors/arrays with leading batch dim B.  Returns
+    ``(token_ids [B, K, max_step_num], beam_scores [B, K])`` (+ lengths
+    with ``return_length=True``), beams sorted best-first; positions
+    past a beam's end are padded with ``end_token``.
+    """
+    dec = decoder
+    K, V_end = dec.beam_size, dec.end_token
+
+    def _arr(t):
+        return t.data if isinstance(t, Tensor) else jnp.asarray(t)
+
+    states0 = jax.tree.map(_arr, inits,
+                           is_leaf=lambda x: isinstance(x, Tensor))
+    leaves = jax.tree.leaves(states0)
+    assert leaves, "dynamic_decode needs initial states with a batch dim"
+    B = leaves[0].shape[0]
+    T = int(max_step_num)
+
+    def cell_step(tok_flat, states_flat):
+        """[B*K] tokens + flat states -> ([B*K, V] logprobs, new states)."""
+        inp = Tensor(tok_flat)
+        if dec.embedding_fn is not None:
+            inp = dec.embedding_fn(inp)
+        out, new_states = dec.cell(inp, jax.tree.map(
+            Tensor, states_flat,
+            is_leaf=lambda x: not isinstance(x, (list, tuple, dict))))
+        if dec.output_fn is not None:
+            out = dec.output_fn(out)
+        logits = _arr(out)
+        new_states = jax.tree.map(_arr, new_states,
+                                  is_leaf=lambda x: isinstance(x, Tensor))
+        return jax.nn.log_softmax(logits.astype(jnp.float32)), new_states
+
+    def decode_fn():
+        # tile the initial state across beams: [B, ...] -> [B*K, ...]
+        states = jax.tree.map(
+            lambda a: jnp.repeat(a, K, axis=0), states0)
+        NEG = jnp.float32(-1e9)
+        # only beam 0 is live at t=0 so identical start beams don't
+        # multiply (reference kInitialBeamScores)
+        scores = jnp.tile(jnp.where(jnp.arange(K) == 0, 0.0, NEG)[None],
+                          (B, 1))
+        tokens = jnp.full((B, K), dec.start_token, jnp.int32)
+        finished = jnp.zeros((B, K), bool)
+        # unwritten history must be self-describing for an early exit:
+        # ids pad with end_token, parents with the identity permutation
+        # (so gather_tree backtracks through unwritten steps unchanged)
+        ids_hist = jnp.full((T, B, K), V_end, jnp.int32)
+        par_hist = jnp.tile(jnp.arange(K, dtype=jnp.int32)[None, None],
+                            (T, B, 1))
+        lengths = jnp.zeros((B, K), jnp.int32)
+
+        def cond(carry):
+            t, _, _, _, finished, _, _, _ = carry
+            return jnp.logical_and(t < T, ~jnp.all(finished))
+
+        def body(carry):
+            t, tokens, scores, states, finished, ids_h, par_h, lens = carry
+            logp, new_states = cell_step(tokens.reshape(-1), states)
+            V = logp.shape[-1]
+            logp = logp.reshape(B, K, V)
+            # finished beams only extend with end_token at zero cost
+            fin_row = jnp.full((V,), float(np.float32(-1e9)), jnp.float32)
+            fin_row = fin_row.at[V_end].set(0.0)
+            logp = jnp.where(finished[..., None], fin_row[None, None],
+                             logp)
+            cand = scores[..., None] + logp            # [B, K, V]
+            flat = cand.reshape(B, K * V)
+            top, idx = jax.lax.top_k(flat, K)          # [B, K]
+            parent = (idx // V).astype(jnp.int32)
+            tok = (idx % V).astype(jnp.int32)
+
+            def sel(a):
+                a = a.reshape((B, K) + a.shape[1:])
+                out = jnp.take_along_axis(
+                    a, parent.reshape((B, K) + (1,) * (a.ndim - 2)),
+                    axis=1)
+                return out.reshape((B * K,) + a.shape[2:])
+
+            states = jax.tree.map(sel, new_states)
+            fin_parent = jnp.take_along_axis(finished, parent, axis=1)
+            lens = jnp.take_along_axis(lens, parent, axis=1)
+            lens = jnp.where(fin_parent, lens, lens + 1)
+            finished = fin_parent | (tok == V_end)
+            ids_h = ids_h.at[t].set(tok)
+            par_h = par_h.at[t].set(parent)
+            return (t + 1, tok, top, states, finished, ids_h, par_h, lens)
+
+        carry = (jnp.int32(0), tokens, scores, states, finished, ids_hist,
+                 par_hist, lengths)
+        t, _, scores, _, _, ids_h, par_h, lens = jax.lax.while_loop(
+            cond, body, carry)
+        seq = _gather_tree_impl(ids_h, par_h)          # [T, B, K]
+        return seq.transpose(1, 2, 0), scores, lens, t
+
+    seq, scores, lens, t = apply(decode_fn, op_name="dynamic_decode",
+                                 nondiff=True)
+    if return_length:
+        return seq, scores, lens
+    return seq, scores

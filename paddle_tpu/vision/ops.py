@@ -1,0 +1,117 @@
+"""paddle.vision.ops — detection primitives.
+
+Reference: python/paddle/vision/ops.py (nms:1586, box IoU in
+operators/detection/).  TPU-first shapes: the suppression sweep is a
+``lax.scan`` over a precomputed [N, N] IoU matrix — fixed shapes, no
+data-dependent loops, so the same code runs eagerly, under jit (with
+``top_k`` for a static result size), and on the accelerator.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["box_iou", "nms", "box_area"]
+
+
+def _area(b):
+    return jnp.maximum(b[..., 2] - b[..., 0], 0) * jnp.maximum(
+        b[..., 3] - b[..., 1], 0)
+
+
+def box_area(boxes, name=None):
+    """[..., 4] xyxy boxes -> areas."""
+    return apply(_area, boxes, op_name="box_area")
+
+
+def _iou_matrix(a, b):
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _area(a)[:, None] + _area(b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def box_iou(boxes1, boxes2, name=None):
+    """Pairwise IoU [N, M] for xyxy boxes (reference: iou_similarity_op)."""
+    return apply(_iou_matrix, boxes1, boxes2, op_name="box_iou")
+
+
+def _nms_mask(boxes, scores, iou_threshold):
+    """Greedy NMS keep-mask in score order (static shapes)."""
+    order = jnp.argsort(-scores)
+    iou = _iou_matrix(boxes[order], boxes[order])
+    n = boxes.shape[0]
+
+    def body(suppressed, i):
+        keep_i = ~suppressed[i]
+        sup_by_i = (iou[i] > iou_threshold) & keep_i
+        sup_by_i = jnp.where(jnp.arange(n) <= i, False, sup_by_i)
+        return suppressed | sup_by_i, keep_i
+
+    _, keep_sorted = jax.lax.scan(body, jnp.zeros(n, bool), jnp.arange(n))
+    return order, keep_sorted
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: Optional[int] = None,
+        name=None):
+    """Greedy hard NMS (reference: vision/ops.py nms:1586).
+
+    Returns kept box indices, best score first.  Eager returns the
+    variable-length result like the reference; pass ``top_k`` for a
+    static-size result (padded with -1) usable under jit.
+    ``category_idxs``/``categories`` run class-aware NMS (boxes of
+    different categories never suppress each other)."""
+    b = boxes.data if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    n = b.shape[0]
+    s = (scores.data if isinstance(scores, Tensor)
+         else jnp.asarray(scores)) if scores is not None else None
+    if s is None:
+        s = jnp.arange(n, 0, -1, dtype=jnp.float32)  # input order
+
+    if category_idxs is not None:
+        # class-aware: offset boxes per category so cross-class IoU = 0
+        # (the standard batched-NMS trick)
+        ci = (category_idxs.data if isinstance(category_idxs, Tensor)
+              else jnp.asarray(category_idxs))
+        if categories is not None and not isinstance(
+                ci, jax.core.Tracer):
+            cats = set(int(v) for v in np.asarray(categories).reshape(-1))
+            bad = set(int(v) for v in np.unique(np.asarray(ci))) - cats
+            if bad:
+                raise ValueError(
+                    f"category_idxs contains {sorted(bad)} not present "
+                    f"in categories {sorted(cats)}")
+        c = ci.astype(b.dtype)
+        span = jnp.max(b) - jnp.min(b) + 1.0
+        b = b + (c * span)[:, None]
+
+    def run(b, s):
+        order, keep_sorted = _nms_mask(b, s, iou_threshold)
+        if top_k is not None:
+            # static result: rank kept entries first, pad with -1
+            rank = jnp.where(keep_sorted, jnp.arange(n), n)
+            sel = jnp.argsort(rank)[:top_k]
+            idx = order[sel]
+            valid = jnp.sort(rank)[:top_k] < n
+            return jnp.where(valid, idx, -1)
+        return order, keep_sorted
+
+    if top_k is not None:
+        return apply(run, b, s, op_name="nms", nondiff=True)
+
+    # eager / variable-length (reference semantics)
+    order, keep_sorted = run(b, s)
+    order = np.asarray(order)
+    kept = order[np.asarray(keep_sorted)]
+    idx_dt = (jnp.int64 if jax.config.read("jax_enable_x64")
+              else jnp.int32)
+    return Tensor(jnp.asarray(kept, idx_dt))

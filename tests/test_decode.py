@@ -1,0 +1,117 @@
+"""Dynamic decode / beam search tests (reference analog: test_rnn_decode
+/ test_gather_tree): deterministic toy LM where the optimal beams are
+known analytically."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class ToyCell(nn.Layer):
+    """Deterministic 'LM': next-token logits depend only on the current
+    token via a fixed table; state counts steps."""
+
+    def __init__(self, table):
+        super().__init__()
+        self.table = paddle.to_tensor(table)
+
+    def forward(self, tok, states):
+        logits = self.table[tok]
+        return logits, states + 1
+
+
+def test_greedy_beam_follows_argmax_chain():
+    V = 5
+    # token i deterministically prefers token (i+1) % V; token 4 -> EOS(0)
+    tbl = np.full((V, V), -5.0, np.float32)
+    for i in range(V):
+        tbl[i, (i + 1) % V] = 5.0
+    cell = ToyCell(tbl)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                               beam_size=2)
+    inits = paddle.zeros([3], dtype="int32")  # batch of 3 counters
+    seq, scores = nn.dynamic_decode(dec, inits, max_step_num=8)
+    seq = np.asarray(seq.numpy())
+    assert seq.shape == (3, 2, 8)
+    # best beam from start=1: 2, 3, 4, 0(EOS)
+    np.testing.assert_array_equal(seq[0, 0, :4], [2, 3, 4, 0])
+    # all batches identical (same start)
+    np.testing.assert_array_equal(seq[0], seq[1])
+
+
+def test_beams_are_sorted_and_lengths_reported():
+    V = 4
+    tbl = np.zeros((V, V), np.float32)
+    tbl[1, 2] = 3.0   # from start=1: best is 2, then others
+    tbl[1, 3] = 1.0
+    tbl[2, 0] = 5.0   # 2 -> EOS fast
+    tbl[3, 0] = 5.0
+    cell = ToyCell(tbl)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                               beam_size=2)
+    seq, scores, lens = nn.dynamic_decode(
+        dec, paddle.zeros([1], dtype="int32"), max_step_num=6,
+        return_length=True)
+    s = np.asarray(scores.numpy())[0]
+    assert s[0] >= s[1]                      # sorted best-first
+    assert np.asarray(seq.numpy())[0, 0, 0] == 2
+    ls = np.asarray(lens.numpy())[0]
+    assert ls[0] == 2                        # token + EOS
+
+
+def test_gather_tree_backtracks():
+    import paddle_tpu.nn as pnn
+    # T=3, B=1, K=2
+    ids = np.array([[[5, 6]], [[7, 8]], [[9, 10]]], np.int32)
+    parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int32)
+    out = pnn.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(parents))
+    got = np.asarray(out.numpy())
+    # beam 0: t=2 token ids[2,0]=9, parent 0 -> t=1 token ids[1,0]=7,
+    # parent 1 -> t=0 token ids[0,1]=6
+    np.testing.assert_array_equal(got[:, 0, 0], [6, 7, 9])
+
+
+def test_decode_with_embedding_and_projection():
+    paddle.seed(0)
+    H, V = 8, 12
+
+    class GruLM(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.cell = nn.GRUCell(H, H)
+
+        def forward(self, x, states):
+            out, new = self.cell(x, states)
+            return out, new
+
+    emb = nn.Embedding(V, H)
+    proj = nn.Linear(H, V)
+    lm = GruLM()
+    dec = nn.BeamSearchDecoder(lm, start_token=1, end_token=0, beam_size=3,
+                               embedding_fn=emb, output_fn=proj)
+    h0 = paddle.zeros([2, H])
+    seq, scores = nn.dynamic_decode(dec, h0, max_step_num=5)
+    assert list(seq.shape) == [2, 3, 5]
+    assert np.isfinite(np.asarray(scores.numpy())).all()
+
+
+def test_early_exit_preserves_distinct_beams():
+    """Early loop exit (all beams finish before max_step_num) must not
+    collapse non-best beams onto beam 0's tokens, and padding is
+    end_token."""
+    V = 6
+    EOS = 5
+    tbl = np.full((V, V), -9.0, np.float32)
+    tbl[1, 2] = 2.0    # start=1: best next is 2, second-best 3
+    tbl[1, 3] = 1.0
+    tbl[2, EOS] = 9.0  # both then finish immediately
+    tbl[3, EOS] = 9.0
+    cell = ToyCell(tbl)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=EOS,
+                               beam_size=2)
+    seq, scores = nn.dynamic_decode(
+        dec, paddle.zeros([1], dtype="int32"), max_step_num=10)
+    s = np.asarray(seq.numpy())[0]
+    np.testing.assert_array_equal(s[0, :2], [2, EOS])
+    np.testing.assert_array_equal(s[1, :2], [3, EOS])  # distinct beam!
+    assert np.all(s[:, 2:] == EOS)  # padding is end_token

@@ -1,0 +1,74 @@
+"""vision.ops detection primitive tests (reference analog: test_nms_op,
+test_iou_similarity_op): IoU math and greedy NMS vs a naive oracle."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+def _naive_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep, suppressed = [], np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if j == i or suppressed[j]:
+                continue
+            xa1, ya1, xa2, ya2 = boxes[i]
+            xb1, yb1, xb2, yb2 = boxes[j]
+            iw = max(0, min(xa2, xb2) - max(xa1, xb1))
+            ih = max(0, min(ya2, yb2) - max(ya1, yb1))
+            inter = iw * ih
+            ua = ((xa2 - xa1) * (ya2 - ya1) + (xb2 - xb1) * (yb2 - yb1)
+                  - inter)
+            if inter / max(ua, 1e-9) > thr:
+                suppressed[j] = True
+    return keep
+
+
+def test_box_iou_known_values():
+    a = paddle.to_tensor(np.array([[0, 0, 2, 2]], np.float32))
+    b = paddle.to_tensor(np.array([[1, 1, 3, 3], [0, 0, 2, 2],
+                                   [5, 5, 6, 6]], np.float32))
+    iou = ops.box_iou(a, b).numpy()
+    np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], rtol=1e-6)
+
+
+def test_nms_matches_naive_oracle():
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        xy = rng.rand(40, 2) * 10
+        wh = rng.rand(40, 2) * 4 + 0.5
+        boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        scores = rng.rand(40).astype(np.float32)
+        want = _naive_nms(boxes, scores, 0.4)
+        got = ops.nms(paddle.to_tensor(boxes), 0.4,
+                      paddle.to_tensor(scores)).numpy().tolist()
+        assert got == want, (got, want)
+
+
+def test_nms_static_topk_under_jit():
+    import jax
+    boxes = np.array([[0, 0, 2, 2], [0, 0, 2.1, 2.1], [5, 5, 7, 7]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+
+    @jax.jit
+    def jitted(b, s):
+        return ops.nms(paddle.to_tensor(b), 0.5, paddle.to_tensor(s),
+                       top_k=3).data
+
+    got = np.asarray(jitted(boxes, scores)).tolist()
+    assert got == [0, 2, -1]  # box1 suppressed by box0; padded with -1
+
+
+def test_nms_class_aware():
+    boxes = np.array([[0, 0, 2, 2], [0, 0, 2, 2]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1])
+    got = ops.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                  category_idxs=paddle.to_tensor(cats),
+                  categories=[0, 1]).numpy().tolist()
+    assert got == [0, 1]  # different classes never suppress each other
