@@ -1031,6 +1031,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         eff_dropout = dropout_p if training else 0.0
         if flash_attention_supported(q_shape, k_shape, dtype, attn_mask,
                                      eff_dropout):
+            if eff_dropout > 0.0:
+                sd = jax.random.bits(next_key(), (1, 1),
+                                     jnp.uint32).astype(jnp.int32)
+                return apply(
+                    lambda q, k, v, s: flash_attention(
+                        q, k, v, causal=is_causal,
+                        dropout_p=eff_dropout, seed=s),
+                    query, key, value, Tensor(sd),
+                    op_name="flash_attention")
             return apply(
                 lambda q, k, v: flash_attention(q, k, v, causal=is_causal),
                 query, key, value, op_name="flash_attention")

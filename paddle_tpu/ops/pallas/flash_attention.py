@@ -66,17 +66,24 @@ def flash_attention_supported(q_shape, k_shape, dtype, attn_mask=None,
     """Capability + profitability check: shapes/dtype the kernel handles
     AND where it beats XLA's fused attention (measured on v5e: flash wins
     ~30% at seq>=2048, XLA wins ~2% at seq 512 — the crossover is the
-    FLAGS_pallas_attention_min_seqlen knob)."""
+    FLAGS_pallas_attention_min_seqlen knob).  Attention dropout runs
+    IN-KERNEL via the Pallas TPU PRNG (tile-seeded, regenerated in the
+    backward) — but only on real TPUs (interpret mode has no PRNG)."""
     from ...core.flags import get_flag
-    if attn_mask is not None or dropout_p > 0.0:
+    if attn_mask is not None:
         return False
+    if dropout_p > 0.0 and _interpret():
+        return False  # pltpu PRNG has no CPU interpreter lowering
     if len(q_shape) != 4:
         return False
     B, Lq, H, D = q_shape
     Lk = k_shape[1]
     if dtype not in (jnp.float32, jnp.bfloat16):
         return False
-    if max(Lq, Lk) < get_flag("pallas_attention_min_seqlen"):
+    min_len = get_flag("pallas_attention_dropout_min_seqlen"
+                       if dropout_p > 0.0
+                       else "pallas_attention_min_seqlen")
+    if max(Lq, Lk) < min_len:
         return False
     # blocks must tile the sequence
     if Lq % min(block_q, Lq) or Lk % min(block_k, Lk):
@@ -97,19 +104,56 @@ def _mask_scores(s, causal, qi, j, q_off_ref, k_off_ref, block_q, block_k,
         return s
     q_off = q_off_ref[0, 0]
     k_off = k_off_ref[0, 0]
+    # int32 iota + cast: Mosaic's tpu.iota only produces integer vectors
     q_pos = (q_off + qi * block_q
-             + jax.lax.broadcasted_iota(jnp.float32, (bq, block_k), 0))
+             + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k),
+                                        0).astype(jnp.float32))
     k_pos = (k_off + j * block_k
-             + jax.lax.broadcasted_iota(jnp.float32, (bq, block_k), 1))
+             + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k),
+                                        1).astype(jnp.float32))
     return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _dropout_keep(seed_ref, qi, j, shape, dropout_p):
+    """Tile keep-mask from the Pallas TPU PRNG, seeded on
+    (user seed, b, h, q-block, k-block) so the backward kernels reproduce
+    the forward's mask exactly.  prng_random_bits has int32 semantics on
+    TPU: an arithmetic >>16 yields uniform [-32768, 32767], compared
+    against the p-quantile threshold."""
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    # Mosaic accepts at most 2 seed words: fold (b,h) and (qi,j) — the
+    # 65599 strides keep tile seeds distinct for any h, j < 65599
+    s1 = seed_ref[0, 0] ^ (b * 65599 + h)
+    s2 = qi * 65599 + j
+    pltpu.prng_seed(s1, s2)
+    bits = pltpu.prng_random_bits(shape)
+    v = jax.lax.shift_right_arithmetic(bits, 16)
+    t = int(round(dropout_p * 65536.0)) - 32768
+    return v >= t
+
+
+def _apply_dropout(p, seed_ref, qi, j, dropout_p):
+    """p (unnormalized probs) -> p * keep / (1 - p_q).  The softmax
+    denominator keeps the UNdropped sum, which reproduces dropout applied
+    to the normalized weights (out = sum(drop(w) v), w = p / l)."""
+    if dropout_p <= 0.0:
+        return p
+    t = int(round(dropout_p * 65536.0))
+    if t >= 65536:  # p ~ 1.0: everything drops
+        return jnp.zeros_like(p)
+    keep = _dropout_keep(seed_ref, qi, j, p.shape, dropout_p)
+    inv_keep = 65536.0 / (65536 - t)
+    return jnp.where(keep, p * inv_keep, 0.0)
 
 
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                *, scale, block_k, seq_k, causal, block_q, aligned):
+def _fwd_kernel(q_off_ref, k_off_ref, seed_ref, q_ref, k_ref, v_ref,
+                o_ref, lse_ref, *, scale, block_k, seq_k, causal, block_q,
+                aligned, dropout_p):
     qi = pl.program_id(2)
     q_raw = q_ref[0, 0]
     q = (q_raw.astype(jnp.float32) * scale).astype(q_raw.dtype)  # [BQ, D]
@@ -137,8 +181,11 @@ def _fwd_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         # those probabilities instead of attending uniformly
         p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
         alpha = jnp.exp(m - m_new)
+        # denominator uses the UNdropped sum; only the value aggregation
+        # sees the dropout mask (== dropout on normalized weights)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + _dot(p.astype(v.dtype), v, ((1,), (0,)))
+        u = _apply_dropout(p, seed_ref, qi, j, dropout_p)
+        acc = acc * alpha + _dot(u.astype(v.dtype), v, ((1,), (0,)))
         return m_new, l, acc
 
     m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m, l, acc))
@@ -151,20 +198,22 @@ def _qkv_fwd_specs(block_q, Lk, D):
     return [
         _smem_scalar_spec(),
         _smem_scalar_spec(),
+        _smem_scalar_spec(),
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
         pl.BlockSpec((1, 1, Lk, D), lambda b, h, i: (b, h, 0, 0)),
         pl.BlockSpec((1, 1, Lk, D), lambda b, h, i: (b, h, 0, 0)),
     ]
 
 
-def _fwd(q, k, v, q_off, k_off, scale, causal, block_q, block_k, aligned):
+def _fwd(q, k, v, q_off, k_off, seed, scale, causal, block_q, block_k,
+         aligned, dropout_p=0.0):
     """q/k/v: [B, H, L, D] → (out [B,H,Lq,D], lse [B,H,Lq,1])."""
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     grid = (B, H, Lq // block_q)
     kernel = functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
                                seq_k=Lk, causal=causal, block_q=block_q,
-                               aligned=aligned)
+                               aligned=aligned, dropout_p=dropout_p)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -178,7 +227,7 @@ def _fwd(q, k, v, q_off, k_off, scale, causal, block_q, block_k, aligned):
             jax.ShapeDtypeStruct((B, H, Lq, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q_off, k_off, q, k, v)
+    )(q_off, k_off, seed, q, k, v)
     return out, lse
 
 
@@ -186,9 +235,9 @@ def _fwd(q, k, v, q_off, k_off, scale, causal, block_q, block_k, aligned):
 # backward (recompute-based, FlashAttention-2 style)
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
-                   lse_ref, delta_ref, dq_ref, *, scale, block_k, seq_k,
-                   causal, block_q, aligned):
+def _bwd_dq_kernel(q_off_ref, k_off_ref, seed_ref, q_ref, k_ref, v_ref,
+                   do_ref, lse_ref, delta_ref, dq_ref, *, scale, block_k,
+                   seq_k, causal, block_q, aligned, dropout_p):
     qi = pl.program_id(2)
     q = q_ref[0, 0]                                       # [BQ, D]
     do = do_ref[0, 0]
@@ -210,17 +259,20 @@ def _bwd_dq_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
                          block_k, bq)
         p = jnp.exp(s - lse)                              # [BQ, BK]
         p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
+        u = _apply_dropout(p, seed_ref, qi, j, dropout_p)
         dp = _dot(do, v, ((1,), (1,)))
-        ds = p * (dp - delta) * scale
+        # d s = p_norm * (keep_scale * dP - delta)  (see derivation in
+        # _apply_dropout: the denominator is undropped)
+        ds = (u * dp - p * delta) * scale
         return dq + _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
     dq = jax.lax.fori_loop(0, num_kv, body, dq)
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
-                    lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block_q,
-                    seq_q, causal, block_k, aligned):
+def _bwd_dkv_kernel(q_off_ref, k_off_ref, seed_ref, q_ref, k_ref, v_ref,
+                    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale,
+                    block_q, seq_q, causal, block_k, aligned, dropout_p):
     kj = pl.program_id(2)
     k = k_ref[0, 0]                                       # [BK, D]
     v = v_ref[0, 0]
@@ -243,9 +295,11 @@ def _bwd_dkv_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
                          block_k, block_q)
         p = jnp.exp(s - lse)                              # [BQ, BK]
         p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
-        dv = dv + _dot(p.astype(do.dtype), do, ((0,), (0,)))
+        # fwd tile (qi=i, j=kj): identical seed -> identical mask
+        u = _apply_dropout(p, seed_ref, i, kj, dropout_p)
+        dv = dv + _dot(u.astype(do.dtype), do, ((0,), (0,)))
         dp = _dot(do, v, ((1,), (1,)))
-        ds = p * (dp - delta) * scale                     # [BQ, BK]
+        ds = (u * dp - p * delta) * scale                 # [BQ, BK]
         dk = dk + _dot(ds.astype(q.dtype), q, ((0,), (0,)))
         return dk, dv
 
@@ -254,8 +308,8 @@ def _bwd_dkv_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, q_off, k_off, out, lse, do, dlse, scale, causal, block_q,
-         block_k, aligned):
+def _bwd(q, k, v, q_off, k_off, seed, out, lse, do, dlse, scale, causal,
+         block_q, block_k, aligned, dropout_p=0.0):
     """Full backward.  The lse cotangent folds into delta: with
     P = exp(S - lse) row-normalized, dS = P * (dP_rows - delta + dlse)
     since d lse / dS = P."""
@@ -269,7 +323,7 @@ def _bwd(q, k, v, q_off, k_off, out, lse, do, dlse, scale, causal, block_q,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
                           seq_k=Lk, causal=causal, block_q=block_q,
-                          aligned=aligned),
+                          aligned=aligned, dropout_p=dropout_p),
         grid=(B, H, Lq // block_q),
         in_specs=_qkv_fwd_specs(block_q, Lk, D) + [
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
@@ -280,14 +334,15 @@ def _bwd(q, k, v, q_off, k_off, out, lse, do, dlse, scale, causal, block_q,
                                lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
         interpret=_interpret(),
-    )(q_off, k_off, q, k, v, do, lse, delta)
+    )(q_off, k_off, seed, q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
                           seq_q=Lq, causal=causal, block_k=block_k,
-                          aligned=aligned),
+                          aligned=aligned, dropout_p=dropout_p),
         grid=(B, H, Lk // block_k),
         in_specs=[
+            _smem_scalar_spec(),
             _smem_scalar_spec(),
             _smem_scalar_spec(),
             pl.BlockSpec((1, 1, Lq, D), lambda b, h, j: (b, h, 0, 0)),
@@ -306,7 +361,7 @@ def _bwd(q, k, v, q_off, k_off, out, lse, do, dlse, scale, causal, block_q,
             jax.ShapeDtypeStruct((B, H, Lk, D), v.dtype),
         ],
         interpret=_interpret(),
-    )(q_off, k_off, q, k, v, do, lse, delta)
+    )(q_off, k_off, seed, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -314,25 +369,28 @@ def _bwd(q, k, v, q_off, k_off, out, lse, do, dlse, scale, causal, block_q,
 # custom-vjp cores over [B, H, L, D]
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _flash(q, k, v, q_off, k_off, scale, causal, block_q, block_k, aligned):
-    out, _ = _fwd(q, k, v, q_off, k_off, scale, causal, block_q, block_k,
-                  aligned)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, q_off, k_off, seed, scale, causal, block_q, block_k,
+           aligned, dropout_p):
+    out, _ = _fwd(q, k, v, q_off, k_off, seed, scale, causal, block_q,
+                  block_k, aligned, dropout_p)
     return out
 
 
-def _flash_fwd(q, k, v, q_off, k_off, scale, causal, block_q, block_k,
-               aligned):
-    out, lse = _fwd(q, k, v, q_off, k_off, scale, causal, block_q, block_k,
-                    aligned)
-    return out, (q, k, v, q_off, k_off, out, lse)
+def _flash_fwd(q, k, v, q_off, k_off, seed, scale, causal, block_q,
+               block_k, aligned, dropout_p):
+    out, lse = _fwd(q, k, v, q_off, k_off, seed, scale, causal, block_q,
+                    block_k, aligned, dropout_p)
+    return out, (q, k, v, q_off, k_off, seed, out, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, aligned, res, do):
-    q, k, v, q_off, k_off, out, lse = res
-    dq, dk, dv = _bwd(q, k, v, q_off, k_off, out, lse, do, None, scale,
-                      causal, block_q, block_k, aligned)
-    return dq, dk, dv, jnp.zeros_like(q_off), jnp.zeros_like(k_off)
+def _flash_bwd(scale, causal, block_q, block_k, aligned, dropout_p, res,
+               do):
+    q, k, v, q_off, k_off, seed, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, q_off, k_off, seed, out, lse, do, None,
+                      scale, causal, block_q, block_k, aligned, dropout_p)
+    return (dq, dk, dv, jnp.zeros_like(q_off), jnp.zeros_like(k_off),
+            None)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -341,21 +399,23 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def _flash_with_lse(q, k, v, q_off, k_off, scale, block_q, block_k):
     """Position-masked attention returning (out, lse) — the ring-attention
-    building block (both outputs differentiable)."""
-    return _fwd(q, k, v, q_off, k_off, scale, True, block_q, block_k, False)
+    building block (both outputs differentiable; no dropout: ring rounds
+    merge via logsumexp, which requires undropped weights)."""
+    return _fwd(q, k, v, q_off, k_off, _zero_seed(), scale, True, block_q,
+                block_k, False)
 
 
 def _flash_with_lse_fwd(q, k, v, q_off, k_off, scale, block_q, block_k):
-    out, lse = _fwd(q, k, v, q_off, k_off, scale, True, block_q, block_k,
-                    False)
+    out, lse = _fwd(q, k, v, q_off, k_off, _zero_seed(), scale, True,
+                    block_q, block_k, False)
     return (out, lse), (q, k, v, q_off, k_off, out, lse)
 
 
 def _flash_with_lse_bwd(scale, block_q, block_k, res, cts):
     q, k, v, q_off, k_off, out, lse = res
     do, dlse = cts
-    dq, dk, dv = _bwd(q, k, v, q_off, k_off, out, lse, do, dlse, scale,
-                      True, block_q, block_k, False)
+    dq, dk, dv = _bwd(q, k, v, q_off, k_off, _zero_seed(), out, lse, do,
+                      dlse, scale, True, block_q, block_k, False)
     return dq, dk, dv, jnp.zeros_like(q_off), jnp.zeros_like(k_off)
 
 
@@ -370,18 +430,38 @@ def _zero_off():
     return jnp.zeros((1, 1), jnp.float32)
 
 
+def _zero_seed():
+    return jnp.zeros((1, 1), jnp.int32)
+
+
 def flash_attention(q, k, v, causal: bool = False, scale=None,
-                    block_q: int = 512, block_k: int = 512):
-    """q/k/v: [B, L, H, D] arrays → [B, Lq, H, D] attention output."""
+                    block_q: int = 512, block_k: int = 512,
+                    dropout_p: float = 0.0, seed=None):
+    """q/k/v: [B, L, H, D] arrays → [B, Lq, H, D] attention output.
+
+    ``dropout_p > 0`` applies attention-probability dropout IN-KERNEL
+    (Pallas TPU PRNG, tile-seeded from ``seed`` so the backward
+    regenerates the identical mask); pass a fresh int32 ``seed`` array
+    ([1, 1]) per training step."""
     D = q.shape[-1]
     scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
     block_q = min(block_q, q.shape[1])
     block_k = min(block_k, k.shape[1])
+    if dropout_p > 0.0 and (_interpret() or pltpu is None):
+        raise NotImplementedError(
+            "flash_attention dropout needs the Pallas TPU PRNG (real TPU "
+            "only); use scaled_dot_product_attention, whose dispatch "
+            "falls back to the unfused path off-TPU")
+    if seed is None:
+        seed = _zero_seed()
+    else:
+        seed = jnp.asarray(seed, jnp.int32).reshape(1, 1)
     qt = jnp.swapaxes(q, 1, 2)      # [B, H, L, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = _flash(qt, kt, vt, _zero_off(), _zero_off(), scale, bool(causal),
-                 block_q, block_k, True)
+    out = _flash(qt, kt, vt, _zero_off(), _zero_off(), seed, scale,
+                 bool(causal), block_q, block_k, True,
+                 float(dropout_p))
     return jnp.swapaxes(out, 1, 2)
 
 

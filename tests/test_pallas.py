@@ -172,3 +172,15 @@ def test_supported_vmem_cap():
     # 32k x 64 f32 K/V cannot be staged whole in VMEM -> not supported
     big = (1, 32768, 1, 64)
     assert not flash_attention_supported(big, big, jnp.float32)
+
+
+def test_flash_dropout_raises_off_tpu():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    if jax.default_backend() == "tpu":
+        pytest.skip("TPU runs dropout in-kernel")
+    q = jnp.ones((1, 8, 1, 8), jnp.float32)
+    with pytest.raises(NotImplementedError, match="TPU"):
+        flash_attention(q, q, q, dropout_p=0.1)
