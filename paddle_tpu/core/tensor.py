@@ -239,8 +239,11 @@ class Tensor:
 
 class Parameter(Tensor):
     """Trainable tensor (reference: python/paddle/fluid/framework.py Parameter)."""
+    # _param_owner_step: weakref to a compiled step that holds the
+    # authoritative value (ZeRO-3 padded shards / LocalSGD replicas);
+    # Layer.state_dict syncs through it before reading p.data
     __slots__ = ("regularizer", "need_clip", "optimize_attr",
-                 "is_distributed")
+                 "is_distributed", "_param_owner_step")
 
     def __init__(self, data, name=None, trainable=True, regularizer=None,
                  need_clip=True):

@@ -51,6 +51,7 @@ class Fleet:
         self._optimizer = None
         self._user_optimizer = None
         self._model = None
+        self._train_step = None
 
     # -- lifecycle (fleet_base.py:130) ------------------------------------
     def init(self, role_maker=None, is_collective=True, strategy=None):
@@ -107,6 +108,10 @@ class Fleet:
         self._user_optimizer = optimizer
         opt = optimizer
         s = self._strategy or DistributedStrategy()
+        # fail loudly on strategies this build deliberately re-architects
+        # away (VERDICT r3: silent no-op toggles are worse than missing)
+        from .strategy import validate_toggles
+        validate_toggles(s)
         if s.lamb:
             from ..optimizer import Lamb
             if not isinstance(opt, Lamb):
@@ -133,12 +138,31 @@ class Fleet:
 
     def get_train_step(self, model, loss_fn, optimizer=None, n_inputs=1):
         """Compile the strategy into one SpmdTrainStep (the meta-optimizer
-        chain's terminal 'graph execution' stage, fleet_base.py:1191)."""
-        from ..parallel.spmd_train_step import SpmdTrainStep
+        chain's terminal 'graph execution' stage, fleet_base.py:1191).
+        strategy.localsgd / adaptive_localsgd route to the vmapped
+        per-replica LocalSGDTrainStep instead."""
         opt = optimizer or self._optimizer
-        return SpmdTrainStep(model, loss_fn, opt, mesh=ensure_mesh(),
-                             strategy=self._strategy, n_inputs=n_inputs,
-                             donate=True)
+        s = self._strategy or DistributedStrategy()
+        if s.localsgd or s.adaptive_localsgd:
+            from ..parallel.localsgd import LocalSGDTrainStep
+            step = LocalSGDTrainStep(model, loss_fn, opt,
+                                     mesh=ensure_mesh(), strategy=s,
+                                     n_inputs=n_inputs,
+                                     adaptive=s.adaptive_localsgd)
+        else:
+            from ..parallel.spmd_train_step import SpmdTrainStep
+            step = SpmdTrainStep(model, loss_fn, opt, mesh=ensure_mesh(),
+                                 strategy=s, n_inputs=n_inputs,
+                                 donate=True)
+        self._train_step = step
+        return step
+
+    def _sync_step_params(self):
+        """Pull authoritative weights out of the compiled step before any
+        persistence read (LocalSGD replicas / ZeRO-3 padded shards)."""
+        step = getattr(self, "_train_step", None)
+        if step is not None:
+            step.sync_params()
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -149,14 +173,34 @@ class Fleet:
     def save_persistables(self, executor=None, dirname=None,
                           main_program=None, mode=0):
         import paddle_tpu as paddle
+        self._sync_step_params()
         if self._model is not None and dirname:
             paddle.save(self._model.state_dict(),
                         os.path.join(dirname, "model.pdparams"))
 
     def save_inference_model(self, executor=None, dirname=None,
                              feeded_var_names=None, target_vars=None,
-                             main_program=None, export_for_deployment=True):
-        pass
+                             main_program=None, export_for_deployment=True,
+                             model=None, input_spec=None):
+        """Export a serveable artifact (reference fleet_base.py:550 →
+        save_inference_model).  Delegates to ``paddle.jit.save`` — the
+        StableHLO artifact the Predictor consumes.  Pass ``model`` +
+        ``input_spec`` (or call ``distributed_model`` first and give the
+        model a traced ``forward``)."""
+        model = model or self._model
+        if model is None:
+            raise ValueError(
+                "fleet.save_inference_model: no model registered — call "
+                "fleet.distributed_model(model) first or pass model=...")
+        self._sync_step_params()
+        if dirname is None:
+            raise ValueError("fleet.save_inference_model: dirname required")
+        from .. import jit as pjit
+        # unwrap DataParallel shells
+        inner = getattr(model, "_layers", model)
+        path = os.path.join(dirname, "model")
+        pjit.save(inner, path, input_spec=input_spec)
+        return path
 
     @property
     def util(self):

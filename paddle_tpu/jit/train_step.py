@@ -60,7 +60,8 @@ class TrainStep:
 
     def __init__(self, model, loss_fn: Callable, optimizer,
                  n_inputs: int = 1, donate: bool = False, scaler=None,
-                 accumulate_steps: int = 1, amp_level: Optional[str] = None):
+                 accumulate_steps: int = 1, amp_level: Optional[str] = None,
+                 recompute: bool = False):
         # donate=False by default: eager user code may alias param arrays
         # (e.g. state_dict sharing); SpmdTrainStep/bench enable donation.
         self.model = model
@@ -80,6 +81,11 @@ class TrainStep:
         # `with amp.auto_cast(...)`); None = trace ops at their natural
         # dtypes (pure-bf16 after amp.decorate O2)
         self.amp_level = amp_level
+        # recompute: rematerialise the forward during backward instead of
+        # storing activations — the reference's recompute meta-optimizer
+        # (fleet/meta_optimizers/recompute_optimizer.py:18) as jax.checkpoint
+        # over the whole loss (checkpoints=[] edge: keep only the inputs)
+        self._recompute = bool(recompute)
         self._scaler_state = None
         self._lr_value = None
         self._lr_device = None
@@ -97,6 +103,38 @@ class TrainStep:
         """Applied to (unscaled) grads before the optimizer update.
         SpmdTrainStep overrides this for ZeRO-2 grad sharding."""
         return grads
+
+    def _decode_params(self, p_list):
+        """Stored form -> model-shaped arrays (inside the trace).
+        SpmdTrainStep overrides this to un-pad ZeRO-3 padded shards."""
+        return p_list
+
+    def _wrap_loss_and_grad(self, fn):
+        """Wrap the per-microbatch (b_cur, inputs, labels, kidx) ->
+        (loss, new_buffers, grads) function.  SpmdTrainStep overrides this
+        for fp16_allreduce (shard_map with reduced-precision grad psum)."""
+        return fn
+
+    def _value_and_grad(self, loss_of, p_list):
+        """Differentiate ``loss_of`` (returns (scaled_loss, (loss, new_b)))
+        w.r.t. the stored param list, honoring ``recompute``."""
+        if self._recompute:
+            loss_of = jax.checkpoint(loss_of)
+        return jax.value_and_grad(loss_of, has_aux=True)(p_list)
+
+    def _param_arrays(self):
+        """Stored param arrays fed to the compiled step (subclasses may
+        keep a padded/sharded store distinct from ``p.data``)."""
+        return tuple(p.data for p in self._params)
+
+    def _writeback_params(self, new_p):
+        for p, arr in zip(self._params, new_p):
+            p.data = arr
+
+    def sync_params(self):
+        """Materialise any step-held authoritative weights into the model
+        (no-op here; ZeRO-3 padded / LocalSGD subclasses override).  Layer
+        .state_dict() calls this via the ``_param_owner_step`` hook."""
 
     # -- the compiled step -------------------------------------------------
     def _make_step_fn(self):
@@ -141,12 +179,13 @@ class TrainStep:
                                  dtype=getattr(model, "_amp_dtype",
                                                "bfloat16"))
 
-            def loss_and_grad(b_cur, mb_inputs, mb_labels, kidx):
+            def loss_and_grad(p_cur, b_cur, mb_inputs, mb_labels, kidx):
                 def loss_of(p_list):
                     k_mb = jax.random.fold_in(key, kidx)
+                    p_model = self._decode_params(p_list)
                     with autograd.no_grad(), rng.seed_scope(k_mb), \
                             amp_scope():
-                        with bind(model, p_list, list(b_cur)) as res:
+                        with bind(model, p_model, list(b_cur)) as res:
                             out = model(*[Tensor(a) for a in mb_inputs])
                             lab = [Tensor(a) for a in mb_labels]
                             loss_t = loss_fn(out, *lab)
@@ -158,12 +197,15 @@ class TrainStep:
                     scaled = loss * scale if scaler is not None else loss
                     return scaled, (loss, new_b)
 
-                (_, (loss, new_b)), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(list(p_arr))
+                (_, (loss, new_b)), grads = self._value_and_grad(
+                    loss_of, list(p_cur))
                 return loss, new_b, grads
 
+            loss_and_grad = self._wrap_loss_and_grad(loss_and_grad)
+
             if K <= 1:
-                loss, new_b, grads = loss_and_grad(b_arr, inputs, labels, 0)
+                loss, new_b, grads = loss_and_grad(p_arr, b_arr, inputs,
+                                                   labels, 0)
             else:
                 # gradient merge: scan over K microbatches, f32 accumulators
                 mb_in = tuple(a.reshape(K, a.shape[0] // K, *a.shape[1:])
@@ -174,7 +216,8 @@ class TrainStep:
                 def mb_body(carry, xs):
                     b_cur, g_acc, l_acc = carry
                     idx, ins, labs = xs
-                    loss, new_b, grads = loss_and_grad(b_cur, ins, labs, idx)
+                    loss, new_b, grads = loss_and_grad(p_arr, b_cur, ins,
+                                                       labs, idx)
                     g_acc = [ga + g.astype(jnp.float32)
                              for ga, g in zip(g_acc, grads)]
                     return (new_b, g_acc, l_acc + loss), None
@@ -277,7 +320,7 @@ class TrainStep:
                 raise ValueError(
                     f"batch size {bs} is not divisible by "
                     f"accumulate_steps={self.accumulate_steps}")
-        p_arr = tuple(p.data for p in self._params)
+        p_arr = self._param_arrays()
         b_arr = tuple(buffer_arrays(self.model))
         if self._opt_state is None:
             self._opt_state = self.optimizer.functional_init(list(p_arr))
@@ -300,8 +343,7 @@ class TrainStep:
             p_arr, b_arr, self._opt_state, self._scaler_state,
             self._lr_device, inputs, labels)
         # write back (device-side aliasing, no host copies)
-        for p, arr in zip(self._params, new_p):
-            p.data = arr
+        self._writeback_params(new_p)
         if self._buffer_objs is None:
             buffers = dict(self.model.named_buffers())
             self._buffer_objs = [buffers[n] for n in self._bnames]
@@ -323,8 +365,9 @@ class TrainStep:
         if compiled is None:
             def eval_fn(p_arr, b_arr, key_data, inputs, labels):
                 k = jax.random.wrap_key_data(key_data)
+                p_model = self._decode_params(list(p_arr))
                 with autograd.no_grad(), rng.seed_scope(k):
-                    with bind(model, list(p_arr), list(b_arr)):
+                    with bind(model, p_model, list(b_arr)):
                         out = model(*[Tensor(a) for a in inputs])
                         lab = [Tensor(a) for a in labels]
                         loss_t = loss_fn(out, *lab)
@@ -334,7 +377,7 @@ class TrainStep:
                 return loss_t.data, out_arr
             compiled = jax.jit(eval_fn)
             self._compiled[key] = compiled
-        p_arr = tuple(p.data for p in self._params)
+        p_arr = self._param_arrays()
         b_arr = tuple(buffer_arrays(self.model))
         key_data = jax.random.key_data(rng.next_key())
         loss, out = compiled(p_arr, b_arr, key_data, inputs, labels)

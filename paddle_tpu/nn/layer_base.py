@@ -264,6 +264,13 @@ class Layer:
                    structured_name_prefix="", use_hook=True) -> Dict[str, Tensor]:
         dest = destination if destination is not None else collections.OrderedDict()
         for n, p in self.named_parameters(structured_name_prefix.rstrip(".")):
+            # a compiled step may hold the authoritative value elsewhere
+            # (ZeRO-3 padded shards, LocalSGD replicas); let it refresh
+            # p.data before we hand out a stale mirror
+            owner = getattr(p, "_param_owner_step", None)
+            owner = owner() if owner is not None else None
+            if owner is not None:
+                owner.sync_params()
             dest[n] = p
         for n, b in self._named_persistable_buffers(
                 structured_name_prefix.rstrip(".")):

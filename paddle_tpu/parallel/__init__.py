@@ -3,6 +3,7 @@
 layers, sharding_optimizer.py, section_worker.cc pipeline schedules; plus
 beyond-reference ring attention, SURVEY §5.7)."""
 from .data_parallel import DataParallel  # noqa: F401
+from .localsgd import LocalSGDTrainStep  # noqa: F401
 from .pipeline import (Pipeline, PipelineStage, pipelined_fn,  # noqa
                        pipeline_train_fn, stack_stage_params)
 from .recompute import recompute, recompute_sequential  # noqa: F401

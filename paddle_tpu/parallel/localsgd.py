@@ -1,0 +1,341 @@
+"""LocalSGD / AdaptiveLocalSGD — reduced-frequency parameter averaging.
+
+Reference semantics (fleet/meta_optimizers/localsgd_optimizer.py):
+- every worker runs the inner optimizer on its own gradient (no per-step
+  grad allreduce);
+- params are averaged across workers every step until ``step >
+  begin_step`` (localsgd_optimizer.py:190 cond), then every ``k_steps``;
+- AdaptiveLocalSGD recomputes ``k`` at each sync (:417-433):
+  ``k = clip(ceil(sqrt(lr_0 * loss / (lr * loss_0) * init_k)), 1, 16)``
+  where ``loss_0``/``lr_0`` are captured during the warm-up syncs;
+- inner-optimizer slots (momentum) stay local — the reference only
+  allreduces the params (snapshot-delta choreography :150-185).
+
+TPU-native redesign: instead of N per-worker programs + conditional
+allreduce ops, every param/buffer/slot carries a leading *replica* axis of
+size dp sharded over the mesh's 'dp' axis, and the local update is
+``jax.vmap`` over that axis — XLA keeps each replica's compute on its own
+devices because dim 0 is dp-sharded, so no collective runs on non-sync
+steps.  The periodic sync is a mean over dim 0 (GSPMD lowers it to one
+fused all-reduce) selected by an in-graph predicate; the adaptive-k state
+machine is a handful of scalar ops in the same compiled step, so sync
+steps and local steps are the SAME executable (no host-side branching,
+zero recompiles).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd, rng
+from ..core.tensor import Tensor
+from ..distributed.mesh import DP_AXIS, ensure_mesh
+from ..distributed.strategy import DistributedStrategy
+from ..jit.bind import bind, buffer_arrays, buffer_names, param_list
+from jax.sharding import NamedSharding, PartitionSpec
+
+_as_arr = lambda x: x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class LocalSGDTrainStep:
+    """Compiled LocalSGD step: per-replica local updates + periodic mean.
+
+    ``adaptive=True`` enables the AdaptiveLocalSGD k schedule.  Model
+    params are NOT refreshed per step (each dp shard owns a diverged
+    replica): call :meth:`sync_to_model` before reading weights out of the
+    model (``model.state_dict()``, checkpointing, eval).
+    ``fleet.save_persistables``/``save_inference_model`` do this for the
+    step they created; direct ``model.state_dict()`` reads are stale until
+    you sync."""
+
+    K_MIN, K_MAX = 1, 16   # localsgd_optimizer.py:425-428
+    scaler = None          # optimizer checkpoint protocol (no fp16 scaler)
+
+    def __init__(self, model, loss_fn, optimizer, mesh=None,
+                 strategy: Optional[DistributedStrategy] = None,
+                 n_inputs: int = 1, adaptive: Optional[bool] = None):
+        strategy = strategy or DistributedStrategy()
+        from ..distributed.strategy import validate_toggles
+        validate_toggles(strategy)
+        # composable toggles are wired below (amp bf16 autocast,
+        # recompute); everything else must be loud, not silently dropped
+        unsupported = [t for t in ("sharding", "gradient_merge",
+                                   "fp16_allreduce", "tensor_parallel",
+                                   "pipeline", "sequence_parallel")
+                       if getattr(strategy, t)]
+        if unsupported:
+            raise NotImplementedError(
+                f"strategy.localsgd does not compose with {unsupported}: "
+                f"LocalSGD keeps full per-replica params/slots on each dp "
+                f"shard (the reference restricts it similarly, "
+                f"localsgd_optimizer.py:27-31 black_list).  Drop the "
+                f"toggle(s) or use plain SpmdTrainStep.")
+        if strategy.amp and strategy.amp_configs.dtype == "float16":
+            raise NotImplementedError(
+                "localsgd + float16 dynamic loss scaling is not wired; "
+                "use amp_configs.dtype='bfloat16' (no scaler needed).")
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.n_inputs = n_inputs
+        self.mesh = mesh or ensure_mesh()
+        self.strategy = strategy
+        self._amp = bool(strategy.amp)
+        self._recompute = bool(strategy.recompute)
+        if adaptive is None:
+            adaptive = strategy.adaptive_localsgd
+        self.adaptive = bool(adaptive)
+        if self.adaptive:
+            cfg = strategy.adaptive_localsgd_configs
+            self._k0 = int(cfg.init_k_steps)
+            self._begin = int(cfg.begin_step)
+        else:
+            cfg = strategy.localsgd_configs
+            self._k0 = int(cfg.k_steps)
+            self._begin = int(cfg.begin_step)
+        others = [a for a, s in self.mesh.shape.items()
+                  if a != DP_AXIS and s > 1]
+        if others:
+            raise NotImplementedError(
+                f"LocalSGD averages full param replicas over 'dp'; model "
+                f"shardings over {others} are not composable with it "
+                f"(the reference restricts it to collective DP too, "
+                f"localsgd_optimizer.py:34-47).")
+        self.dp = self.mesh.shape.get(DP_AXIS, 1)
+        self._params = param_list(model)
+        self._bnames = buffer_names(model)
+        self._p_rep = None
+        self._b_rep = None
+        self._s_rep = None
+        self._aux = None
+        self._compiled = None
+        self._lr_value = None
+        self._lr_device = None
+        optimizer._bound_train_step = self
+
+    # -- sharded replica store --------------------------------------------
+    def _rep_sharding(self):
+        return NamedSharding(self.mesh, PartitionSpec(DP_AXIS))
+
+    def _replicate(self, arr):
+        rep = jnp.broadcast_to(arr[None], (self.dp,) + arr.shape)
+        return jax.device_put(rep, self._rep_sharding())
+
+    def _init_state(self):
+        import weakref
+        for p in self._params:
+            p._param_owner_step = weakref.ref(self)  # state_dict auto-sync
+        self._p_rep = tuple(self._replicate(p.data) for p in self._params)
+        self._b_rep = tuple(self._replicate(a)
+                            for a in buffer_arrays(self.model))
+        base = self.optimizer.functional_init(
+            [p.data for p in self._params])
+        self._s_rep = jax.tree.map(self._replicate, base)
+        # seed the applied-step counter from the optimizer's host count so
+        # a set_state_dict before (re)start is honored (TrainStep parity)
+        start = int(self.optimizer._step_count)
+        self._aux = {
+            "step": jnp.asarray(start, jnp.int32),
+            "draw": jnp.asarray(0, jnp.int32),
+            "last": jnp.asarray(start, jnp.int32),
+            "k": jnp.asarray(self._k0, jnp.int32),
+            "key": jax.random.key_data(rng.next_key()),
+            # 0.0 = "not captured yet" sentinel: the first sync captures
+            # loss0/lr0 even when begin_step=0 (no warm-up syncs happen)
+            "loss0": jnp.asarray(0.0, jnp.float32),
+            "lr0": jnp.asarray(0.0, jnp.float32),
+        }
+
+    # -- optimizer checkpoint protocol ------------------------------------
+    # optimizer.state_dict()/set_state_dict() talk to the bound step via
+    # `_scaler_state` (the device-resident aux carry): expose ours under
+    # that name, and let set_state_dict reset it so the next call reseeds
+    # from the loaded host counter (optimizer.py:_effective_step).
+    @property
+    def _scaler_state(self):
+        return self._aux
+
+    @_scaler_state.setter
+    def _scaler_state(self, value):
+        if value is None:
+            # also drop the replica store: loaded weights in p.data must
+            # win over the stale diverged replicas
+            self._p_rep = self._b_rep = self._s_rep = None
+        self._aux = value
+
+    # -- the compiled step -------------------------------------------------
+    def _make_step_fn(self):
+        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        params_meta = self._params
+        bnames = self._bnames
+        dp, begin, k0 = self.dp, self._begin, self._k0
+        adaptive = self.adaptive
+
+        def step_fn(p_rep, b_rep, s_rep, aux, lr, inputs, labels):
+            key = jax.random.wrap_key_data(aux["key"])
+            attempt = aux["step"] + 1
+            draw = aux["draw"] + 1
+            step_f = attempt.astype(jnp.float32)
+            base_key = jax.random.fold_in(key, draw)
+
+            mb_in = tuple(a.reshape(dp, a.shape[0] // dp, *a.shape[1:])
+                          for a in inputs)
+            mb_lab = tuple(a.reshape(dp, a.shape[0] // dp, *a.shape[1:])
+                           for a in labels)
+            rep_keys = jax.vmap(
+                lambda r: jax.random.key_data(
+                    jax.random.fold_in(base_key, r)))(jnp.arange(dp))
+
+            import contextlib
+            use_amp, use_remat = self._amp, self._recompute
+            amp_dtype = (self.strategy.amp_configs.dtype if use_amp
+                         else "bfloat16")
+
+            def amp_scope():
+                if not use_amp:
+                    return contextlib.nullcontext()
+                from ..amp import auto_cast
+                return auto_cast(level="O1", dtype=amp_dtype)
+
+            def local(p_l, b_l, s_l, ins, labs, kd):
+                k_r = jax.random.wrap_key_data(kd)
+
+                def loss_of(pl):
+                    with autograd.no_grad(), rng.seed_scope(k_r), \
+                            amp_scope():
+                        with bind(model, list(pl), list(b_l)) as res:
+                            out = model(*[Tensor(a) for a in ins])
+                            lab = [Tensor(a) for a in labs]
+                            loss_t = loss_fn(out, *lab)
+                        new_b = tuple(
+                            _as_arr(res.new_buffers.get(n, old))
+                            for n, old in zip(bnames, b_l))
+                    return loss_t.data, new_b
+
+                if use_remat:
+                    loss_of = jax.checkpoint(loss_of)
+                (loss, new_b), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(list(p_l))
+                new_p, new_s = opt.functional_update(
+                    list(p_l), grads, s_l, lr, step_f,
+                    params_meta=params_meta)
+                return loss, tuple(new_p), new_b, new_s
+
+            losses, new_p, new_b, new_s = jax.vmap(local)(
+                p_rep, b_rep, s_rep, mb_in, mb_lab, rep_keys)
+            loss_avg = jnp.mean(losses)
+
+            # sync predicate (localsgd_optimizer.py:188-190): every step
+            # while attempt <= begin_step, then every k steps after
+            warm = attempt <= begin
+            due = (attempt - aux["last"]) >= aux["k"]
+            sync = jnp.logical_or(warm, due)
+            mean0 = lambda a: jnp.broadcast_to(
+                jnp.mean(a.astype(jnp.float32), axis=0, keepdims=True),
+                a.shape).astype(a.dtype)
+            # lax.cond, not where: the cross-replica mean lowers to an
+            # all-reduce over 'dp', which must only execute on sync steps
+            # (otherwise LocalSGD's bandwidth saving evaporates)
+            new_p, new_b = jax.lax.cond(
+                sync,
+                lambda t: (jax.tree.map(mean0, t[0]),
+                           jax.tree.map(mean0, t[1])),
+                lambda t: t,
+                (new_p, new_b))
+
+            new_aux = dict(aux)
+            new_aux.update(step=attempt, draw=draw,
+                           last=jnp.where(sync, attempt, aux["last"]))
+            if adaptive:
+                # capture loss_0/lr_0 during warm-up syncs (:354-355) —
+                # or at the first sync ever if begin_step=0 skipped warm-up
+                captured = aux["loss0"] > 0
+                grab = jnp.logical_and(sync, jnp.logical_or(
+                    warm, jnp.logical_not(captured)))
+                loss0 = jnp.where(grab, loss_avg, aux["loss0"])
+                lr0 = jnp.where(grab, lr, aux["lr0"])
+                # re-derive k at post-warm-up syncs (:417-433), only once
+                # a baseline exists
+                k_next = jnp.ceil(jnp.sqrt(
+                    lr0 * loss_avg / (lr * loss0 + 1e-12) * k0))
+                k_next = jnp.clip(k_next.astype(jnp.int32),
+                                  self.K_MIN, self.K_MAX)
+                adapt = jnp.logical_and(
+                    jnp.logical_and(sync, ~warm),
+                    jnp.logical_and(captured, ~grab))
+                new_aux.update(
+                    loss0=loss0, lr0=lr0,
+                    k=jnp.where(adapt, k_next, aux["k"]))
+            return loss_avg, new_p, new_b, new_s, new_aux
+
+        return step_fn
+
+    def _build(self):
+        rep = self._rep_sharding()
+        scalar = NamedSharding(self.mesh, PartitionSpec())
+        p_specs = tuple(rep for _ in self._p_rep)
+        b_specs = tuple(rep for _ in self._b_rep)
+        s_specs = jax.tree.map(lambda _: rep, self._s_rep)
+        aux_specs = {k: scalar for k in self._aux}
+        batch = NamedSharding(self.mesh, PartitionSpec(DP_AXIS))
+        jitted = jax.jit(
+            self._make_step_fn(),
+            in_shardings=(p_specs, b_specs, s_specs, aux_specs, scalar,
+                          None, None),
+            out_shardings=(scalar, p_specs, b_specs, s_specs, aux_specs),
+            donate_argnums=(0, 1, 2, 3))
+
+        def run(p, b, s, aux, lr, inputs, labels):
+            put = lambda a: jax.device_put(a, batch)
+            return jitted(p, b, s, aux, lr,
+                          tuple(put(a) for a in inputs),
+                          tuple(put(a) for a in labels))
+
+        return run
+
+    def __call__(self, *batch):
+        inputs = tuple(_as_arr(b) for b in batch[:self.n_inputs])
+        labels = tuple(_as_arr(b) for b in batch[self.n_inputs:])
+        if inputs[0].shape[0] % self.dp:
+            raise ValueError(
+                f"batch size {inputs[0].shape[0]} not divisible by "
+                f"dp={self.dp}")
+        if self._p_rep is None:
+            self._init_state()
+        if self._compiled is None:
+            self._compiled = self._build()
+        self.optimizer._step_count += 1
+        lr_val = float(self.optimizer.get_lr())
+        if lr_val != self._lr_value:
+            self._lr_value = lr_val
+            self._lr_device = jnp.asarray(lr_val, jnp.float32)
+        loss, self._p_rep, self._b_rep, self._s_rep, self._aux = (
+            self._compiled(self._p_rep, self._b_rep, self._s_rep,
+                           self._aux, self._lr_device, inputs, labels))
+        self._model_dirty = True
+        return Tensor(loss)
+
+    @property
+    def k_steps(self) -> int:
+        """Current (possibly adapted) sync interval — host sync."""
+        return int(self._aux["k"]) if self._aux is not None else self._k0
+
+    def sync_params(self):
+        """Unified step contract (TrainStep.sync_params): materialise the
+        authoritative weights into the model."""
+        self.sync_to_model()
+
+    def sync_to_model(self):
+        """Average the dp replicas back into model params/buffers."""
+        if self._p_rep is None or not getattr(self, "_model_dirty", False):
+            return
+        self._model_dirty = False
+        for p, rep in zip(self._params, self._p_rep):
+            p.data = jnp.mean(rep.astype(jnp.float32), axis=0).astype(
+                rep.dtype)
+        buffers = dict(self.model.named_buffers())
+        for n, rep in zip(self._bnames, self._b_rep):
+            buffers[n].data = jnp.mean(
+                rep.astype(jnp.float32), axis=0).astype(rep.dtype)

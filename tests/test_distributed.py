@@ -435,7 +435,7 @@ def test_zero3_param_sharding_parity():
     mesh = dist.init_mesh({"dp": 4})
     strat = DistributedStrategy()
     strat.sharding = True
-    strat.sharding_configs = {"stage": 3}
+    strat.sharding_configs = {"stage": 3, "min_shard_numel": 1}
     opt = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
     step = SpmdTrainStep(net, loss_fn, opt, mesh=mesh, strategy=strat)
     z3_losses = [float(step(x, y)) for _ in range(3)]
@@ -443,9 +443,9 @@ def test_zero3_param_sharding_parity():
     # params actually sharded over dp
     from paddle_tpu.parallel.tp_layers import get_placement
     from jax.sharding import PartitionSpec
-    sharded = [p for p in step._params
+    sharded = [(i, p) for i, p in enumerate(step._params)
                if p.data.shape and p.data.shape[0] % 4 == 0]
-    specs = [step._param_spec(p) for p in sharded]
+    specs = [step._param_spec(i, p) for i, p in sharded]
     assert any(s == PartitionSpec("dp") for s in specs), specs
 
     net.set_state_dict(init)

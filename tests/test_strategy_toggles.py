@@ -1,0 +1,395 @@
+"""Every DistributedStrategy toggle is real or loud (VERDICT r3 #2).
+
+Reference analogs: fleet/meta_optimizers/localsgd_optimizer.py (LocalSGD +
+AdaptiveLocalSGD), fp16_allreduce_optimizer.py, recompute_optimizer.py,
+dgc_optimizer.py, distributed_strategy.proto:106-118 (a_sync).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed.strategy import DistributedStrategy
+from paddle_tpu.parallel import LocalSGDTrainStep, SpmdTrainStep
+import paddle_tpu.nn.functional as F
+
+
+@pytest.fixture(autouse=True)
+def _mesh_dp8():
+    dist.init_mesh({"dp": 8})
+    yield
+
+
+def _toy(seed=7, din=4, dout=3, bs=16):
+    paddle.seed(seed)
+    net = nn.Linear(din, dout)
+    r = np.random.RandomState(seed)
+    x = jnp.asarray(r.randn(bs, din), jnp.float32)
+    y = jnp.asarray(r.randn(bs, dout), jnp.float32)
+    loss_fn = lambda out, lab: F.mse_loss(out, lab)
+    return net, x, y, loss_fn
+
+
+def _weights(net):
+    return {k: np.asarray(v.data).copy() for k, v in net.state_dict().items()}
+
+
+# -- LocalSGD ------------------------------------------------------------
+
+def _localsgd_oracle(w0, b0, x, y, lr, dp, k_steps, begin, n_steps):
+    """NumPy oracle: per-replica SGD on its batch shard, mean every-step
+    during warmup then every k steps (reference cond, :188-190)."""
+    W = [w0.copy() for _ in range(dp)]
+    B = [b0.copy() for _ in range(dp)]
+    xs = x.reshape(dp, -1, x.shape[1])
+    ys = y.reshape(dp, -1, y.shape[1])
+    last = 0
+    for t in range(1, n_steps + 1):
+        for r in range(dp):
+            pred = xs[r] @ W[r] + B[r]
+            e = pred - ys[r]
+            n = e.size
+            gW = 2.0 / n * xs[r].T @ e
+            gB = 2.0 / n * e.sum(0)
+            W[r] = W[r] - lr * gW
+            B[r] = B[r] - lr * gB
+        sync = (t <= begin) or (t - last >= k_steps)
+        if sync:
+            Wm, Bm = np.mean(W, 0), np.mean(B, 0)
+            W = [Wm.copy() for _ in range(dp)]
+            B = [Bm.copy() for _ in range(dp)]
+            last = t
+    return np.mean(W, 0), np.mean(B, 0)
+
+
+def test_localsgd_matches_numpy_oracle():
+    net, x, y, loss_fn = _toy()
+    w0 = np.asarray(net.weight.data).copy()
+    b0 = np.asarray(net.bias.data).copy()
+    strat = DistributedStrategy()
+    strat.localsgd = True
+    strat.localsgd_configs = {"k_steps": 3, "begin_step": 2}
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = LocalSGDTrainStep(net, loss_fn, opt, strategy=strat)
+    for _ in range(7):
+        step(x, y)
+    step.sync_to_model()
+    We, Be = _localsgd_oracle(w0, b0, np.asarray(x), np.asarray(y),
+                              0.1, 8, 3, 2, 7)
+    np.testing.assert_allclose(np.asarray(net.weight.data), We, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(net.bias.data), Be, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_localsgd_k1_equals_plain_dp():
+    """k_steps=1 syncs every step — must match the SpmdTrainStep DP
+    baseline (grad-mean == param-mean for SGD on a linear model)."""
+    net, x, y, loss_fn = _toy(seed=11)
+    init = _weights(net)
+    strat = DistributedStrategy()
+    strat.localsgd = True
+    strat.localsgd_configs = {"k_steps": 1, "begin_step": 0}
+    opt = optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+    step = LocalSGDTrainStep(net, loss_fn, opt, strategy=strat)
+    for _ in range(4):
+        step(x, y)
+    step.sync_to_model()
+    w_local = np.asarray(net.weight.data).copy()
+
+    net.set_state_dict(init)
+    opt2 = optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+    base = SpmdTrainStep(net, loss_fn, opt2)
+    for _ in range(4):
+        base(x, y)
+    np.testing.assert_allclose(w_local, np.asarray(net.weight.data),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_localsgd_diverges_between_syncs():
+    """With k=4 the replicas genuinely diverge mid-interval (the toggle
+    changes numerics — VERDICT: no silent no-op)."""
+    net, x, y, loss_fn = _toy(seed=13)
+    strat = DistributedStrategy()
+    strat.localsgd = True
+    strat.localsgd_configs = {"k_steps": 4, "begin_step": 0}
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = LocalSGDTrainStep(net, loss_fn, opt, strategy=strat)
+    step(x, y)   # step 1: no sync (1-0 < 4)
+    rep = np.asarray(step._p_rep[0])
+    spread = np.abs(rep - rep.mean(0, keepdims=True)).max()
+    assert spread > 1e-6, "replicas did not diverge — localsgd inert"
+
+
+def test_adaptive_localsgd_adapts_k():
+    net, x, y, loss_fn = _toy(seed=17)
+    strat = DistributedStrategy()
+    strat.adaptive_localsgd = True
+    strat.adaptive_localsgd_configs = {"init_k_steps": 4, "begin_step": 2}
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = LocalSGDTrainStep(net, loss_fn, opt, strategy=strat)
+    assert step.adaptive
+    ks = []
+    for _ in range(12):
+        step(x, y)
+        ks.append(step.k_steps)
+    assert all(1 <= k <= 16 for k in ks)
+    # loss decreases on this convex problem → k should shrink from init
+    assert ks[-1] <= 4
+    assert len(set(ks)) > 1, "k never adapted"
+
+
+def test_fleet_routes_localsgd():
+    from paddle_tpu.distributed.fleet import Fleet
+    f = Fleet()
+    strat = DistributedStrategy()
+    strat.localsgd = True
+    f.init(strategy=strat)
+    net, x, y, loss_fn = _toy(seed=19)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    f.distributed_optimizer(opt)
+    step = f.get_train_step(net, loss_fn)
+    assert isinstance(step, LocalSGDTrainStep)
+    l0 = float(step(x, y))
+    l1 = float(step(x, y))
+    assert l1 < l0
+
+
+# -- fp16_allreduce ------------------------------------------------------
+
+def test_fp16_allreduce_quantises_grads():
+    """The toggle must change numerics (bf16-quantised grad reduction)
+    while staying close to the f32 baseline."""
+    net, x, y, loss_fn = _toy(seed=23, din=8, dout=8, bs=32)
+    init = _weights(net)
+    strat = DistributedStrategy()
+    strat.fp16_allreduce = True
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = SpmdTrainStep(net, loss_fn, opt, strategy=strat)
+    for _ in range(3):
+        step(x, y)
+    w_half = np.asarray(net.weight.data).copy()
+
+    net.set_state_dict(init)
+    opt2 = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    base = SpmdTrainStep(net, loss_fn, opt2)
+    for _ in range(3):
+        base(x, y)
+    w_full = np.asarray(net.weight.data).copy()
+    # close (bf16 has ~3 decimal digits) but NOT bitwise identical
+    np.testing.assert_allclose(w_half, w_full, rtol=3e-2, atol=3e-3)
+    assert not np.array_equal(w_half, w_full), \
+        "fp16_allreduce changed nothing — silent no-op"
+
+
+def test_fp16_allreduce_rejects_model_sharding():
+    net, x, y, loss_fn = _toy()
+    dist.init_mesh({"dp": 4, "mp": 2})
+    strat = DistributedStrategy()
+    strat.fp16_allreduce = True
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    with pytest.raises(NotImplementedError, match="fp16_allreduce"):
+        SpmdTrainStep(net, loss_fn, opt, strategy=strat)
+
+
+# -- recompute -----------------------------------------------------------
+
+def test_recompute_toggle_remats_and_matches():
+    net, x, y, loss_fn = _toy(seed=29)
+    init = _weights(net)
+    strat = DistributedStrategy()
+    strat.recompute = True
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = SpmdTrainStep(net, loss_fn, opt, strategy=strat)
+    assert step._recompute
+    # the jaxpr of the compiled step contains a remat call
+    fn = step._make_step_fn()
+    p_arr = tuple(p.data for p in step._params)
+    state = opt.functional_init(list(p_arr))
+    aux = step._init_scaler_state()
+    jaxpr = jax.make_jaxpr(fn)(p_arr, (), state, aux,
+                               jnp.float32(0.1), (x,), (y,))
+    assert "remat" in str(jaxpr), "strategy.recompute did not remat"
+    losses = [float(step(x, y)) for _ in range(3)]
+
+    net.set_state_dict(init)
+    opt2 = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    base = SpmdTrainStep(net, loss_fn, opt2)
+    base_losses = [float(base(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(losses, base_losses, rtol=1e-5)
+
+
+# -- dead toggles raise --------------------------------------------------
+
+def test_dgc_raises():
+    net, x, y, loss_fn = _toy()
+    strat = DistributedStrategy()
+    strat.dgc = True
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    with pytest.raises(NotImplementedError, match="dgc"):
+        SpmdTrainStep(net, loss_fn, opt, strategy=strat)
+    from paddle_tpu.distributed.fleet import Fleet
+    f = Fleet()
+    f.init(strategy=strat)
+    with pytest.raises(NotImplementedError, match="dgc"):
+        f.distributed_optimizer(opt)
+
+
+def test_a_sync_raises():
+    net, x, y, loss_fn = _toy()
+    strat = DistributedStrategy()
+    strat.a_sync = True
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    with pytest.raises(NotImplementedError, match="a_sync"):
+        SpmdTrainStep(net, loss_fn, opt, strategy=strat)
+
+
+# -- ZeRO-3 padded sharding (VERDICT r3 #10) -----------------------------
+
+def test_zero3_pads_odd_params():
+    """Params whose dim0 % dp != 0 must still shard at stage 3 (the
+    reference pads by numel, meta_optimizers/sharding/shard.py) and train
+    to the same numbers as the unsharded baseline."""
+    paddle.seed(31)
+    net = nn.Sequential(nn.Linear(7, 13), nn.Tanh(), nn.Linear(13, 5))
+    r = np.random.RandomState(31)
+    x = jnp.asarray(r.randn(16, 7), jnp.float32)
+    y = jnp.asarray(r.randn(16, 5), jnp.float32)
+    loss_fn = lambda out, lab: F.mse_loss(out, lab)
+    init = {k: np.asarray(v.data).copy()
+            for k, v in net.state_dict().items()}
+
+    strat = DistributedStrategy()
+    strat.sharding = True
+    strat.sharding_configs = {"stage": 3, "min_shard_numel": 1}
+    opt = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    step = SpmdTrainStep(net, loss_fn, opt, strategy=strat)
+    # every param is sharded over dp — none silently replicated
+    from jax.sharding import PartitionSpec
+    for i, p in enumerate(step._params):
+        assert step._param_spec(i, p) == PartitionSpec("dp"), (
+            i, p.shape_tuple)
+    # (7,13) and (13,) and (5,) need padding to multiples of 8
+    assert len(step._padded) >= 3
+    z3 = [float(step(x, y)) for _ in range(3)]
+    # stored arrays really carry padded dim0 and dp sharding
+    for i, (d0, pd0) in step._padded.items():
+        arr = step._p_store[i]
+        assert arr.shape[0] == pd0 and pd0 % 8 == 0
+        assert arr.sharding.spec == PartitionSpec("dp")
+    # pad rows stay zero (optimizer must not leak into padding)
+    i0 = next(iter(step._padded))
+    d0, pd0 = step._padded[i0]
+    pad_rows = np.asarray(step._p_store[i0][d0:])
+    assert np.all(pad_rows == 0)
+
+    # sync back to model and compare against unsharded baseline
+    step.sync_params()
+    w_z3 = {k: np.asarray(v.data).copy()
+            for k, v in net.state_dict().items()}
+
+    net.set_state_dict(init)
+    from paddle_tpu.jit import TrainStep
+    opt2 = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    base = TrainStep(net, loss_fn, opt2)
+    base_losses = [float(base(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(z3, base_losses, rtol=2e-4, atol=1e-6)
+    for k, v in net.state_dict().items():
+        np.testing.assert_allclose(w_z3[k], np.asarray(v.data),
+                                   rtol=2e-4, atol=1e-6)
+
+
+# -- fleet.save_inference_model is real ----------------------------------
+
+def test_fleet_save_inference_model(tmp_path):
+    from paddle_tpu.distributed.fleet import Fleet
+    from paddle_tpu.static import InputSpec
+    f = Fleet()
+    f.init()
+    net, x, y, loss_fn = _toy(seed=37)
+    f.distributed_model(net)
+    path = f.save_inference_model(
+        dirname=str(tmp_path),
+        input_spec=[InputSpec([None, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    out = loaded(paddle.to_tensor(np.asarray(x)))
+    ref = net(paddle.to_tensor(np.asarray(x)))
+    np.testing.assert_allclose(np.asarray(out.data), np.asarray(ref.data),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_localsgd_optimizer_checkpoint_roundtrip():
+    """Regression (r4 review): optimizer.state_dict/set_state_dict must
+    work with a bound LocalSGDTrainStep, and a restore must reset the
+    replica store so loaded weights win."""
+    net, x, y, loss_fn = _toy(seed=41)
+    strat = DistributedStrategy()
+    strat.localsgd = True
+    strat.localsgd_configs = {"k_steps": 2, "begin_step": 0}
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = LocalSGDTrainStep(net, loss_fn, opt, strategy=strat)
+    for _ in range(3):
+        step(x, y)
+    sd = opt.state_dict()
+    assert sd["step"] == 3
+    step.sync_to_model()
+    w3 = np.asarray(net.weight.data).copy()
+    for _ in range(2):
+        step(x, y)
+    # restore: loaded weights + counter must win over diverged replicas
+    net.weight.data = paddle.to_tensor(w3).data
+    opt.set_state_dict(sd)
+    assert step._p_rep is None      # replica store dropped
+    step(x, y)
+    assert int(step._aux["step"]) == 4
+
+
+def test_localsgd_rejects_silently_droppable_toggles():
+    net, x, y, loss_fn = _toy()
+    strat = DistributedStrategy()
+    strat.localsgd = True
+    strat.sharding = True
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    with pytest.raises(NotImplementedError, match="sharding"):
+        LocalSGDTrainStep(net, loss_fn, opt, strategy=strat)
+
+
+def test_zero3_padded_honors_external_load():
+    """Regression (r4 review): set_state_dict on a model bound to a live
+    padded stage-3 step must not be silently ignored."""
+    paddle.seed(43)
+    net = nn.Linear(7, 5)
+    r = np.random.RandomState(43)
+    x = jnp.asarray(r.randn(16, 7), jnp.float32)
+    y = jnp.asarray(r.randn(16, 5), jnp.float32)
+    loss_fn = lambda out, lab: F.mse_loss(out, lab)
+    strat = DistributedStrategy()
+    strat.sharding = True
+    strat.sharding_configs = {"stage": 3, "min_shard_numel": 1}
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = SpmdTrainStep(net, loss_fn, opt, strategy=strat)
+    step(x, y)
+    # external load of fresh weights
+    w_new = r.randn(7, 5).astype(np.float32)
+    net.weight.data = paddle.to_tensor(w_new).data
+    step(x, y)
+    step.sync_params()
+    got = np.asarray(net.weight.data)
+    # one SGD step from w_new, NOT from the old trajectory
+    expect_g = 2.0 / y.size * np.asarray(x).T @ (
+        np.asarray(x) @ w_new + np.asarray(net.bias.data) * 0
+        + np.asarray(net.bias.data) - np.asarray(y))
+    # bias also trained a step before the load; just assert the weight
+    # moved from w_new by one lr-sized step, not from the old weights
+    assert np.abs(got - w_new).max() < 0.1 * np.abs(expect_g).max() * 3
+    assert np.abs(got - w_new).max() > 0
+
+
+def test_fleet_save_inference_model_loud_without_model():
+    from paddle_tpu.distributed.fleet import Fleet
+    f = Fleet()
+    with pytest.raises(ValueError, match="no model"):
+        f.save_inference_model(dirname="/tmp/x")
