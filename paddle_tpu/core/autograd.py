@@ -79,7 +79,12 @@ def grad_enabled() -> bool:
 
 @contextlib.contextmanager
 def no_grad():
-    """paddle.no_grad parity."""
+    """paddle.no_grad parity.
+
+    Also the memory lever for eager inference: outside no_grad every
+    differentiable op records a tape node whose replay tuple pins its
+    input arrays (double-backward support) until backward() frees them —
+    large eager loops that never backprop should run inside this scope."""
     prev = grad_enabled()
     _tls.grad_enabled = False
     try:

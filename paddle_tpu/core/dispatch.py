@@ -48,6 +48,24 @@ _VJP_CACHE: dict = {}
 _VJP_CACHE_CAP = 4096
 
 
+def _cached_fwd(fn, kw):
+    """Compiled forward-only rule for the no-grad eager path (inference
+    loops): one pjit call instead of one dispatch per primitive inside
+    ``fn``.  Shares _VJP_CACHE under a 'fwd' marker key."""
+    try:
+        key = (fn, "fwd", tuple(sorted(kw.items())))
+        hash(key)
+    except TypeError:
+        return None
+    jfn = _VJP_CACHE.get(key)
+    if jfn is None:
+        if len(_VJP_CACHE) >= _VJP_CACHE_CAP:
+            _VJP_CACHE.clear()
+        jfn = jax.jit(lambda *a: fn(*a, **kw))
+        _VJP_CACHE[key] = jfn
+    return jfn
+
+
 def _cached_rules(fn, kw, diff_idx, arrays):
     """Compiled fwd + bwd for a stable op function (the eager fast path —
     reference analog: the tracer's cached OpKernel lookup,
@@ -59,8 +77,10 @@ def _cached_rules(fn, kw, diff_idx, arrays):
     del arrays  # avals are jit's cache dimension, not ours
     try:
         # shapes/dtypes are NOT part of the key: jax.jit already caches
-        # per-aval under each entry, so one entry per (op, kw) suffices
-        key = (id(fn), tuple(diff_idx), tuple(sorted(kw.items())))
+        # per-aval under each entry, so one entry per (op, kw) suffices.
+        # Keying on fn itself (not id(fn)) pins it alive — an id could be
+        # reused after GC and silently serve another op's compiled rules.
+        key = (fn, tuple(diff_idx), tuple(sorted(kw.items())))
         hash(key)
     except TypeError:
         return None
@@ -157,7 +177,11 @@ def apply(fn: Callable, *inputs, op_name: str | None = None,
 
                 outs, vjp_fn = jax.vjp(f, *(arrays[i] for i in diff_idx))
         else:
-            outs = fn(*arrays, **kw)
+            jfn = (_cached_fwd(fn, kw)
+                   if cacheable and arrays
+                   and not any(isinstance(a, jax.core.Tracer)
+                               for a in arrays) else None)
+            outs = jfn(*arrays) if jfn is not None else fn(*arrays, **kw)
     except Exception as e:  # attach op attribution like AppendErrorOpHint
         raise with_op_hint(e, name)
 
@@ -180,6 +204,11 @@ def apply(fn: Callable, *inputs, op_name: str | None = None,
             out_ids=[t._bw_id for t in out_tensors],
             out_avals=[(t.shape_tuple, np.dtype(t.data.dtype)) for t in out_tensors],
             out_is_tuple=multi,
+            # replay pins ALL input arrays (incl. non-differentiable ones)
+            # until a backward with retain_graph=False frees it — the
+            # price of create_graph double-backward support.  Eager loops
+            # that never backprop should run under autograd.no_grad() (no
+            # node, no retention).
             replay=(fn, kw, tuple(diff_idx), tuple(arrays)),
         )
         for t in out_tensors:

@@ -267,14 +267,23 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
                    data_format, 3)
 
 
-def _conv_fn(a, w, *, stride, pad_spec, dilation, groups, specs):
+def _conv_fn(a, w, *maybe_bias, stride, pad_spec, dilation, groups, specs,
+             channels_last=False):
     dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, specs)
-    return jax.lax.conv_general_dilated(
+    out = jax.lax.conv_general_dilated(
         a, w, window_strides=stride,
         padding=(pad_spec if isinstance(pad_spec, str)
                  else [tuple(p) for p in pad_spec]),
         rhs_dilation=dilation, dimension_numbers=dn,
         feature_group_count=groups)
+    if maybe_bias:
+        # bias fused into the cached op: an eager reshape+add pair costs
+        # more host dispatch than the conv itself (r4 profile: 330us vs
+        # 69us per call)
+        shape = [1] * out.ndim
+        shape[-1 if channels_last else 1] = -1
+        out = out + maybe_bias[0].reshape(shape)
+    return out
 
 
 def _convnd(x, weight, bias, stride, padding, dilation, groups, data_format,
@@ -293,14 +302,11 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, data_format,
     out_spec = lhs_spec
     pad_hashable = (pad_spec if isinstance(pad_spec, str)
                     else tuple(tuple(p) for p in pad_spec))
-    out = apply(_conv_fn, x, weight, op_name=f"conv{n}d", cacheable=True,
-                stride=stride, pad_spec=pad_hashable, dilation=dilation,
-                groups=groups, specs=(lhs_spec, rhs_spec, out_spec))
-    if bias is not None:
-        shape = [1] * (n + 2)
-        shape[-1 if channels_last else 1] = -1
-        out = out + bias.reshape(shape)
-    return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(_conv_fn, *args, op_name=f"conv{n}d", cacheable=True,
+                 stride=stride, pad_spec=pad_hashable, dilation=dilation,
+                 groups=groups, specs=(lhs_spec, rhs_spec, out_spec),
+                 channels_last=channels_last)
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
@@ -1020,8 +1026,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     operators/math/bert_encoder_functor.cu fused attention).
 
     ``return_weights=True`` forces the unfused path and returns
-    ``(out, weights [B, H, Lq, Lk])`` — the post-softmax, pre-dropout
-    probabilities (MultiHeadAttention's need_weights)."""
+    ``(out, weights [B, H, Lq, Lk])`` — post-softmax probabilities, with
+    dropout applied in training mode (matching the reference, which
+    returns the dropped weights: nn/layer/transformer.py:412-431)."""
     from ...core.flags import get_flag
     if get_flag("use_pallas_kernels") and not return_weights:
         from ...ops.pallas import flash_attention, flash_attention_supported
@@ -1067,7 +1074,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                 w_used = w * mask
         out = jnp.einsum("bhls,bshd->blhd", w_used, v)
         if return_weights:
-            return out, w
+            # post-DROPOUT weights in training mode: the reference passes
+            # weights through F.dropout before returning them
+            # (nn/layer/transformer.py:412-431)
+            return out, w_used
         return out
 
     args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])

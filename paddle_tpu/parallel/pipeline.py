@@ -12,9 +12,21 @@ neighbouring stages with ``lax.ppermute`` (the send_v2/recv_v2 analog, but
 compiler-scheduled over ICI).  The fill-drain schedule is a ``lax.scan``
 over M + S - 1 ticks, so forward AND backward pipeline in one compiled
 program — differentiating the scan yields the reverse schedule
-automatically (the activation-memory discipline the reference's 1F1B
-schedule buys by hand, section_worker.cc:128-165, comes from the scan
-carrying ONE microbatch activation per stage).
+automatically.
+
+Activation-memory discipline (measured in tests/test_pipeline_memory.py):
+differentiating the scan stores residuals for every tick, so per-device
+backward memory is O(M) in the microbatch count — what each tick STORES is
+the lever.  With ``remat=True`` (default) the stage/embed/head bodies are
+``jax.checkpoint``-ed, so a tick stores only its carry (ONE microbatch
+activation at the stage boundary) and recomputes layer internals in the
+backward: O(M · |mb activation|) total, a factor-of-depth below the
+unrematted scan's O(M · |all layer internals|).  This is the same
+recompute-in-backward trade the reference's 1F1B + recompute combination
+makes (section_worker.cc:128-165 interleaves backward to hold O(S)
+in-flight microbatches; its per-microbatch store is the full section's
+internals unless recompute is also on — for stages deeper than ~2 layers
+and the usual M ≈ 2S, rematted-scan stores LESS than unrematted 1F1B).
 
 Memory/layout discipline (round-3 redesign):
 - the microbatch INPUT stream is sharded over 'pp' round-robin (microbatch
@@ -107,7 +119,8 @@ def pipelined_fn(stage_layer: Layer, n_stages: int, num_microbatches: int,
                  mesh=None, pp_axis: str = PP_AXIS,
                  dp_axis: Optional[str] = None,
                  embed_layer: Optional[Layer] = None,
-                 head_layer: Optional[Layer] = None):
+                 head_layer: Optional[Layer] = None,
+                 remat: bool = True):
     """Build a pure function running ``stage_layer`` as an S-stage pipeline.
 
     Returns ``fn(stacked_params, x[, embed_params][, head_params])``:
@@ -117,6 +130,11 @@ def pipelined_fn(stage_layer: Layer, n_stages: int, num_microbatches: int,
     ``embed_layer``/``head_layer`` make the first/last stages non-uniform
     (their params ride replicated).  Output: [B, ...] after embed → S
     stages → head.
+
+    ``remat=True`` checkpoints the stage/embed/head bodies so the scan's
+    backward stores one microbatch boundary activation per tick instead of
+    every layer internal (see module docstring; the reference's recompute
+    + 1F1B combination, section_worker.cc + recompute_optimizer.py).
     """
     mesh = mesh or ensure_mesh()
     S = n_stages
@@ -153,14 +171,22 @@ def pipelined_fn(stage_layer: Layer, n_stages: int, num_microbatches: int,
                                else cand * 0)
             return jax.lax.psum(masked, pp_axis)
 
+        maybe_remat = jax.checkpoint if remat else (lambda f: f)
+        stage_apply = maybe_remat(
+            lambda p, a: _apply_layer(template, p, a))
+        embed_apply = maybe_remat(
+            lambda p, a: _apply_layer(embed_layer, p, a))
+        head_apply = maybe_remat(
+            lambda p, a: _apply_layer(head_layer, p, a))
+
         def first_stage_in(mb_in):
             if embed_layer is not None:
-                return _apply_layer(embed_layer, e_params, mb_in)
+                return embed_apply(e_params, mb_in)
             return mb_in
 
         def last_stage_out(y):
             if head_layer is not None:
-                return _apply_layer(head_layer, h_params, y)
+                return head_apply(h_params, y)
             return y
 
         # probe shapes (abstract): activation and collected-output element
@@ -168,7 +194,7 @@ def pipelined_fn(stage_layer: Layer, n_stages: int, num_microbatches: int,
             lambda m: first_stage_in(m),
             jax.ShapeDtypeStruct(my_stream.shape[1:], my_stream.dtype))
         y0 = jax.eval_shape(
-            lambda a: _apply_layer(template, my_params, a), act0)
+            lambda a: stage_apply(my_params, a), act0)
         out0 = jax.eval_shape(lambda a: last_stage_out(a), y0)
 
         def tick(carry, t):
@@ -176,7 +202,7 @@ def pipelined_fn(stage_layer: Layer, n_stages: int, num_microbatches: int,
             mb_in = inject(jnp.clip(t, 0, M - 1))
             cand_act = first_stage_in(mb_in)
             inp = jnp.where(idx == 0, cand_act, buf)
-            y = _apply_layer(template, my_params, inp)
+            y = stage_apply(my_params, inp)
             nxt = jax.lax.ppermute(
                 y, pp_axis, [(i, (i + 1) % S) for i in range(S)])
             # collect: last stage's tick-t output is microbatch t-(S-1);
@@ -232,13 +258,13 @@ def pipelined_fn(stage_layer: Layer, n_stages: int, num_microbatches: int,
 def pipeline_train_fn(stage_layer: Layer, head_fn: Callable, n_stages: int,
                       num_microbatches: int, mesh=None,
                       pp_axis: str = PP_AXIS, dp_axis=None,
-                      embed_layer=None, head_layer=None):
+                      embed_layer=None, head_layer=None, remat: bool = True):
     """fn(stacked_params, x, y, ...) -> scalar loss, for use inside
     jax.value_and_grad.  ``head_fn(out_arrays, y)`` computes the loss from
     pipeline output (pure jnp)."""
     fwd = pipelined_fn(stage_layer, n_stages, num_microbatches, mesh,
                        pp_axis, dp_axis=dp_axis, embed_layer=embed_layer,
-                       head_layer=head_layer)
+                       head_layer=head_layer, remat=remat)
 
     def fn(stacked_params, x, y, embed_params=(), head_params=()):
         out = fwd(stacked_params, x, embed_params, head_params)

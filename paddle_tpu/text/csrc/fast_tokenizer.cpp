@@ -146,6 +146,17 @@ void ft_encode_batch(void* handle, const char* const* texts, int64_t n,
                      int32_t* out_lens) {
   if (n <= 0) return;
   const auto* tk = static_cast<Tokenizer*>(handle);
+  // max_len < 2 would resize(max_len - 1) with a negative value, whose
+  // size_t conversion throws length_error across the extern "C"/thread
+  // boundary and aborts the process — reject defensively (the Python
+  // wrapper also validates), pad-filling ids like the normal path
+  if (max_len < 2) {
+    for (int64_t i = 0; i < n; ++i) {
+      out_lens[i] = 0;
+      for (int32_t j = 0; j < max_len; ++j) out_ids[i * max_len + j] = tk->pad_id;
+    }
+    return;
+  }
   int64_t workers = n_threads > 0
                         ? n_threads
                         : static_cast<int64_t>(

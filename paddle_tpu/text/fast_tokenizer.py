@@ -127,6 +127,11 @@ class FastWordPieceTokenizer:
                      n_threads: int = 0):
         """texts -> (ids [B, max_len] int32, lens [B] int32), with
         [CLS]...[SEP] framing and [PAD] fill."""
+        if max_len < 2:
+            # [CLS] + [SEP] framing needs >= 2 slots; smaller values would
+            # drive a negative resize through the C extension
+            raise ValueError(
+                f"encode_batch: max_len must be >= 2, got {max_len}")
         n = len(texts)
         ids = np.empty((n, max_len), np.int32)
         lens = np.empty((n,), np.int32)

@@ -1,0 +1,87 @@
+"""Pipeline activation-memory discipline, measured (VERDICT r3 #3).
+
+The claim under test (parallel/pipeline.py module docstring): with
+``remat=True`` each scan tick stores one microbatch boundary activation
+instead of every stage-internal activation, so compiled backward temp
+memory drops by roughly the stage depth, and grows linearly in M with a
+small per-tick constant.  Reference bar: section_worker.cc:128-165 1F1B +
+recompute_optimizer.py.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import distributed as dist
+from paddle_tpu.parallel import pipelined_fn, pipeline_train_fn, \
+    stack_stage_params
+
+S = 4          # pipeline stages
+D = 64         # width (small: param-grad accumulators must not dominate)
+DEPTH = 10     # sublayers per stage — the factor remat should save
+
+
+def _build(seed=5):
+    paddle.seed(seed)
+    stages = [nn.Sequential(*[nn.Linear(D, D) for _ in range(DEPTH)])
+              for _ in range(S)]
+    stacked, _ = stack_stage_params(stages)
+    return stages, stacked
+
+
+def _temp_bytes(M, remat, mb=64):
+    dist.init_mesh({"pp": S})
+    stages, stacked = _build()
+    fn = pipeline_train_fn(
+        stages[0], lambda out, y: jnp.mean((out - y) ** 2), S, M,
+        remat=remat)
+    B = M * mb
+    x = jnp.zeros((B, D), jnp.float32)
+    y = jnp.zeros((B, D), jnp.float32)
+    g = jax.jit(jax.grad(lambda p, x, y: fn(p, x, y)))
+    compiled = g.lower(stacked, x, y).compile()
+    ma = compiled.memory_analysis()
+    if ma is None:
+        pytest.skip("backend reports no memory analysis")
+    return ma.temp_size_in_bytes
+
+
+def test_remat_cuts_backward_memory_by_depth_factor():
+    """remat must store ~one boundary activation per tick instead of all
+    DEPTH sublayer activations: expect a multiple-x temp reduction."""
+    M = 8
+    t_remat = _temp_bytes(M, remat=True)
+    t_plain = _temp_bytes(M, remat=False)
+    assert t_remat < t_plain / 2, (
+        f"remat gave only {t_plain / max(t_remat, 1):.2f}x "
+        f"(remat={t_remat}, plain={t_plain})")
+
+
+def test_remat_memory_grows_linearly_with_small_constant():
+    """Per-tick residual is one microbatch activation: doubling M (fixed
+    microbatch size) must scale temp close to linearly, not worse."""
+    t16 = _temp_bytes(16, remat=True)
+    t32 = _temp_bytes(32, remat=True)
+    growth = t32 / max(t16, 1)
+    assert growth < 2.6, (t16, t32, growth)
+
+
+def test_remat_numerics_match_unrematted():
+    dist.init_mesh({"pp": S})
+    stages, stacked = _build(seed=9)
+    M, mb = 8, 4
+    r = np.random.RandomState(9)
+    x = jnp.asarray(r.randn(M * mb, D), jnp.float32)
+    y = jnp.asarray(r.randn(M * mb, D), jnp.float32)
+    loss_fn = lambda out, yy: jnp.mean((out - yy) ** 2)
+    outs = {}
+    for remat in (True, False):
+        fn = pipeline_train_fn(stages[0], loss_fn, S, M, remat=remat)
+        l, g = jax.value_and_grad(lambda p: fn(p, x, y))(stacked)
+        outs[remat] = (float(l), g)
+    np.testing.assert_allclose(outs[True][0], outs[False][0], rtol=1e-5)
+    for a, b in zip(outs[True][1], outs[False][1]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
