@@ -323,9 +323,12 @@ class _PrefetchIterator:
 class DataLoader:
     """reference: paddle.io.DataLoader (fluid/reader.py).
 
-    num_workers>0 uses a thread pool (numpy releases the GIL for the array
-    ops that dominate collation); `places`/`use_shared_memory` accepted for
-    API parity."""
+    num_workers>0 with use_shared_memory=True (default) runs real worker
+    PROCESSES with shared-memory batch transport (io.multiprocess,
+    reference fluid/reader.py:91-149) — the GIL-free path for Python-heavy
+    transforms.  use_shared_memory=False falls back to the in-process
+    thread pool (numpy releases the GIL for array collation).
+    `places` accepted for API parity."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -337,6 +340,9 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.return_list = return_list
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
             self.batch_size = batch_size
@@ -364,6 +370,12 @@ class DataLoader:
             # no batching: sample-by-sample
             return (self.dataset[i] for i in range(len(self.dataset)))
         if self.num_workers > 0:
+            if self.use_shared_memory:
+                # real worker processes + shared-memory transport
+                # (reference: fluid/reader.py:91-149); sidesteps the GIL
+                # for Python-heavy transforms
+                from .multiprocess import MultiprocessIterator
+                return MultiprocessIterator(self, iter(self.batch_sampler))
             return _PrefetchIterator(self, iter(self.batch_sampler))
         return self._iter_sync()
 

@@ -1,0 +1,316 @@
+"""Multiprocess DataLoader workers with shared-memory transport.
+
+Reference: fluid/reader.py:91-149 (_DataLoaderIterMultiProcess: worker
+processes + mmap'd tensors + SIGCHLD cleanup) and
+memory/allocation/mmap_allocator.cc.  The thread-pool path
+(io.__init__._PrefetchIterator) is GIL-bound for Python-heavy
+``__getitem__`` transforms; real processes sidestep the GIL, and batches
+cross the process boundary through ``multiprocessing.shared_memory``
+blocks (one memcpy in the worker, zero-copy numpy views in the parent)
+instead of pickle.
+
+Process model: ``forkserver`` by default — workers fork from a CLEAN
+server interpreter, never from the training process (fork()-ing a parent
+whose XLA/JAX runtime threads hold locks can deadlock the child; the
+reference forks before CUDA init for the same reason).  The
+dataset/collate_fn therefore must be picklable (module-level classes);
+set ``PADDLE_TPU_MP_START=fork`` to opt into classic fork for
+unpicklable datasets.  Workers are PERSISTENT: the pool is created at
+the first epoch and reused by every subsequent iterator (torch's
+persistent_workers semantics — it also means workers see the dataset as
+pickled at pool creation; per-epoch dataset mutation does not propagate).
+Workers run ``__getitem__`` + collation to NUMPY arrays only (no JAX in
+children).  The parent re-assembles views, converts to device arrays,
+and releases the block.  Worker death is detected on queue timeout (the
+reference's SIGCHLD handler analog).  In-flight work is bounded to
+``num_workers * prefetch_factor`` batches so /dev/shm never holds more
+than the prefetch window."""
+from __future__ import annotations
+
+import atexit
+import multiprocessing as mp
+import os
+import queue as queue_mod
+from multiprocessing import shared_memory
+from typing import Any, List
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_live_shm: set = set()
+
+
+def _cleanup_shm():
+    for name in list(_live_shm):
+        try:
+            s = shared_memory.SharedMemory(name=name)
+            s.close()
+            s.unlink()
+        except Exception:
+            pass
+
+
+atexit.register(_cleanup_shm)
+
+
+def _to_numpy(obj):
+    """Tensor/array leaves -> numpy (workers must not ship device arrays)."""
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.data)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_numpy(v) for k, v in obj.items()}
+    return np.asarray(obj)
+
+
+def _np_collate(batch):
+    """Pure-numpy default collation (mirror of default_collate_fn minus
+    the Tensor wrapping, which happens in the parent)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        return tuple(_np_collate(list(s)) for s in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: _np_collate([d[k] for d in batch]) for k in sample}
+    return np.asarray(batch)
+
+
+def _flatten(tree, out):
+    if isinstance(tree, np.ndarray):
+        out.append(tree)
+        return "*"
+    if isinstance(tree, tuple):
+        return tuple(_flatten(t, out) for t in tree)
+    if isinstance(tree, list):
+        return [_flatten(t, out) for t in tree]
+    if isinstance(tree, dict):
+        return {k: _flatten(v, out) for k, v in tree.items()}
+    raise TypeError(f"cannot ship type {type(tree)} over shared memory")
+
+
+def _unflatten(spec, leaves, it=None):
+    if it is None:
+        it = iter(leaves)
+        return _unflatten(spec, leaves, it)
+    if spec == "*":
+        return next(it)
+    if isinstance(spec, tuple):
+        return tuple(_unflatten(s, leaves, it) for s in spec)
+    if isinstance(spec, list):
+        return [_unflatten(s, leaves, it) for s in spec]
+    if isinstance(spec, dict):
+        return {k: _unflatten(v, leaves, it) for k, v in spec.items()}
+    raise TypeError(spec)
+
+
+def _worker_loop(dataset, collate_fn, idx_q, result_q, worker_id,
+                 worker_init_fn, seed):
+    np.random.seed((seed + worker_id) % (2 ** 31))
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = idx_q.get()
+        if item is None:
+            return
+        tag, i, idxs = item
+        try:
+            samples = [dataset[j] for j in idxs]
+            batch = (_to_numpy(collate_fn(samples)) if collate_fn
+                     else _np_collate([_to_numpy(s) for s in samples]))
+            leaves: List[np.ndarray] = []
+            spec = _flatten(batch, leaves)
+            total = sum(a.nbytes for a in leaves)
+            shm = shared_memory.SharedMemory(create=True,
+                                             size=max(total, 1))
+            # ownership passes to the parent (which unlinks after
+            # tensorizing) — detach from this process's resource_tracker
+            # so it doesn't warn about 'leaked' blocks at worker exit
+            try:
+                from multiprocessing import resource_tracker
+                resource_tracker.unregister(shm._name, "shared_memory")
+            except Exception:
+                pass
+            metas, off = [], 0
+            for a in leaves:
+                shp = a.shape            # ascontiguousarray promotes 0-d
+                a = np.ascontiguousarray(a)
+                view = np.ndarray(a.shape, a.dtype, buffer=shm.buf,
+                                  offset=off)
+                view[...] = a
+                metas.append((shp, a.dtype.str, off))
+                off += a.nbytes
+            shm.close()
+            result_q.put((tag, i, shm.name, spec, metas, None))
+        except Exception as e:  # surface the worker traceback in the parent
+            import traceback
+            result_q.put((tag, i, None, None, None,
+                          f"{type(e).__name__}: {e}\n"
+                          f"{traceback.format_exc()}"))
+
+
+class _WorkerPool:
+    """Persistent worker pool shared by successive epoch iterators."""
+
+    def __init__(self, loader):
+        method = os.environ.get("PADDLE_TPU_MP_START", "forkserver")
+        if method not in mp.get_all_start_methods():
+            method = "spawn"
+        ctx = mp.get_context(method)
+        self.idx_q = ctx.Queue()
+        self.result_q = ctx.Queue()
+        self.workers = []
+        self.epoch = 0
+        n = loader.num_workers
+        for w in range(n):
+            try:
+                p = ctx.Process(
+                    target=_worker_loop,
+                    args=(loader.dataset, loader.collate_fn, self.idx_q,
+                          self.result_q, w,
+                          getattr(loader, "worker_init_fn", None),
+                          int.from_bytes(os.urandom(4), "little")),
+                    daemon=True)
+                p.start()
+            except Exception as e:
+                self.close()
+                raise RuntimeError(
+                    f"DataLoader could not start a '{method}' worker "
+                    f"({type(e).__name__}: {e}); a non-picklable dataset/"
+                    f"collate_fn needs PADDLE_TPU_MP_START=fork or "
+                    f"use_shared_memory=False") from e
+            self.workers.append(p)
+
+    def close(self):
+        for p in self.workers:
+            if p.is_alive():
+                p.terminate()
+        for p in self.workers:
+            p.join(timeout=5)
+        for q in (self.idx_q, self.result_q):
+            while True:
+                try:
+                    item = q.get_nowait()
+                except (queue_mod.Empty, OSError, ValueError):
+                    break
+                name = item[2] if len(item) >= 3 else None
+                if isinstance(name, str):
+                    try:
+                        s = shared_memory.SharedMemory(name=name)
+                        s.close()
+                        s.unlink()
+                    except Exception:
+                        pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def get_pool(loader) -> _WorkerPool:
+    pool = getattr(loader, "_mp_pool", None)
+    if pool is None or not all(p.is_alive() for p in pool.workers):
+        if pool is not None:
+            pool.close()
+        pool = _WorkerPool(loader)
+        loader._mp_pool = pool
+    return pool
+
+
+class MultiprocessIterator:
+    """Ordered batch producer over the loader's persistent pool."""
+
+    def __init__(self, loader, sampler_iter):
+        self.loader = loader
+        self.pool = get_pool(loader)
+        self.pool.epoch += 1
+        self.tag = self.pool.epoch
+        self.batches = list(sampler_iter)
+        self.total = len(self.batches)
+        self.pending = {}
+        self.next_emit = 0
+        self.timeout = getattr(loader, "timeout", 0) or 120
+        # backpressure: at most num_workers * prefetch_factor batches in
+        # flight, so /dev/shm holds a bounded window, not the whole epoch
+        n = loader.num_workers
+        self._window = max(
+            n * max(int(getattr(loader, "prefetch_factor", 2)), 1), n)
+        self._fed = 0
+        while self._fed < min(self._window, self.total):
+            self._feed_one()
+
+    def _feed_one(self):
+        if self._fed < self.total:
+            self.pool.idx_q.put(
+                (self.tag, self._fed, list(self.batches[self._fed])))
+            self._fed += 1
+
+    def __iter__(self):
+        return self
+
+    def _tensorize(self, shm_name, spec, metas):
+        shm = shared_memory.SharedMemory(name=shm_name)
+        _live_shm.add(shm_name)
+        leaves = []
+        for shape, dtype, off in metas:
+            view = np.ndarray(shape, np.dtype(dtype), buffer=shm.buf,
+                              offset=off)
+            # .copy() is required: jnp.asarray may zero-copy-alias a host
+            # buffer, and the shm block is unlinked right below
+            leaves.append(Tensor(view.copy()))
+        shm.close()
+        try:
+            shm2 = shared_memory.SharedMemory(name=shm_name)
+            shm2.close()
+            shm2.unlink()
+        except FileNotFoundError:
+            pass
+        _live_shm.discard(shm_name)
+        return _unflatten(spec, leaves)
+
+    def __next__(self):
+        if self.next_emit >= self.total:
+            raise StopIteration
+        while self.next_emit not in self.pending:
+            try:
+                tag, i, name, spec, metas, err = self.pool.result_q.get(
+                    timeout=self.timeout)
+            except queue_mod.Empty:
+                dead = [w for w, p in enumerate(self.pool.workers)
+                        if not p.is_alive()]
+                self.pool.close()
+                self.loader._mp_pool = None
+                raise RuntimeError(
+                    f"DataLoader worker(s) {dead or '?'} died or stalled "
+                    f"(timeout={self.timeout}s) — reference analog: "
+                    f"reader.py SIGCHLD handler.  If the dataset/collate "
+                    f"is defined in a script's __main__, forkserver "
+                    f"workers re-import the script (python spawn "
+                    f"semantics): guard it with `if __name__ == "
+                    f"'__main__'`, move the dataset to a module, or set "
+                    f"PADDLE_TPU_MP_START=fork.")
+            if tag != self.tag:
+                # stale result from an abandoned earlier epoch: free it
+                if name:
+                    try:
+                        s = shared_memory.SharedMemory(name=name)
+                        s.close()
+                        s.unlink()
+                    except Exception:
+                        pass
+                continue
+            if err is not None:
+                self.pool.close()
+                self.loader._mp_pool = None
+                raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            self.pending[i] = (name, spec, metas)
+        name, spec, metas = self.pending.pop(self.next_emit)
+        self.next_emit += 1
+        self._feed_one()
+        return self._tensorize(name, spec, metas)

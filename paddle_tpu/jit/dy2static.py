@@ -18,9 +18,26 @@ predicate runs one branch in Python); only traced tensor predicates lower
 to ``lax.cond``.  Anything the transformer cannot prove convertible is
 left untouched — an unconverted tensor ``if`` still raises the loud
 trace-time error pointing at paddle.cond (no silent mistracing).
-``while`` loops are not converted (use paddle.while_loop; XLA's While has
-no reverse-mode adjoint, so auto-converting could silently break
-training).
+
+Loops (reference: loop_transformer.py + break_continue_transformer.py):
+
+3. ``while <test>: <assign-only body>`` → carried-variable closures
+   dispatched through :func:`_jst_while` (Python loop when everything is
+   concrete, ``paddle.while_loop``/``lax.while_loop`` when traced);
+4. ``for i in range(...): <assign-only body>`` → the same, with a
+   synthetic counter carry (``range`` over a traced tensor bound works
+   after conversion — it would be a TypeError in plain Python);
+5. a single ``if c: break`` / ``if c: continue`` as the first statement,
+   or ``if c: break`` as the last statement of the loop body → a carried
+   done-flag and predicated (select) state updates, the
+   break_continue_transformer's early-exit semantics.
+
+Loop-carried variables follow the reference's rule: every assigned name
+that is read by the loop test, read before it is written in the body, or
+read after the loop must be BOUND before the loop.  Like the reference's
+while_op, a traced loop is forward-only (XLA While has no reverse-mode
+adjoint — taking gradients through a converted loop raises jax's loud
+error rather than silently mis-differentiating).
 """
 from __future__ import annotations
 
@@ -30,7 +47,7 @@ import inspect
 import textwrap
 from typing import Callable, List, Optional, Set
 
-__all__ = ["convert_control_flow", "_jst_cond"]
+__all__ = ["convert_control_flow", "_jst_cond", "_jst_while"]
 
 
 def _jst_cond(pred, true_fn, false_fn):
@@ -46,17 +63,96 @@ def _jst_cond(pred, true_fn, false_fn):
     return true_fn() if p else false_fn()
 
 
+def _is_traced(v):
+    import jax
+    from ..core.tensor import Tensor
+    d = v.data if isinstance(v, Tensor) else v
+    return isinstance(d, jax.core.Tracer)
+
+
+def _jst_bool(x):
+    from ..core.tensor import Tensor
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _jst_not(x):
+    if _is_traced(x):
+        import jax.numpy as jnp
+        return jnp.logical_not(_jst_bool(x))
+    return not _jst_bool(x)
+
+
+def _jst_and(a, b):
+    if _is_traced(a) or _is_traced(b):
+        import jax.numpy as jnp
+        return jnp.logical_and(_jst_bool(a), _jst_bool(b))
+    return bool(_jst_bool(a)) and bool(_jst_bool(b))
+
+
+def _jst_or(a, b):
+    if _is_traced(a) or _is_traced(b):
+        import jax.numpy as jnp
+        return jnp.logical_or(_jst_bool(a), _jst_bool(b))
+    return bool(_jst_bool(a)) or bool(_jst_bool(b))
+
+
+def _jst_lt(a, b):
+    av, bv = _jst_bool(a), _jst_bool(b)
+    return av < bv
+
+
+def _jst_select(pred, old_vals, new_fn):
+    """Predicated state update for converted break/continue: keep
+    ``old_vals`` where ``pred`` holds, else the values ``new_fn``
+    computes.  Eager concrete predicate short-circuits in Python."""
+    if not _is_traced(pred):
+        return tuple(old_vals) if bool(_jst_bool(pred)) else tuple(
+            new_fn())
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    p = _jst_bool(pred)
+    new_vals = tuple(new_fn())
+    out = []
+    for o, n in zip(old_vals, new_vals):
+        od = o.data if isinstance(o, Tensor) else o
+        nd = n.data if isinstance(n, Tensor) else n
+        sel = jnp.where(p, od, nd)
+        out.append(Tensor(sel) if isinstance(o, Tensor) or
+                   isinstance(n, Tensor) else sel)
+    return tuple(out)
+
+
+def _jst_while(cond_fn, body_fn, init):
+    """Runtime dispatch for converted loops: Python loop when all carried
+    values and the predicate are concrete, paddle.while_loop (lax.While)
+    when traced (loop_transformer.py's create_while_nodes)."""
+    vals = tuple(init)
+    c = cond_fn(*vals)
+    if _is_traced(c) or any(_is_traced(v) for v in vals):
+        from ..ops.control_flow import while_loop
+        out = while_loop(cond_fn, lambda *a: tuple(body_fn(*a)),
+                         list(vals))
+        return tuple(out)
+    while bool(_jst_bool(c)):
+        vals = tuple(body_fn(*vals))
+        c = cond_fn(*vals)
+    return vals
+
+
 def _loads(node) -> Set[str]:
     return {n.id for n in ast.walk(node)
             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
 
 
-def _assigned_names(stmts: List[ast.stmt]) -> Optional[Set[str]]:
-    """Simple names assigned by ``stmts``; None if anything non-trivial
-    (aug-assign, attribute/subscript targets, nested control flow, or a
-    read of a to-be-assigned name before its assignment — which would
-    become an UnboundLocalError inside the branch closure)."""
-    names: Set[str] = set()
+def _assigned_names(stmts: List[ast.stmt]):
+    """Analyse a branch body of simple assignments.
+
+    Returns ``(assigned, prebind)`` — the simple names the body assigns,
+    and the subset it READS before assigning (incl. ``x = x + 1`` /
+    ``x += 1``), which the branch closure receives as default-argument
+    snapshots.  Returns ``None`` for anything non-trivial (attribute or
+    subscript targets, nested control flow)."""
     all_assigned: Set[str] = set()
     for s in stmts:
         if isinstance(s, ast.Assign):
@@ -68,25 +164,30 @@ def _assigned_names(stmts: List[ast.stmt]) -> Optional[Set[str]]:
                     all_assigned.update(e.id for e in t.elts)
                 else:
                     return None
+        elif isinstance(s, ast.AugAssign):
+            if not isinstance(s.target, ast.Name):
+                return None
+            all_assigned.add(s.target.id)
         elif not isinstance(s, ast.Expr):
             return None
     assigned_so_far: Set[str] = set()
+    prebind: Set[str] = set()
     for s in stmts:
         if isinstance(s, ast.Assign):
-            # reading a name this branch assigns LATER (incl. this stmt's
-            # own target, `x = x + 1`) would hit the closure-local unbound
-            if (_loads(s.value) & all_assigned) - assigned_so_far:
-                return None
+            prebind |= (_loads(s.value) & all_assigned) - assigned_so_far
             for t in s.targets:
                 if isinstance(t, ast.Name):
                     assigned_so_far.add(t.id)
                 else:
                     assigned_so_far.update(e.id for e in t.elts)
-            names = assigned_so_far
+        elif isinstance(s, ast.AugAssign):
+            if s.target.id not in assigned_so_far:
+                prebind.add(s.target.id)
+            prebind |= (_loads(s.value) & all_assigned) - assigned_so_far
+            assigned_so_far.add(s.target.id)
         elif isinstance(s, ast.Expr):
-            if (_loads(s) & all_assigned) - assigned_so_far:
-                return None
-    return set(names)
+            prebind |= (_loads(s) & all_assigned) - assigned_so_far
+    return all_assigned, prebind
 
 
 class _IfElseTransformer(ast.NodeTransformer):
@@ -111,7 +212,11 @@ class _IfElseTransformer(ast.NodeTransformer):
                         args=[test, t, f], keywords=[])
         return ast.Return(value=call)
 
-    def _rewrite_body(self, body: List[ast.stmt]) -> List[ast.stmt]:
+    def _rewrite_body(self, body: List[ast.stmt],
+                      bound: Set[str]) -> List[ast.stmt]:
+        """Rewrite one statement list, tracking ``bound`` — names
+        DEFINITELY bound at each point (needed to know whether a branch's
+        read-before-write names can be prebound as argument defaults)."""
         out: List[ast.stmt] = []
         i = 0
         while i < len(body):
@@ -133,22 +238,61 @@ class _IfElseTransformer(ast.NodeTransformer):
                         s.test, s.body[0], s.orelse[0]))
                     i += 1
                     continue
-                conv = self._convert_assign_if(s)
+                conv = self._convert_assign_if(s, bound)
                 if conv is not None:
                     out.extend(conv)
+                    for t in conv:
+                        if isinstance(t, ast.Assign):
+                            bound |= _stores(t)
                     i += 1
                     continue
+                # unconverted if: recurse; only names assigned in BOTH
+                # arms are definitely bound after it
+                s.body = self._rewrite_body(s.body, set(bound))
+                s.orelse = self._rewrite_body(s.orelse, set(bound))
+                bs = set()
+                for t in s.body:
+                    bs |= _stores(t)
+                os_ = set()
+                for t in s.orelse:
+                    os_ |= _stores(t)
+                bound |= (bs & os_) if s.orelse else set()
+                out.append(s)
+                i += 1
+                continue
+            if isinstance(s, (ast.While, ast.For)):
+                # loop bodies: rewrite with a copy (their stores are only
+                # conditionally bound afterwards)
+                s.body = self._rewrite_body(s.body, set(bound))
+                s.orelse = self._rewrite_body(s.orelse, set(bound))
+                out.append(s)
+                i += 1
+                continue
             out.append(s)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(s.name)     # not the names stored INSIDE it
+            else:
+                bound |= _stores(s)
             i += 1
         return out
 
     # -- pattern 1: both-branch assignments ---------------------------------
-    def _convert_assign_if(self, node: ast.If) -> Optional[List[ast.stmt]]:
+    def _convert_assign_if(self, node: ast.If,
+                           bound: Set[str]) -> Optional[List[ast.stmt]]:
         if not node.orelse:
             return None
-        a = _assigned_names(node.body)
-        b = _assigned_names(node.orelse)
+        ra = _assigned_names(node.body)
+        rb = _assigned_names(node.orelse)
+        if ra is None or rb is None:
+            return None
+        (a, pre_a), (b, pre_b) = ra, rb
         if not a or a != b:
+            return None
+        prebind = sorted(pre_a | pre_b)
+        if any(p not in bound for p in prebind):
+            # a read-before-write name not provably bound before the if:
+            # the default-argument snapshot would evaluate eagerly and
+            # raise where plain Python (branch not taken) would not
             return None
         targets = sorted(a)
         self.count += 1
@@ -158,10 +302,16 @@ class _IfElseTransformer(ast.NodeTransformer):
             ctx=ast.Load()))
 
         def mk(name, stmts):
+            # names read before assignment arrive as default-argument
+            # snapshots (`def t(s=s): s = s + x; ...`), sidestepping the
+            # closure-local UnboundLocalError
             return ast.FunctionDef(
                 name=name,
-                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
-                                   kw_defaults=[], defaults=[]),
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=p) for p in prebind],
+                    kwonlyargs=[], kw_defaults=[],
+                    defaults=[ast.Name(p, ast.Load()) for p in prebind]),
                 body=list(stmts) + [ret], decorator_list=[])
 
         call = ast.Call(func=ast.Name("_jst_cond", ast.Load()),
@@ -179,8 +329,266 @@ class _IfElseTransformer(ast.NodeTransformer):
                 mk(f"__jst_false_{n}", node.orelse), assign]
 
     def visit_FunctionDef(self, node):
+        self.generic_visit(node)   # nested defs rewrite themselves
+        args = node.args
+        bound = {a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)}
+        for extra in (args.vararg, args.kwarg):
+            if extra is not None:
+                bound.add(extra.arg)
+        node.body = self._rewrite_body(node.body, bound)
+        return node
+
+
+def _stores(node) -> Set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)}
+
+
+class _LoopTransformer(ast.NodeTransformer):
+    """reference: loop_transformer.py + break_continue_transformer.py.
+
+    Converts ``while``/``for-range`` whose bodies are assignment-only
+    (after the if-transformer has run) into carried-closure ``_jst_while``
+    dispatch, with a single leading ``if c: break/continue`` or trailing
+    ``if c: break`` lowered to a carried done-flag + predicated updates.
+    """
+
+    _OK_STMTS = (ast.Assign, ast.AugAssign, ast.Expr, ast.FunctionDef)
+
+    def __init__(self):
+        self.count = 0
+        self.converted = 0
+
+    # -- analysis ---------------------------------------------------------
+    def _body_ok(self, stmts) -> bool:
+        for s in stmts:
+            if not isinstance(s, self._OK_STMTS):
+                return False
+            if isinstance(s, ast.Expr) and not isinstance(
+                    s.value, ast.Constant):
+                # a bare expression in a loop body is almost always a
+                # side-effecting call (list.append, dict update, print):
+                # running it inside a traced closure would leak tracers
+                # into Python state — leave such loops to plain Python
+                return False
+            if isinstance(s, ast.Assign):
+                for t in s.targets:
+                    if isinstance(t, ast.Name):
+                        continue
+                    if isinstance(t, ast.Tuple) and all(
+                            isinstance(e, ast.Name) for e in t.elts):
+                        continue
+                    return False
+            if isinstance(s, ast.AugAssign) and not isinstance(
+                    s.target, ast.Name):
+                return False
+            # no hidden control flow inside expressions — but do NOT
+            # descend into nested FunctionDefs: the if-transformer's
+            # generated branch closures legitimately contain Return
+            stack = list(ast.iter_child_nodes(s)) if not isinstance(
+                s, ast.FunctionDef) else []
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.Break, ast.Continue, ast.Return,
+                                  ast.While, ast.For, ast.If, ast.Yield,
+                                  ast.YieldFrom, ast.Await)):
+                    return False
+                if not isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    stack.extend(ast.iter_child_nodes(n))
+        return True
+
+    def _split_break(self, body):
+        """Return (mode, pred, rest) where mode in {None, 'lead_break',
+        'lead_continue', 'tail_break'}."""
+        def is_exit_if(s, kind):
+            return (isinstance(s, ast.If) and not s.orelse
+                    and len(s.body) == 1 and isinstance(s.body[0], kind))
+
+        if body and is_exit_if(body[0], ast.Break):
+            return "lead_break", body[0].test, body[1:]
+        if body and is_exit_if(body[0], ast.Continue):
+            return "lead_continue", body[0].test, body[1:]
+        if body and is_exit_if(body[-1], ast.Break):
+            return "tail_break", body[-1].test, body[:-1]
+        return None, None, body
+
+    def _carried(self, test, body_stmts, after_loads, brk_pred=None):
+        """Loop-carried names: assigned in body AND (read by the test or
+        the break/continue predicate, read before written in the body, or
+        read after the loop)."""
+        assigned: Set[str] = set()
+        for s in body_stmts:
+            assigned |= _stores(s)
+        live: Set[str] = set()
+        written: Set[str] = set()
+        for s in body_stmts:
+            if isinstance(s, ast.Assign):
+                live |= (_loads(s.value) & assigned) - written
+                for t in s.targets:
+                    written |= _stores(t)
+            elif isinstance(s, ast.AugAssign):
+                live.add(s.target.id)
+                live |= (_loads(s.value) & assigned) - written
+                written.add(s.target.id)
+            else:
+                live |= (_loads(s) & assigned) - written
+        if test is not None:
+            live |= _loads(test) & assigned
+        if brk_pred is not None:
+            # the break predicate is re-evaluated every iteration: any
+            # body-assigned name it reads must ride in the carry or it
+            # would see a stale pre-loop snapshot forever
+            live |= _loads(brk_pred) & assigned
+        live |= after_loads & assigned
+        # only live names ride in the carry (they must be bound before the
+        # loop, the reference's loop-var rule); write-before-read temps
+        # stay body-local
+        return sorted(live)
+
+    # -- conversion -------------------------------------------------------
+    def _convert(self, node, after_loads):
+        is_for = isinstance(node, ast.For)
+        if node.orelse:
+            return None
+        mode, brk_pred, body = self._split_break(list(node.body))
+        if not self._body_ok(body):
+            return None
+        if mode is not None and brk_pred is None:
+            return None
+        if is_for:
+            # for <name> in range(...)
+            if not (isinstance(node.target, ast.Name)
+                    and isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and 1 <= len(node.iter.args) <= 3
+                    and not node.iter.keywords):
+                return None
+            ivar = node.target.id
+            if ivar in after_loads:
+                # python leaves i at the LAST value; our carry leaves it
+                # one step past — bail rather than deviate
+                return None
+            ra = node.iter.args
+            start = ast.unparse(ra[0]) if len(ra) >= 2 else "0"
+            stop = ast.unparse(ra[1] if len(ra) >= 2 else ra[0])
+            if len(ra) == 3:
+                if not (isinstance(ra[2], ast.Constant)
+                        and isinstance(ra[2].value, int)
+                        and ra[2].value > 0):
+                    return None
+                step = str(ra[2].value)
+            else:
+                step = "1"
+            test_src = None
+        else:
+            test_src = ast.unparse(node.test)
+
+        carried = self._carried(node.test if not is_for else None, body,
+                                after_loads, brk_pred=brk_pred)
+        if is_for and ivar in carried:
+            carried.remove(ivar)
+        if not carried:
+            return None
+        self.count += 1
+        k = self.count
+        names = ", ".join(carried)
+        done = f"__jst_done_{k}"
+        ctr = f"__jst_i_{k}"
+        body_src = "\n".join(
+            ast.unparse(ast.fix_missing_locations(s)) for s in body
+        ) or "pass"
+
+        args = ([ctr] if is_for else []) + carried + (
+            [done] if mode in ("lead_break", "tail_break") else [])
+        argl = ", ".join(args)
+        lines = []
+        if is_for:
+            lines.append(f"{ctr} = {start}")
+            lines.append(f"__jst_n_{k} = {stop}")
+        if mode in ("lead_break", "tail_break"):
+            lines.append(f"{done} = False")
+        # cond
+        base_test = (f"_jst_lt({ctr}, __jst_n_{k})" if is_for
+                     else f"({test_src})")
+        if mode in ("lead_break", "tail_break"):
+            cond_ret = f"_jst_and({base_test}, _jst_not({done}))"
+        else:
+            cond_ret = base_test
+        lines.append(f"def __jst_cond_{k}({argl}):")
+        lines.append(f"    return {cond_ret}")
+        # body
+        lines.append(f"def __jst_body_{k}({argl}):")
+        if is_for:
+            lines.append(f"    {node.target.id} = {ctr}")
+        if mode in ("lead_break", "lead_continue"):
+            pred = ast.unparse(brk_pred)
+            defaults = ", ".join(f"{c}={c}" for c in carried)
+            lines.append(f"    __jst_p_{k} = {pred}")
+            lines.append(f"    def __jst_rest_{k}({defaults}):")
+            for ln in body_src.splitlines():
+                lines.append(f"        {ln}")
+            lines.append(f"        return ({names},)")
+            lines.append(f"    ({names},) = _jst_select(__jst_p_{k}, "
+                         f"({names},), __jst_rest_{k})")
+            if mode == "lead_break":
+                lines.append(f"    {done} = _jst_or({done}, __jst_p_{k})")
+        else:
+            for ln in body_src.splitlines():
+                lines.append(f"    {ln}")
+            if mode == "tail_break":
+                lines.append(f"    {done} = {ast.unparse(brk_pred)}")
+        if is_for:
+            lines.append(f"    {ctr} = {ctr} + {step}")
+        lines.append(f"    return ({argl},)" if len(args) == 1
+                     else f"    return ({argl})")
+        # dispatch
+        lines.append(f"({argl},) = _jst_while(__jst_cond_{k}, "
+                     f"__jst_body_{k}, ({argl},))"
+                     if len(args) == 1 else
+                     f"({argl}) = _jst_while(__jst_cond_{k}, "
+                     f"__jst_body_{k}, ({argl}))")
+        src = "\n".join(lines)
+        try:
+            new_stmts = ast.parse(src).body
+        except SyntaxError:  # pragma: no cover - defensive
+            return None
+        self.converted += 1
+        return new_stmts
+
+    def _rewrite(self, stmts, extra_after: Optional[Set[str]] = None):
+        out = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, (ast.While, ast.For)):
+                after_loads: Set[str] = set(extra_after or ())
+                for t in stmts[i + 1:]:
+                    after_loads |= _loads(t)
+                conv = self._convert(s, after_loads)
+                if conv is not None:
+                    out.extend(conv)
+                    continue
+            out.append(s)
+        return out
+
+    def visit_FunctionDef(self, node):
         self.generic_visit(node)
-        node.body = self._rewrite_body(node.body)
+        node.body = self._rewrite(node.body)
+        return node
+
+    def visit_While(self, node):
+        # convert inner loops first; a converted inner loop inside an
+        # unconverted (Python) outer loop is still a win
+        self.generic_visit(node)
+        node.body = self._rewrite(node.body,
+                                  extra_after=_loads(node))
+        return node
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        node.body = self._rewrite(node.body,
+                                  extra_after=_loads(node))
         return node
 
 
@@ -199,7 +607,9 @@ def convert_control_flow(fn: Callable) -> Callable:
     fdef.decorator_list = []  # run undecorated (to_static wraps us)
     tr = _IfElseTransformer()
     tr.visit(tree)
-    if not tr.converted:
+    lt = _LoopTransformer()
+    lt.visit(tree)
+    if not (tr.converted or lt.converted):
         return fn
     ast.fix_missing_locations(tree)
     try:
@@ -207,7 +617,9 @@ def convert_control_flow(fn: Callable) -> Callable:
     except (ValueError, SyntaxError):  # pragma: no cover - defensive
         return fn
     glb = dict(fn.__globals__)
-    glb["_jst_cond"] = _jst_cond
+    glb.update(_jst_cond=_jst_cond, _jst_while=_jst_while,
+               _jst_select=_jst_select, _jst_and=_jst_and,
+               _jst_or=_jst_or, _jst_not=_jst_not, _jst_lt=_jst_lt)
     # snapshot closure cells into globals (documented limitation: the
     # converted function sees decoration-time closure values)
     if fn.__closure__:

@@ -20,6 +20,7 @@ from .random import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from . import linalg  # noqa: F401
 from .linalg import norm, dist  # noqa: F401
+from . import sequence  # noqa: F401
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply as _apply

@@ -1,0 +1,303 @@
+"""Sequence ops over (padded values, lengths) pairs.
+
+Reference: operators/sequence_ops/*.cc — 19 LoD-tensor kernels.  SURVEY §7
+sets the TPU design stance: LoD (ragged) tensors become dense padded
+arrays plus a ``lengths`` vector, and every kernel becomes a masked dense
+computation with static shapes — jittable, vmappable, MXU-friendly.
+Each function documents its reference kernel; semantics over the valid
+region match the reference, and the padded region is deterministic
+(pad_value or zero), never garbage.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
+    "sequence_softmax", "sequence_reverse", "sequence_expand_as",
+    "sequence_concat", "sequence_slice", "sequence_erase",
+    "sequence_enumerate", "sequence_conv", "sequence_first_step",
+    "sequence_last_step",
+]
+
+_arr = lambda x: x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _mask(lengths, maxlen, dtype=jnp.bool_):
+    return (jnp.arange(maxlen)[None, :] < lengths[:, None]).astype(dtype)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    """[B] lengths -> [B, maxlen] 0/1 mask (sequence_mask_op.cc)."""
+    from ..nn import functional as F
+    return F.sequence_mask(lengths, maxlen, dtype)
+
+
+def sequence_pad(x, lengths, maxlen=None, pad_value=0.0, name=None):
+    """Packed [total, ...] rows + [B] lengths -> ([B, maxlen, ...], [B]).
+
+    Reference: sequence_pad_op.cc (LoD -> padded).  ``maxlen`` must be
+    static under jit; defaults to the eager max length."""
+    xa, la = _arr(x), _arr(lengths).astype(jnp.int32)
+    if maxlen is None:
+        maxlen = int(jax.device_get(la.max()))
+
+    def fn(xv, lv):
+        B = lv.shape[0]
+        offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(lv)[:-1]])
+        idx = offs[:, None] + jnp.arange(maxlen)[None, :]      # [B, T]
+        valid = _mask(lv, maxlen)
+        gathered = xv[jnp.clip(idx, 0, xv.shape[0] - 1)]
+        shape = (B, maxlen) + (1,) * (xv.ndim - 1)
+        return jnp.where(valid.reshape(shape), gathered, pad_value), lv
+
+    return apply(fn, Tensor(xa), Tensor(la), op_name="sequence_pad")
+
+
+def sequence_unpad(x, lengths, name=None):
+    """Padded [B, T, ...] -> packed [sum(lengths), ...]
+    (sequence_unpad_op.cc).  The output length is data-dependent, so this
+    runs eagerly; under jit use the (values, lengths) pair directly."""
+    xa, la = _arr(x), _arr(lengths)
+    if isinstance(xa, jax.core.Tracer):
+        raise RuntimeError(
+            "sequence_unpad produces a data-dependent shape and cannot "
+            "run under jit — keep the (padded, lengths) pair (SURVEY §7 "
+            "LoD->padding design) or unpad outside the compiled region.")
+    import numpy as np
+    xn, ln = np.asarray(xa), np.asarray(la)
+    rows = [xn[i, :int(l)] for i, l in enumerate(ln)]
+    return Tensor(jnp.asarray(np.concatenate(rows, axis=0)))
+
+
+def _pool_fn(xv, lv, *, pool_type, pad_value):
+    T = xv.shape[1]
+    m = _mask(lv, T, xv.dtype)
+    shape = m.shape + (1,) * (xv.ndim - 2)
+    m = m.reshape(shape)
+    neg = jnp.asarray(jnp.finfo(xv.dtype).min, xv.dtype)
+    cnt = jnp.maximum(lv.astype(xv.dtype), 1.0)
+    cnt = cnt.reshape((-1,) + (1,) * (xv.ndim - 2))
+    if pool_type == "sum":
+        out = (xv * m).sum(axis=1)
+    elif pool_type == "average":
+        out = (xv * m).sum(axis=1) / cnt
+    elif pool_type == "sqrt":
+        out = (xv * m).sum(axis=1) / jnp.sqrt(cnt)
+    elif pool_type == "max":
+        out = jnp.where(m > 0, xv, neg).max(axis=1)
+    elif pool_type == "first":
+        out = xv[:, 0]
+    elif pool_type == "last":
+        idx = jnp.maximum(lv - 1, 0)
+        out = jnp.take_along_axis(
+            xv, idx.reshape((-1, 1) + (1,) * (xv.ndim - 2)), axis=1
+        ).squeeze(1)
+    else:
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    # empty sequences yield pad_value (sequence_pool_op.h)
+    empty = (lv == 0).reshape((-1,) + (1,) * (xv.ndim - 2))
+    return jnp.where(empty, jnp.asarray(pad_value, xv.dtype), out)
+
+
+def sequence_pool(x, lengths, pool_type="average", pad_value=0.0,
+                  name=None):
+    """Masked pooling over time (sequence_pool_op.cc): sum / average /
+    sqrt / max / first / last on [B, T, ...] with [B] lengths."""
+    return apply(_pool_fn, x, Tensor(_arr(lengths).astype(jnp.int32)),
+                 op_name="sequence_pool", pool_type=pool_type.lower(),
+                 pad_value=float(pad_value))
+
+
+def sequence_first_step(x, lengths, name=None):
+    return sequence_pool(x, lengths, "first")
+
+
+def sequence_last_step(x, lengths, name=None):
+    return sequence_pool(x, lengths, "last")
+
+
+def _softmax_fn(xv, lv):
+    m = _mask(lv, xv.shape[1])
+    z = jnp.where(m, xv, -jnp.inf)
+    out = jax.nn.softmax(z, axis=1)
+    return jnp.where(m, out, 0.0)
+
+
+def sequence_softmax(x, lengths, name=None):
+    """Per-row masked softmax over the valid prefix
+    (sequence_softmax_op.cc)."""
+    return apply(_softmax_fn, x, Tensor(_arr(lengths).astype(jnp.int32)),
+                 op_name="sequence_softmax")
+
+
+def _reverse_fn(xv, lv):
+    T = xv.shape[1]
+    t = jnp.arange(T)[None, :]
+    idx = jnp.where(t < lv[:, None], lv[:, None] - 1 - t, t)
+    return jnp.take_along_axis(
+        xv, idx.reshape(idx.shape + (1,) * (xv.ndim - 2)), axis=1)
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    """Reverse each row's valid prefix, padding stays in place
+    (sequence_reverse_op.cc)."""
+    xa = _arr(x)
+    if lengths is None:
+        lengths = jnp.full((xa.shape[0],), xa.shape[1], jnp.int32)
+    return apply(_reverse_fn, Tensor(xa),
+                 Tensor(_arr(lengths).astype(jnp.int32)),
+                 op_name="sequence_reverse")
+
+
+def sequence_expand_as(x, lengths, maxlen, name=None):
+    """Tile row i of [B, ...] into [B, maxlen, ...], valid for
+    ``lengths[i]`` slots, zero beyond (sequence_expand_as_op.cc under the
+    padded design: the reference repeats rows to match a ragged target;
+    here the target is (maxlen, lengths))."""
+    def fn(xv, lv):
+        tiled = jnp.repeat(xv[:, None], maxlen, axis=1)
+        m = _mask(lv, maxlen, xv.dtype)
+        return tiled * m.reshape(m.shape + (1,) * (xv.ndim - 1))
+
+    return apply(fn, x, Tensor(_arr(lengths).astype(jnp.int32)),
+                 op_name="sequence_expand_as")
+
+
+def _concat_fn(a, la, b, lb):
+    B, Ta = a.shape[:2]
+    Tb = b.shape[1]
+    T = Ta + Tb
+    t = jnp.arange(T)[None, :]                      # [1, T]
+    in_a = t < la[:, None]
+    ia = jnp.broadcast_to(jnp.clip(t, 0, Ta - 1), (B, T))
+    ib = jnp.clip(t - la[:, None], 0, Tb - 1)
+    ga = jnp.take_along_axis(
+        a, ia.reshape((B, T) + (1,) * (a.ndim - 2)), axis=1)
+    gb = jnp.take_along_axis(
+        b, ib.reshape((B, T) + (1,) * (b.ndim - 2)), axis=1)
+    valid = t < (la + lb)[:, None]
+    sel = jnp.where(in_a.reshape((B, T) + (1,) * (a.ndim - 2)), ga, gb)
+    return (sel * valid.reshape((B, T) + (1,) * (a.ndim - 2)).astype(
+        a.dtype), la + lb)
+
+
+def sequence_concat(xs, lengths_list, name=None):
+    """Concatenate sequences along time per row
+    (sequence_concat_op.cc): ([B,Ta,..],[B]) + ([B,Tb,..],[B]) -> ...
+    Output time dim = sum of input time dims; valid prefix = sum of
+    lengths, padding zeroed."""
+    assert len(xs) == len(lengths_list) and len(xs) >= 1
+    out = xs[0] if isinstance(xs[0], Tensor) else Tensor(_arr(xs[0]))
+    lo = Tensor(_arr(lengths_list[0]).astype(jnp.int32))
+    for x2, l2 in zip(xs[1:], lengths_list[1:]):
+        out, lo = apply(
+            _concat_fn, out, lo, x2, Tensor(_arr(l2).astype(jnp.int32)),
+            op_name="sequence_concat")
+    return out, lo
+
+
+def _slice_fn(xv, off, ln):
+    B, T = xv.shape[:2]
+    t = jnp.arange(T)[None, :]
+    idx = jnp.clip(off[:, None] + t, 0, T - 1)
+    g = jnp.take_along_axis(
+        xv, idx.reshape((B, T) + (1,) * (xv.ndim - 2)), axis=1)
+    m = (t < ln[:, None]).reshape((B, T) + (1,) * (xv.ndim - 2))
+    return g * m.astype(xv.dtype), ln
+
+
+def sequence_slice(x, offset, length, name=None):
+    """Per-row slice [offset, offset+length) of the time dim, left-packed
+    and zero-padded (sequence_slice_op.cc)."""
+    return apply(_slice_fn, x, Tensor(_arr(offset).astype(jnp.int32)),
+                 Tensor(_arr(length).astype(jnp.int32)),
+                 op_name="sequence_slice")
+
+
+def _erase_fn(ids, lv, *, tokens):
+    B, T = ids.shape
+    t = jnp.arange(T)[None, :]
+    valid = t < lv[:, None]
+    erase = jnp.zeros_like(valid)
+    for tok in tokens:
+        erase = erase | (ids == tok)
+    keep = valid & ~erase
+    # stable left-compaction: order by (dropped, position)
+    rank = jnp.where(keep, t, T + t)
+    order = jnp.argsort(rank, axis=1)
+    packed = jnp.take_along_axis(ids, order, axis=1)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    packed = jnp.where(t < new_len[:, None], packed, 0)
+    return packed, new_len
+
+
+def sequence_erase(x, tokens, lengths=None, name=None):
+    """Remove every id in ``tokens``, left-compact, zero-pad; returns
+    (ids, new_lengths) (sequence_erase_op.cc)."""
+    xa = _arr(x)
+    if lengths is None:
+        lengths = jnp.full((xa.shape[0],), xa.shape[1], jnp.int32)
+    return apply(_erase_fn, Tensor(xa),
+                 Tensor(_arr(lengths).astype(jnp.int32)),
+                 op_name="sequence_erase", nondiff=True,
+                 tokens=tuple(int(v) for v in tokens))
+
+
+def _enumerate_fn(ids, lv, *, win_size, pad_value):
+    B, T = ids.shape
+    t = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]  # [T, W]
+    g = ids[:, jnp.clip(t, 0, T - 1)]                            # [B, T, W]
+    ok = (t[None] < lv[:, None, None])
+    return jnp.where(ok, g, pad_value)
+
+
+def sequence_enumerate(x, win_size, pad_value=0, lengths=None, name=None):
+    """Sliding windows of ids: [B, T] -> [B, T, win_size]
+    (sequence_enumerate_op.cc), windows crossing the row's end padded."""
+    xa = _arr(x)
+    if lengths is None:
+        lengths = jnp.full((xa.shape[0],), xa.shape[1], jnp.int32)
+    return apply(_enumerate_fn, Tensor(xa),
+                 Tensor(_arr(lengths).astype(jnp.int32)),
+                 op_name="sequence_enumerate", nondiff=True,
+                 win_size=int(win_size), pad_value=int(pad_value))
+
+
+def _seq_conv_fn(xv, lv, w, *maybe_b, context_length, context_start):
+    B, T, D = xv.shape
+    m = _mask(lv, T, xv.dtype)[..., None]
+    xm = xv * m
+    cols = []
+    for k in range(context_length):
+        shift = context_start + k
+        rolled = jnp.roll(xm, -shift, axis=1)
+        t = jnp.arange(T)
+        ok = ((t + shift >= 0) & (t + shift < T))[None, :, None]
+        cols.append(rolled * ok)
+    ctx = jnp.concatenate(cols, axis=-1)            # [B, T, ctx*D]
+    out = ctx @ w                                   # MXU matmul
+    if maybe_b:
+        out = out + maybe_b[0]
+    return out * m
+
+
+def sequence_conv(x, lengths, weight, bias=None, context_length=3,
+                  context_start=None, name=None):
+    """Context-window sequence convolution (sequence_conv_op.cc): gather
+    ``context_length`` shifted copies, one [ctx*D, out] matmul — im2col
+    over time, phrased as a dense MXU matmul.  ``weight``:
+    [context_length * D, out_dim]."""
+    if context_start is None:
+        context_start = -(context_length // 2)
+    args = [x, Tensor(_arr(lengths).astype(jnp.int32)), weight]
+    if bias is not None:
+        args.append(bias)
+    return apply(_seq_conv_fn, *args, op_name="sequence_conv",
+                 context_length=int(context_length),
+                 context_start=int(context_start))

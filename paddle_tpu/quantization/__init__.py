@@ -222,3 +222,120 @@ def _wrappers(model):
         if isinstance(child, _QuantWrapper):
             out.append(child)
     return out
+
+
+# ---------------------------------------------------------------------------
+# int8 deployment (VERDICT r4 #8) — reference: contrib/slim post-training
+# quant convert flow (quant2_int8 pass): fake-quant programs become real
+# int8 weights + scale metadata baked into the jit.save artifact, served
+# by the Predictor with int8 MXU matmuls (static activation scales) or
+# fused weight-dequant (dynamic).
+# ---------------------------------------------------------------------------
+
+def _quantize_weight(w, bits, axis):
+    qmax = 2.0 ** (bits - 1) - 1
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    scale = jnp.maximum(jnp.max(jnp.abs(w), axis=red, keepdims=True), 1e-8)
+    q = jnp.round(jnp.clip(w / scale * qmax, -qmax, qmax)).astype(jnp.int8)
+    return q, (scale / qmax).astype(jnp.float32)
+
+
+class Int8Linear(nn.Layer):
+    """Deployed int8 linear: int8 weight buffer + per-output-channel
+    scales.  With a calibrated activation scale the matmul itself runs
+    int8 x int8 -> int32 on the MXU (reference: quant2_int8 mkldnn/TRT
+    pass); without one it is weight-only int8 (dequant fused into the
+    matmul by XLA)."""
+
+    def __init__(self, linear: nn.Linear, act_scale: float,
+                 weight_bits: int = 8, activation_bits: int = 8):
+        super().__init__()
+        w = linear.weight.data                     # [in, out]
+        q, s = _quantize_weight(w, weight_bits, axis=1)
+        self.register_buffer("qweight", Tensor(q))
+        self.register_buffer("w_scale", Tensor(s.reshape(-1)))
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(act_scale, jnp.float32)))
+        self._act_qmax = 2.0 ** (activation_bits - 1) - 1
+        # static-vs-dynamic is a conversion-time property (a calibrated
+        # scale exists or not), snapshot it as a Python bool — the buffer
+        # is traced at jit time and cannot drive Python control flow
+        self._static_act = float(act_scale) > 0.0
+        self.bias = (None if linear.bias is None else linear.bias)
+
+    def forward(self, x):
+        from ..core.dispatch import apply
+        qmax = self._act_qmax
+        static = self._static_act
+        args = [x, self.qweight, self.w_scale, self.act_scale] + (
+            [self.bias] if self.bias is not None else [])
+
+        def fn(a, qw, ws, as_, *mb):
+            if static:
+                # static int8 activations: int8 x int8 -> int32 MXU path
+                aq = jnp.round(jnp.clip(a / as_ * qmax, -qmax, qmax)
+                               ).astype(jnp.int8)
+                acc = jax.lax.dot_general(
+                    aq, qw, (((a.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out = acc.astype(jnp.float32) * (as_ / qmax) * ws
+            else:
+                # weight-only: dequant fused into the matmul epilogue
+                out = a @ (qw.astype(a.dtype) * ws.astype(a.dtype))
+            if mb:
+                out = out + mb[0]
+            return out.astype(a.dtype)
+
+        return apply(fn, *args, op_name="int8_linear", nondiff=True)
+
+
+class Int8Conv2D(nn.Layer):
+    """Deployed weight-only int8 conv (per-out-channel scales; dequant
+    fuses into the conv)."""
+
+    def __init__(self, conv: nn.Conv2D, act_scale: float,
+                 weight_bits: int = 8):
+        super().__init__()
+        w = conv.weight.data                       # [out, in, kh, kw]
+        q, s = _quantize_weight(w, weight_bits, axis=0)
+        self.register_buffer("qweight", Tensor(q))
+        self.register_buffer("w_scale", Tensor(s))
+        self.bias = (None if conv.bias is None else conv.bias)
+        # copy conv attrs only — registering the conv itself would keep
+        # its f32 weight in the state dict and erase the artifact saving
+        self._stride = conv._stride
+        self._padding = conv._padding
+        self._dilation = conv._dilation
+        self._groups = conv._groups
+        self._data_format = conv._data_format
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        w = Tensor(self.qweight.data.astype(x.data.dtype)
+                   * self.w_scale.data.astype(x.data.dtype))
+        return F.conv2d(x, w, self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups,
+                        data_format=self._data_format)
+
+
+def convert_to_int8(model: nn.Layer, inplace: bool = True) -> nn.Layer:
+    """Replace fake-quant wrappers (QAT/PTQ output) with real int8
+    layers whose int8 weights + scales live in the state dict — so
+    ``jit.save`` exports an int8 artifact the Predictor serves directly.
+    Reference: contrib/slim quant2_int8 conversion."""
+    assert inplace, "convert_to_int8 is in-place"
+    for name, child in list(model.named_children()):
+        if isinstance(child, _QuantWrapper):
+            scale = float(child.act_scale.data)
+            inner = child._inner
+            cfg = child._cfg
+            if isinstance(inner, nn.Linear):
+                setattr(model, name, Int8Linear(
+                    inner, scale, cfg.weight_bits, cfg.activation_bits))
+            elif isinstance(inner, nn.Conv2D):
+                setattr(model, name, Int8Conv2D(
+                    inner, scale, cfg.weight_bits))
+        else:
+            convert_to_int8(child)
+    return model

@@ -17,7 +17,9 @@ import numpy as np
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 
-__all__ = ["box_iou", "nms", "box_area"]
+__all__ = ["box_iou", "nms", "box_area", "roi_align", "yolo_box",
+           "prior_box", "box_coder", "multiclass_nms", "box_clip",
+           "iou_similarity"]
 
 
 def _area(b):
@@ -115,3 +117,366 @@ def nms(boxes, iou_threshold: float = 0.3, scores=None,
     idx_dt = (jnp.int64 if jax.config.read("jax_enable_x64")
               else jnp.int32)
     return Tensor(jnp.asarray(kept, idx_dt))
+
+
+# ---------------------------------------------------------------------------
+# detection zoo (VERDICT r4 #5) — TPU-first redesigns of
+# operators/detection/: fixed shapes, masked outputs instead of LoD,
+# gathers instead of scalar loops, everything jittable and vmappable.
+# ---------------------------------------------------------------------------
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU matrix (reference: iou_similarity_op.cc)."""
+    return box_iou(x, y)
+
+
+def _clip_fn(b, im_info):
+    # im_info rows: [h, w, scale]; boxes clipped to [0, dim - 1]
+    h = im_info[..., 0:1] - 1.0
+    w = im_info[..., 1:2] - 1.0
+    x1 = jnp.clip(b[..., 0], 0, w)
+    y1 = jnp.clip(b[..., 1], 0, h)
+    x2 = jnp.clip(b[..., 2], 0, w)
+    y2 = jnp.clip(b[..., 3], 0, h)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def box_clip(input, im_info, name=None):
+    """Clip [.., 4] xyxy boxes to image bounds (box_clip_op.cc).
+    ``im_info``: [h, w, scale] (broadcast over leading dims)."""
+    return apply(_clip_fn, input, im_info, op_name="box_clip")
+
+
+def _roi_align_fn(x, boxes, batch_idx, *, output_size, spatial_scale,
+                  sampling_ratio, aligned):
+    R = boxes.shape[0]
+    C, H, W = x.shape[1:]
+    ph, pw = output_size
+    S = sampling_ratio if sampling_ratio > 0 else 2
+    off = 0.5 if aligned else 0.0
+    b = boxes * spatial_scale
+    x1 = b[:, 0] - off
+    y1 = b[:, 1] - off
+    roi_w = b[:, 2] - b[:, 0]
+    roi_h = b[:, 3] - b[:, 1]
+    if not aligned:                      # legacy: min size 1 (roi_align_op.h)
+        roi_w = jnp.maximum(roi_w, 1.0)
+        roi_h = jnp.maximum(roi_h, 1.0)
+    bin_w = roi_w / pw
+    bin_h = roi_h / ph
+    # sample grid: for output bin (i,j), S x S points at
+    # y = y1 + (i + (sy + .5)/S) * bin_h   (roi_align_op.h bilinear loop)
+    iy = (jnp.arange(ph)[:, None] + (jnp.arange(S)[None, :] + 0.5) / S)
+    ix = (jnp.arange(pw)[:, None] + (jnp.arange(S)[None, :] + 0.5) / S)
+    ys = y1[:, None, None] + iy[None] * bin_h[:, None, None]   # [R,ph,S]
+    xs = x1[:, None, None] + ix[None] * bin_w[:, None, None]   # [R,pw,S]
+
+    def bilinear_1d(coord, size):
+        c = jnp.clip(coord, 0.0, size - 1.0)
+        lo = jnp.clip(jnp.floor(c).astype(jnp.int32), 0, size - 1)
+        hi = jnp.minimum(lo + 1, size - 1)
+        frac = c - lo
+        # out-of-range samples contribute 0 (roi_align_op.h: skip when
+        # y < -1 or y > height, clamp the [-1, 0) band to 0)
+        valid = (coord >= -1.0) & (coord <= size)
+        return lo, hi, frac, valid
+
+    ylo, yhi, fy, vy = bilinear_1d(ys, H)        # [R,ph,S]
+    xlo, xhi, fx, vx = bilinear_1d(xs, W)        # [R,pw,S]
+    bi = batch_idx[:, None, None]
+
+    def gather_rows(yi):                          # yi [R,ph,S] -> [R,ph,S,C,W]
+        return x[bi, :, yi, :]
+
+    top, bot = gather_rows(ylo), gather_rows(yhi)
+    rows = top + (bot - top) * fy[..., None, None]     # [R,ph,S,C,W]
+    rows = rows * vy[..., None, None]
+
+    # gather along W: result [R, ph, Sy, C, pw, Sx]
+    left = jnp.take_along_axis(
+        rows[:, :, :, :, None, None, :],
+        xlo[:, None, None, None, :, :, None].astype(jnp.int32), axis=-1)[..., 0]
+    right = jnp.take_along_axis(
+        rows[:, :, :, :, None, None, :],
+        xhi[:, None, None, None, :, :, None].astype(jnp.int32), axis=-1)[..., 0]
+    vals = left + (right - left) * fx[:, None, None, None, :, :]
+    vals = vals * vx[:, None, None, None, :, :]
+    # average over the S x S samples -> [R, C, ph, pw]
+    out = vals.mean(axis=(2, 5))                  # [R, ph, C, pw]
+    return out.transpose(0, 2, 1, 3)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference: roi_align_op.cc / vision/ops.py roi_align).
+
+    ``x``: [N, C, H, W]; ``boxes``: [R, 4] xyxy in input-image coords;
+    ``boxes_num``: [N] rois per image.  Output [R, C, ph, pw].
+
+    TPU deviation (documented): ``sampling_ratio=-1`` uses a fixed 2x2
+    sample grid per bin instead of the reference's per-RoI adaptive
+    ``ceil(roi_size / pooled_size)`` — adaptive counts are data-dependent
+    shapes XLA cannot compile.  Pass an explicit ``sampling_ratio`` for
+    bit-matched parity with the reference kernel."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    xa = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    ba = boxes.data if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    bn = (boxes_num.data if isinstance(boxes_num, Tensor)
+          else jnp.asarray(boxes_num)).astype(jnp.int32)
+    # roi -> image index: searchsorted over the cumulative roi counts
+    # (replaces the reference's LoD offsets, roi_align_op.cc:74)
+    batch_idx = jnp.searchsorted(jnp.cumsum(bn), jnp.arange(ba.shape[0]),
+                                 side="right").astype(jnp.int32)
+    return apply(_roi_align_fn, xa, ba, Tensor(batch_idx),
+                 op_name="roi_align", output_size=tuple(output_size),
+                 spatial_scale=float(spatial_scale),
+                 sampling_ratio=int(sampling_ratio), aligned=bool(aligned))
+
+
+def _yolo_box_fn(x, img_size, *, anchors, class_num, conf_thresh,
+                 downsample_ratio, clip_bbox, scale_x_y):
+    n, c, h, w = x.shape
+    an = len(anchors) // 2
+    anc = jnp.asarray(anchors, x.dtype).reshape(an, 2)
+    bias = -0.5 * (scale_x_y - 1.0)
+    xv = x.reshape(n, an, class_num + 5, h, w)
+    tx, ty, tw, th = xv[:, :, 0], xv[:, :, 1], xv[:, :, 2], xv[:, :, 3]
+    obj = jax.nn.sigmoid(xv[:, :, 4])                       # [n,an,h,w]
+    cls = jax.nn.sigmoid(xv[:, :, 5:])                      # [n,an,cls,h,w]
+    img_h = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    img_w = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    in_h, in_w = downsample_ratio * h, downsample_ratio * w
+    gx = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    cx = (gx + jax.nn.sigmoid(tx) * scale_x_y + bias) * img_w / w
+    cy = (gy + jax.nn.sigmoid(ty) * scale_x_y + bias) * img_h / h
+    bw = jnp.exp(tw) * anc[None, :, 0, None, None] * img_w / in_w
+    bh = jnp.exp(th) * anc[None, :, 1, None, None] * img_h / in_h
+    x1, y1 = cx - bw / 2, cy - bh / 2
+    x2, y2 = cx + bw / 2, cy + bh / 2
+    if clip_bbox:
+        x1 = jnp.maximum(x1, 0.0)
+        y1 = jnp.maximum(y1, 0.0)
+        x2 = jnp.minimum(x2, img_w - 1.0)
+        y2 = jnp.minimum(y2, img_h - 1.0)
+    keep = obj >= conf_thresh                               # [n,an,h,w]
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+    scores = obj[:, :, None] * cls * keep[:, :, None]       # [n,an,cls,h,w]
+    # layout parity (yolo_box_op.h GetEntryIndex): anchor-major, then h, w
+    boxes = boxes.reshape(n, an * h * w, 4)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, an * h * w,
+                                                     class_num)
+    return boxes, scores
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0, name=None):
+    """YOLOv3 box decode (reference: yolo_box_op.cc/.h).
+
+    ``x``: [N, an*(5+classes), H, W]; ``img_size``: [N, 2] (h, w).
+    Returns (boxes [N, an*H*W, 4] xyxy, scores [N, an*H*W, classes]);
+    entries with objectness below ``conf_thresh`` are zeroed (the masked
+    analog of the reference's sparse write into zeroed outputs)."""
+    return apply(_yolo_box_fn, x, img_size, op_name="yolo_box",
+                 anchors=tuple(int(a) for a in anchors),
+                 class_num=int(class_num), conf_thresh=float(conf_thresh),
+                 downsample_ratio=int(downsample_ratio),
+                 clip_bbox=bool(clip_bbox), scale_x_y=float(scale_x_y))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (reference: prior_box_op.cc/.h).
+
+    Returns (boxes [H, W, P, 4] normalized xyxy, variances [H, W, P, 4]).
+    Pure host-side construction (priors depend only on shapes/attrs, like
+    the reference's CPU kernel) — the result is a constant for a given
+    feature size, so XLA folds it."""
+    xa = input.data if isinstance(input, Tensor) else jnp.asarray(input)
+    im = image.data if isinstance(image, Tensor) else jnp.asarray(image)
+    fh, fw = xa.shape[2], xa.shape[3]
+    ih, iw = im.shape[2], im.shape[3]
+    # ExpandAspectRatios (prior_box_op.h:28): dedup, keep 1.0 first
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    min_sizes = [float(s) for s in np.atleast_1d(min_sizes)]
+    max_sizes = ([float(s) for s in np.atleast_1d(max_sizes)]
+                 if max_sizes is not None else [])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    cx = (np.arange(fw) + offset) * step_w          # [fw]
+    cy = (np.arange(fh) + offset) * step_h          # [fh]
+    whs = []
+    for s, mn in enumerate(min_sizes):
+        variants = []
+        if min_max_aspect_ratios_order:
+            variants.append((mn / 2.0, mn / 2.0))
+            if max_sizes:
+                m = (mn * max_sizes[s]) ** 0.5 / 2.0
+                variants.append((m, m))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                variants.append((mn * ar ** 0.5 / 2.0, mn / ar ** 0.5 / 2.0))
+        else:
+            for ar in ars:
+                variants.append((mn * ar ** 0.5 / 2.0, mn / ar ** 0.5 / 2.0))
+            if max_sizes:
+                m = (mn * max_sizes[s]) ** 0.5 / 2.0
+                variants.append((m, m))
+        whs.extend(variants)
+    whs_np = np.asarray(whs, np.float32)            # [P, 2] half sizes
+    P = whs_np.shape[0]
+    gx = np.broadcast_to(cx[None, :, None], (fh, fw, P))
+    gy = np.broadcast_to(cy[:, None, None], (fh, fw, P))
+    hw = np.broadcast_to(whs_np[None, None, :, 0], (fh, fw, P))
+    hh = np.broadcast_to(whs_np[None, None, :, 1], (fh, fw, P))
+    boxes = np.stack([(gx - hw) / iw, (gy - hh) / ih,
+                      (gx + hw) / iw, (gy + hh) / ih], axis=-1)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          (fh, fw, P, 4)).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(var))
+
+
+def _encode_center(t, p, pv, normalized):
+    norm = 0.0 if normalized else 1.0
+    pw = p[:, 2] - p[:, 0] + norm
+    ph = p[:, 3] - p[:, 1] + norm
+    px = p[:, 0] + pw * 0.5
+    py = p[:, 1] + ph * 0.5
+    tw = t[:, 2] - t[:, 0] + norm
+    th = t[:, 3] - t[:, 1] + norm
+    tx = t[:, 0] + tw * 0.5
+    ty = t[:, 1] + th * 0.5
+    out = jnp.stack([
+        (tx[:, None] - px[None]) / pw[None],
+        (ty[:, None] - py[None]) / ph[None],
+        jnp.log(tw[:, None] / pw[None]),
+        jnp.log(th[:, None] / ph[None])], axis=-1)     # [N, M, 4]
+    if pv is not None:
+        out = out / pv[None]
+    return out
+
+
+def _decode_center(t, p, pv, normalized, axis):
+    norm = 0.0 if normalized else 1.0
+    pw = p[:, 2] - p[:, 0] + norm
+    ph = p[:, 3] - p[:, 1] + norm
+    px = p[:, 0] + pw * 0.5
+    py = p[:, 1] + ph * 0.5
+    # box_coder_op.h DecodeCenterSize: axis==0 indexes priors by the
+    # COLUMN (dim 1) of the [N, M, 4] codes; axis==1 by the row
+    ex = (slice(None), None) if axis == 1 else (None, slice(None))
+    pw, ph, px, py = (a[ex] for a in (pw, ph, px, py))
+    v = pv[ex + (slice(None),)] if pv is not None else jnp.ones((4,), t.dtype)
+    ox = v[..., 0] * t[..., 0] * pw + px
+    oy = v[..., 1] * t[..., 1] * ph + py
+    ow = jnp.exp(v[..., 2] * t[..., 2]) * pw
+    oh = jnp.exp(v[..., 3] * t[..., 3]) * ph
+    return jnp.stack([ox - ow / 2 + norm * 0.5, oy - oh / 2 + norm * 0.5,
+                      ox + ow / 2 - norm * 0.5, oy + oh / 2 - norm * 0.5],
+                     axis=-1)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference: box_coder_op.cc/.h).
+
+    encode: target [N, 4], priors [M, 4] -> [N, M, 4] offsets.
+    decode: target [N, M, 4] codes -> [N, M, 4] xyxy boxes (``axis``
+    selects which dim the priors broadcast over, as in the reference)."""
+    pv = prior_box_var
+    if pv is not None and not hasattr(pv, "shape"):
+        pv = jnp.asarray(pv, jnp.float32)
+    args = [prior_box, target_box] + ([pv] if pv is not None else [])
+
+    def fn(p, t, *rest):
+        v = rest[0] if rest else None
+        if v is not None and v.ndim == 1:
+            v = jnp.broadcast_to(v, p.shape)
+        if code_type == "encode_center_size":
+            return _encode_center(t, p, v, box_normalized)
+        return _decode_center(t, p, v, box_normalized, axis)
+
+    return apply(fn, *args, op_name="box_coder")
+
+
+def _multiclass_nms_fn(bboxes, scores, *, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold, normalized, nms_eta,
+                       background_label):
+    N, M, _ = bboxes.shape
+    C = scores.shape[1]
+
+    def per_class(boxes, sc):
+        # sc [M]; stage 1 (multiclass_nms_op.cc NMSFast): threshold,
+        # top-k by score, greedy NMS with adaptive eta
+        sc = jnp.where(sc > score_threshold, sc, 0.0)
+        if 0 < nms_top_k < M:
+            top = jnp.sort(sc)[::-1][nms_top_k - 1]
+            sc = jnp.where(sc >= jnp.maximum(top, 1e-38), sc, 0.0)
+        order = jnp.argsort(-sc)
+        iou = _iou_matrix(boxes[order], boxes[order])
+        n = M
+
+        def body(carry, i):
+            suppressed, thresh = carry
+            keep_i = (~suppressed[i]) & (sc[order[i]] > 0)
+            sup = (iou[i] > thresh) & keep_i
+            sup = jnp.where(jnp.arange(n) <= i, False, sup)
+            thresh = jnp.where(keep_i & (thresh > 0.5), thresh * nms_eta,
+                               thresh)
+            return (suppressed | sup, thresh), keep_i
+
+        (_, _), keep_sorted = jax.lax.scan(
+            body, (jnp.zeros(n, bool), jnp.asarray(nms_threshold)),
+            jnp.arange(n))
+        keep = jnp.zeros(n, bool).at[order].set(keep_sorted)
+        return jnp.where(keep, sc, 0.0)
+
+    def per_image(boxes, sc):
+        kept = jax.vmap(lambda s: per_class(boxes, s))(sc)   # [C, M]
+        if background_label >= 0:
+            kept = kept.at[background_label].set(0.0)
+        flat = kept.reshape(-1)                              # [C*M]
+        K = keep_top_k if keep_top_k > 0 else flat.shape[0]
+        K = min(K, flat.shape[0])
+        top_sc, top_ix = jax.lax.top_k(flat, K)
+        label = (top_ix // M).astype(jnp.float32)
+        box = boxes[top_ix % M]
+        valid = top_sc > 0.0
+        out = jnp.concatenate(
+            [jnp.where(valid, label, -1.0)[:, None], top_sc[:, None], box],
+            axis=1)
+        index = jnp.where(valid, top_ix % M, -1)
+        return out, index, valid.sum().astype(jnp.int32)
+
+    return jax.vmap(per_image)(bboxes, scores)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=-1, name=None):
+    """Multi-class NMS (reference: multiclass_nms_op.cc).
+
+    ``bboxes`` [N, M, 4], ``scores`` [N, C, M].  Returns
+    (out [N, K, 6] rows ``[label, score, x1, y1, x2, y2]``,
+    index [N, K] box indices, nms_num [N]) where K = keep_top_k; invalid
+    rows carry label/index -1 — the masked fixed-shape redesign of the
+    reference's LoD output (SURVEY §7 LoD -> padding)."""
+    return apply(_multiclass_nms_fn, bboxes, scores,
+                 op_name="multiclass_nms", nondiff=True,
+                 score_threshold=float(score_threshold),
+                 nms_top_k=int(nms_top_k), keep_top_k=int(keep_top_k),
+                 nms_threshold=float(nms_threshold),
+                 normalized=bool(normalized), nms_eta=float(nms_eta),
+                 background_label=int(background_label))

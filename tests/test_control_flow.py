@@ -68,12 +68,28 @@ def test_cond_traced_grad_parity():
         np.testing.assert_allclose(gs, x2.grad.numpy(), rtol=1e-5)
 
 
-def test_python_if_on_traced_tensor_raises_loudly():
+def test_python_while_on_traced_tensor_converts():
+    """Round-4 upgrade: dy2static now converts assignment-only tensor
+    ``while`` loops (loop_transformer.py analog) instead of raising."""
     @jit.to_static
     def fn(x):
         out = x
-        while x.sum() > 0:  # dy2static does not convert while: loud error
+        while x.sum() > 0:
             out = out * 2
+            x = x - 1
+        return out
+
+    np.testing.assert_allclose(fn(paddle.ones([2])).numpy(), [2.0, 2.0])
+
+
+def test_python_if_on_traced_tensor_raises_loudly():
+    """Genuinely unconvertible control flow (list mutation in the body)
+    must still fail loudly at trace time, not mistrace."""
+    @jit.to_static
+    def fn(x):
+        out = []
+        while x.sum() > 0:   # body appends to a list: not convertible
+            out.append(x)
             x = x - 1
         return out
 

@@ -69,3 +69,65 @@ def test_qat_conv_swap():
     assert isinstance(m[0], QuantizedConv2D)
     out = m(paddle.randn([2, 3, 8, 8]))
     assert out.shape == [2, 8, 8, 8]
+
+
+# -- int8 deployment (VERDICT r4 #8) -------------------------------------
+
+def test_convert_to_int8_accuracy_and_serving(tmp_path):
+    """PTQ -> convert_to_int8 -> jit.save -> Predictor: the served int8
+    model must stay close to the float model, and the artifact must store
+    int8 weights (reference: contrib/slim quant2_int8 flow)."""
+    import os
+    import jax.numpy as jnp
+    from paddle_tpu import inference, jit
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.quantization import PTQ, convert_to_int8, Int8Linear
+
+    paddle.seed(50)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    r = np.random.RandomState(50)
+    x = paddle.to_tensor(r.randn(8, 16).astype(np.float32))
+    ref = net(x).numpy()
+
+    q = PTQ().quantize(net)
+    for _ in range(4):          # calibration
+        net(x)
+    PTQ.convert(net)
+    convert_to_int8(net)
+    assert any(isinstance(m, Int8Linear) for m in net.sublayers())
+    got = net(x).numpy()
+    # int8 close to float on this scale of model
+    assert np.abs(got - ref).max() < 0.12 * np.abs(ref).max() + 0.05
+
+    # int8 weights live in the state dict (small artifact)
+    sd = net.state_dict()
+    qw = [v for k, v in sd.items() if k.endswith("qweight")]
+    assert qw and all(np.asarray(v.data).dtype == np.int8 for v in qw)
+    assert not any(k.endswith(".weight") for k in sd)  # f32 weights gone
+
+    pfx = os.path.join(str(tmp_path), "int8")
+    jit.save(net, pfx, input_spec=[InputSpec([None, 16], "float32")])
+    pred = inference.create_predictor(inference.Config(pfx))
+    out = np.asarray(pred.run([np.asarray(x.data)])[0])
+    np.testing.assert_allclose(out, got, rtol=2e-3, atol=1e-3)
+
+
+def test_int8_static_activation_matmul_path():
+    """With a calibrated activation scale the linear runs the int8 x int8
+    -> int32 dot (static path), and still tracks the float result."""
+    from paddle_tpu.quantization import QuantConfig, Int8Linear
+
+    paddle.seed(51)
+    lin = nn.Linear(8, 4)
+    r = np.random.RandomState(51)
+    x = paddle.to_tensor(r.randn(4, 8).astype(np.float32))
+    ref = lin(x).numpy()
+    i8 = Int8Linear(lin, act_scale=float(np.abs(x.numpy()).max()))
+    assert i8._static_act
+    got = i8(x).numpy()
+    assert np.abs(got - ref).max() < 0.1 * np.abs(ref).max() + 0.05
+    # weight-only dynamic path too
+    i8d = Int8Linear(lin, act_scale=0.0)
+    assert not i8d._static_act
+    got_d = i8d(x).numpy()
+    assert np.abs(got_d - ref).max() < 0.05 * np.abs(ref).max() + 0.02
