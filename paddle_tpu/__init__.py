@@ -55,6 +55,7 @@ from . import amp  # noqa: E402
 from . import io  # noqa: E402
 from . import metric  # noqa: E402
 from . import distribution  # noqa: E402
+from . import onnx  # noqa: E402
 from . import vision  # noqa: E402
 from . import text  # noqa: E402
 from . import hapi  # noqa: E402

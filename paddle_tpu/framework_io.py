@@ -56,31 +56,49 @@ def _unflatten(spec, arrays, to_tensor_cls):
     return spec
 
 
-def save(obj: Any, path: str, protocol: int = 4, **configs):
-    """paddle.save parity: state_dicts, nested dict/list of tensors, scalars."""
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
+def save(obj: Any, path: str, protocol: int = 4, encryption_key=None,
+         **configs):
+    """paddle.save parity: state_dicts, nested dict/list of tensors,
+    scalars.  ``path`` may carry a registered filesystem scheme
+    (``hdfs://...`` — utils/fs.py, reference framework/io/fs.cc);
+    ``encryption_key`` encrypts the artifact at rest (AES-256-GCM,
+    reference framework/io/crypto)."""
     arrays: dict = {}
     skeleton = _flatten(obj, "r", arrays, None)
     buf = _io.BytesIO()
     np.savez(buf, **{k: v for k, v in arrays.items()})
-    with open(path, "wb") as f:
-        f.write(_MAGIC)
-        sk = pickle.dumps(skeleton, protocol=protocol)
-        f.write(len(sk).to_bytes(8, "little"))
-        f.write(sk)
-        f.write(buf.getvalue())
+    out = _io.BytesIO()
+    out.write(_MAGIC)
+    sk = pickle.dumps(skeleton, protocol=protocol)
+    out.write(len(sk).to_bytes(8, "little"))
+    out.write(sk)
+    out.write(buf.getvalue())
+    payload = out.getvalue()
+    if encryption_key is not None:
+        from .utils import crypto
+        payload = crypto.encrypt(payload, encryption_key)
+    from .utils import fs as _fs
+    with _fs.open_write(path) as f:
+        f.write(payload)
 
 
-def load(path: str, **configs) -> Any:
-    with open(path, "rb") as f:
-        magic = f.read(8)
-        if magic != _MAGIC:
-            # fall back: plain pickle (reference-compatible style)
-            f.seek(0)
-            return pickle.load(f)
-        n = int.from_bytes(f.read(8), "little")
-        skeleton = pickle.loads(f.read(n))
-        arrays = dict(np.load(_io.BytesIO(f.read()), allow_pickle=False))
+def load(path: str, encryption_key=None, **configs) -> Any:
+    from .utils import fs as _fs
+    with _fs.open_read(path) as f:
+        payload = f.read()
+    from .utils import crypto
+    if crypto.is_encrypted(payload[:8]):
+        if encryption_key is None:
+            raise ValueError(
+                f"'{path}' is encrypted — pass encryption_key= to load")
+        payload = crypto.decrypt(payload, encryption_key)
+    f = _io.BytesIO(payload)
+    magic = f.read(8)
+    if magic != _MAGIC:
+        # fall back: plain pickle (reference-compatible style)
+        f.seek(0)
+        return pickle.load(f)
+    n = int.from_bytes(f.read(8), "little")
+    skeleton = pickle.loads(f.read(n))
+    arrays = dict(np.load(_io.BytesIO(f.read()), allow_pickle=False))
     return _unflatten(skeleton, arrays, Tensor)

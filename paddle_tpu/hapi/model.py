@@ -28,13 +28,21 @@ class Model:
     # -- setup -------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None, jit_compile=True):
+        """reference: hapi/model.py:1640 — `jit_compile` is the adapter
+        switch (the reference's dygraph/static duality :263/:642):
+        True compiles one fused TrainStep; False runs the eager tape.
+        ``loss`` may return a list/tuple of losses (multi-task heads);
+        they are summed for the update and reported summed."""
         self._optimizer = optimizer
-        self._loss = loss
+        self._loss = _wrap_loss(loss) if loss is not None else None
         metrics = metrics or []
         self._metrics = metrics if isinstance(metrics, list) else [metrics]
         self._jit = jit_compile
         if optimizer is not None and loss is not None and jit_compile:
-            self._train_step = TrainStep(self.network, loss, optimizer)
+            n_in = (len(self._inputs)
+                    if isinstance(self._inputs, (list, tuple)) else 1)
+            self._train_step = TrainStep(self.network, self._loss,
+                                         optimizer, n_inputs=n_in)
 
     # -- data plumbing -----------------------------------------------------
     def _to_loader(self, data, batch_size, shuffle, num_workers=0):
@@ -45,9 +53,14 @@ class Model:
                               num_workers=num_workers)
         return data  # assume iterable of batches
 
-    @staticmethod
-    def _split_batch(batch):
+    def _split_batch(self, batch):
+        """Split a loader batch into (inputs, labels): by the declared
+        ``inputs=``/``labels=`` specs when given (multi-input models,
+        hapi/model.py _update_inputs), else input*, label."""
         if isinstance(batch, (list, tuple)):
+            if isinstance(self._inputs, (list, tuple)):
+                k = len(self._inputs)
+                return batch[:k], batch[k:]
             if len(batch) >= 2:
                 return batch[:-1], batch[-1:]
             return batch, ()
@@ -208,3 +221,18 @@ class Model:
 
 def _t(x):
     return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _wrap_loss(loss):
+    """Multi-loss support: a loss returning a list/tuple is summed
+    (reference: hapi/model.py _run_one_epoch sums loss lists)."""
+    def fn(out, *labels):
+        val = loss(out, *labels)
+        if isinstance(val, (list, tuple)):
+            total = val[0]
+            for v in val[1:]:
+                total = total + v
+            return total
+        return val
+    fn.__name__ = getattr(loss, "__name__", "loss")
+    return fn

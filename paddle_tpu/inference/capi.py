@@ -1,0 +1,126 @@
+"""Build + load the C-ABI predictor shim (csrc/predictor_capi.cpp).
+
+Reference: fluid/inference/capi/paddle_c_api.h + go/paddle/predictor.go —
+a C surface any language with FFI (Go, Rust, C#) can bind.  Here the shim
+embeds CPython and drives the Python Predictor (the XLA AOT executable);
+this module compiles it on demand and exposes a ctypes harness that both
+tests it and documents the calling convention external programs use.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import List, Sequence
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(__file__), "csrc")
+_LOCK = threading.Lock()
+_LIB = None
+_LIB_TRIED = False
+
+
+class PT_Output(ctypes.Structure):
+    _fields_ = [("data", ctypes.POINTER(ctypes.c_float)),
+                ("shape", ctypes.POINTER(ctypes.c_int64)),
+                ("ndim", ctypes.c_int32),
+                ("numel", ctypes.c_int64)]
+
+
+def load_capi():
+    """Compile (once) and dlopen the C ABI; raises on failure (the C
+    surface is an explicit product feature, not a soft fallback)."""
+    global _LIB, _LIB_TRIED
+    with _LOCK:
+        if _LIB_TRIED:
+            if _LIB is None:
+                raise RuntimeError("paddle_tpu C ABI failed to build "
+                                   "earlier in this process")
+            return _LIB
+        _LIB_TRIED = True
+        src = os.path.join(_CSRC, "predictor_capi.cpp")
+        so = os.path.join(_CSRC, "libpaddle_tpu_capi.so")
+        inc = sysconfig.get_path("include")
+        ver = f"{os.sys.version_info.major}.{os.sys.version_info.minor}"
+        libdir = sysconfig.get_config_var("LIBDIR") or ""
+        if os.path.exists(src) and (
+                not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            tmp = so + ".tmp"
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   f"-I{inc}", src, "-o", tmp,
+                   f"-L{libdir}", f"-lpython{ver}"]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True)
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(
+                    f"C ABI build failed:\n{e.stderr.decode()[:800]}")
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so, mode=ctypes.RTLD_GLOBAL)
+        lib.PT_NewPredictor.restype = ctypes.c_void_p
+        lib.PT_NewPredictor.argtypes = [ctypes.c_char_p]
+        lib.PT_PredictorRun.restype = ctypes.c_int32
+        lib.PT_PredictorRun.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        lib.PT_GetOutput.restype = ctypes.c_int32
+        lib.PT_GetOutput.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                     ctypes.POINTER(PT_Output)]
+        lib.PT_FreeOutput.argtypes = [ctypes.POINTER(PT_Output)]
+        lib.PT_DeletePredictor.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+class CPredictor:
+    """ctypes harness over the C ABI (what predictor.go would be in Go)."""
+
+    def __init__(self, model_path_prefix: str):
+        self._lib = load_capi()
+        self._h = self._lib.PT_NewPredictor(
+            model_path_prefix.encode("utf-8"))
+        if not self._h:
+            raise RuntimeError(
+                f"PT_NewPredictor failed for '{model_path_prefix}'")
+
+    def run(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        lib = self._lib
+        arrs = [np.ascontiguousarray(a, np.float32) for a in inputs]
+        n = len(arrs)
+        bufs = (ctypes.POINTER(ctypes.c_float) * n)(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrs])
+        shapes_store = [(ctypes.c_int64 * a.ndim)(*a.shape) for a in arrs]
+        shapes = (ctypes.POINTER(ctypes.c_int64) * n)(
+            *[ctypes.cast(s, ctypes.POINTER(ctypes.c_int64))
+              for s in shapes_store])
+        ndims = (ctypes.c_int32 * n)(*[a.ndim for a in arrs])
+        n_out = lib.PT_PredictorRun(self._h, bufs, shapes, ndims, n)
+        if n_out < 0:
+            raise RuntimeError("PT_PredictorRun failed")
+        outs = []
+        for i in range(n_out):
+            o = PT_Output()
+            if lib.PT_GetOutput(self._h, i, ctypes.byref(o)) != 0:
+                raise RuntimeError(f"PT_GetOutput({i}) failed")
+            shape = tuple(o.shape[d] for d in range(o.ndim))
+            arr = np.ctypeslib.as_array(o.data, shape=(o.numel,)).copy()
+            outs.append(arr.reshape(shape))
+            lib.PT_FreeOutput(ctypes.byref(o))
+        return outs
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.PT_DeletePredictor(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -277,13 +277,17 @@ class MultiprocessIterator:
     def __next__(self):
         if self.next_emit >= self.total:
             raise StopIteration
+        waited = 0.0
         while self.next_emit not in self.pending:
             try:
                 tag, i, name, spec, metas, err = self.pool.result_q.get(
-                    timeout=self.timeout)
+                    timeout=min(self.timeout, 15))
             except queue_mod.Empty:
                 dead = [w for w, p in enumerate(self.pool.workers)
                         if not p.is_alive()]
+                waited += min(self.timeout, 15)
+                if not dead and waited < self.timeout:
+                    continue          # alive but slow (loaded machine)
                 self.pool.close()
                 self.loader._mp_pool = None
                 raise RuntimeError(

@@ -1,0 +1,330 @@
+"""paddle.onnx.export — interop export to ONNX.
+
+Reference: python/paddle/onnx/export.py (paddle.onnx.export -> paddle2onnx
+over the inference program).  TPU-native path: the layer is traced to a
+jaxpr (the same trace jit.save uses) and a documented primitive subset is
+mapped 1:1 onto ONNX ops; everything else raises loudly with the
+offending primitive named.  The protobuf bytes are hand-encoded
+(onnx/_proto.py) because no onnx package exists in this environment;
+``protoc --decode`` verifies schema conformance in the tests.
+
+Supported primitives (the MLP/CNN serving surface): dot_general (2-D) →
+MatMul/Gemm, conv_general_dilated (NCHW) → Conv, add/sub/mul/div/max/min
+→ elementwise, neg → Neg, tanh → Tanh, logistic → Sigmoid, exp → Exp,
+log → Log, rsqrt/sqrt → Sqrt(+Reciprocal), integer_pow → Pow, reshape →
+Reshape, transpose → Transpose, broadcast_in_dim → Reshape+Expand,
+squeeze → Reshape, reduce_sum/max/min → ReduceSum/Max/Min,
+reduce_window (max/avg pattern) → MaxPool/AveragePool, select_n → Where,
+convert_element_type → Cast, stop_gradient/copy → Identity.  Nested
+call-like primitives (pjit, custom_jvp/vjp, remat, closed_call) are
+inlined.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd, rng
+from ..core.tensor import Tensor
+from ..jit.bind import bind, buffer_arrays, param_list
+from . import _proto as P
+
+__all__ = ["export"]
+
+_ELEMWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "tanh": "Tanh", "logistic": "Sigmoid",
+    "exp": "Exp", "log": "Log", "neg": "Neg", "sqrt": "Sqrt",
+    "sign": "Sign", "abs": "Abs", "floor": "Floor", "ceil": "Ceil",
+    "erf": "Erf",
+}
+
+_REDUCE = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+           "reduce_min": "ReduceMin"}
+
+_CALL_PRIMS = {"pjit", "jit", "closed_call", "custom_jvp_call",
+               "custom_vjp_call", "custom_jvp_call_jaxpr", "remat2",
+               "checkpoint", "custom_vjp_call_jaxpr"}
+
+
+class _Converter:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.names: Dict[int, str] = {}     # id(var) -> onnx name
+        self.counter = 0
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def name_of(self, var, jaxpr_consts):
+        from jax.extend.core import Literal
+        if isinstance(var, Literal):
+            return self.add_const(np.asarray(var.val))
+        return self.names[id(var)]
+
+    def add_const(self, arr: np.ndarray, hint="const"):
+        nm = self.fresh(hint)
+        self.initializers.append(P.tensor_proto(nm, arr))
+        return nm
+
+    def emit(self, op, ins, n_out=1, attrs=(), hint=None):
+        outs = [self.fresh(hint or op.lower()) for _ in range(n_out)]
+        self.nodes.append(P.node(op, ins, outs, name=outs[0] + "_node",
+                                 attrs=list(attrs)))
+        return outs
+
+    # -- the conversion ----------------------------------------------------
+    def convert(self, jaxpr, consts, in_names):
+        for v, nm in zip(jaxpr.invars, in_names):
+            self.names[id(v)] = nm
+        for v, c in zip(jaxpr.constvars, consts):
+            self.names[id(v)] = self.add_const(np.asarray(c), "param")
+        for eqn in jaxpr.eqns:
+            self._eqn(eqn)
+        return [self.name_of(v, None) for v in jaxpr.outvars]
+
+    def _inline(self, inner_jaxpr, inner_consts, eqn):
+        in_names = [self.name_of(v, None) for v in eqn.invars]
+        sub_out = _Converter.convert_into(self, inner_jaxpr, inner_consts,
+                                          in_names)
+        for v, nm in zip(eqn.outvars, sub_out):
+            self.names[id(v)] = nm
+
+    @staticmethod
+    def convert_into(conv, jaxpr, consts, in_names):
+        saved = dict(conv.names)
+        out = conv.convert(jaxpr, consts, in_names)
+        # keep outer names intact for vars outside the sub-jaxpr
+        conv.names.update(saved)
+        return out
+
+    def _eqn(self, eqn):
+        prim = eqn.primitive.name
+        ins = [self.name_of(v, None) for v in eqn.invars]
+
+        def setout(names):
+            for v, nm in zip(eqn.outvars, names):
+                self.names[id(v)] = nm
+
+        if prim in _CALL_PRIMS:
+            params = eqn.params
+            inner = (params.get("jaxpr") or params.get("call_jaxpr")
+                     or params.get("fun_jaxpr"))
+            if inner is None:
+                raise NotImplementedError(
+                    f"ONNX export: call primitive '{prim}' with no "
+                    f"inlineable jaxpr")
+            closed = inner if hasattr(inner, "jaxpr") else None
+            jx = closed.jaxpr if closed is not None else inner
+            consts = closed.consts if closed is not None else []
+            sub = _Converter.convert_into(self, jx, consts, ins)
+            setout(sub)
+            return
+        if prim in _ELEMWISE:
+            setout(self.emit(_ELEMWISE[prim], ins))
+            return
+        if prim == "rsqrt":
+            (s,) = self.emit("Sqrt", ins)
+            setout(self.emit("Reciprocal", [s]))
+            return
+        if prim == "integer_pow":
+            e = self.add_const(np.asarray(float(eqn.params["y"]),
+                                          np.float32))
+            setout(self.emit("Pow", [ins[0], e]))
+            return
+        if prim in ("stop_gradient", "copy"):
+            setout(self.emit("Identity", ins))
+            return
+        if prim == "convert_element_type":
+            to = P._NP2ONNX.get(np.dtype(eqn.params["new_dtype"]))
+            if to is None:
+                raise NotImplementedError(
+                    f"ONNX export: cast to {eqn.params['new_dtype']}")
+            setout(self.emit("Cast", ins, attrs=[P.attr_int("to", to)]))
+            return
+        if prim == "reshape":
+            shp = self.add_const(
+                np.asarray(eqn.outvars[0].aval.shape, np.int64), "shape")
+            setout(self.emit("Reshape", [ins[0], shp]))
+            return
+        if prim == "squeeze":
+            shp = self.add_const(
+                np.asarray(eqn.outvars[0].aval.shape, np.int64), "shape")
+            setout(self.emit("Reshape", [ins[0], shp]))
+            return
+        if prim == "transpose":
+            perm = list(eqn.params["permutation"])
+            setout(self.emit("Transpose", ins,
+                             attrs=[P.attr_ints("perm", perm)]))
+            return
+        if prim == "broadcast_in_dim":
+            out_shape = list(eqn.outvars[0].aval.shape)
+            bdims = list(eqn.params["broadcast_dimensions"])
+            mid = [1] * len(out_shape)
+            for src, dst in enumerate(bdims):
+                mid[dst] = eqn.invars[0].aval.shape[src]
+            shp = self.add_const(np.asarray(mid, np.int64), "shape")
+            (r,) = self.emit("Reshape", [ins[0], shp])
+            tgt = self.add_const(np.asarray(out_shape, np.int64), "shape")
+            setout(self.emit("Expand", [r, tgt]))
+            return
+        if prim in _REDUCE:
+            axes = list(eqn.params["axes"])
+            if prim == "reduce_sum":
+                # opset 13 moved ReduceSum's axes from attribute to a
+                # second INPUT (ReduceMax/Min move only at opset 18)
+                ax = self.add_const(np.asarray(axes, np.int64), "axes")
+                setout(self.emit("ReduceSum", [ins[0], ax],
+                                 attrs=[P.attr_int("keepdims", 0)]))
+                return
+            setout(self.emit(
+                _REDUCE[prim], ins,
+                attrs=[P.attr_ints("axes", axes),
+                       P.attr_int("keepdims", 0)]))
+            return
+        if prim == "dot_general":
+            ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+            la = eqn.invars[0].aval
+            ra = eqn.invars[1].aval
+            if (not lb and not rb and la.ndim == 2 and ra.ndim == 2
+                    and lc == (1,) and rc == (0,)):
+                setout(self.emit("MatMul", ins))
+                return
+            raise NotImplementedError(
+                f"ONNX export: dot_general with dims "
+                f"{eqn.params['dimension_numbers']} (only plain 2-D "
+                f"matmul is mapped; reshape batched dims first)")
+        if prim == "conv_general_dilated":
+            dn = eqn.params["dimension_numbers"]
+            if (dn.lhs_spec != tuple(range(len(dn.lhs_spec)))
+                    or dn.rhs_spec != tuple(range(len(dn.rhs_spec)))):
+                raise NotImplementedError(
+                    "ONNX export: conv supports NCHW/OIHW layouts only")
+            pads = eqn.params["padding"]
+            attrs = [
+                P.attr_ints("strides",
+                            list(eqn.params["window_strides"])),
+                P.attr_ints("dilations",
+                            list(eqn.params.get("rhs_dilation")
+                                 or [1] * len(pads))),
+                P.attr_ints("pads", [p[0] for p in pads]
+                            + [p[1] for p in pads]),
+                P.attr_int("group",
+                           int(eqn.params.get("feature_group_count", 1))),
+            ]
+            setout(self.emit("Conv", ins, attrs=attrs))
+            return
+        if prim == "reduce_window_max":
+            setout(self.emit("MaxPool", [ins[0]],
+                             attrs=self._pool_attrs(eqn)))
+            return
+        if prim == "reduce_window_sum":
+            # avg pool appears as window-sum / window-size; emit the sum
+            # as AveragePool * window_size so the following div folds.
+            # count_include_pad=1: padded zeros must count, or the
+            # product differs from the true window sum at padded edges
+            attrs = self._pool_attrs(eqn) + [
+                P.attr_int("count_include_pad", 1)]
+            (ap,) = self.emit("AveragePool", [ins[0]], attrs=attrs)
+            wd = eqn.params["window_dimensions"]
+            scale = float(np.prod(wd))
+            sc = self.add_const(np.asarray(scale, np.float32))
+            setout(self.emit("Mul", [ap, sc]))
+            return
+        if prim == "select_n":
+            if len(ins) != 3:
+                raise NotImplementedError(
+                    "ONNX export: select_n with more than 2 cases")
+            # lax.select_n(pred, on_false, on_true) -> Where(pred, true, false)
+            setout(self.emit("Where", [ins[0], ins[2], ins[1]]))
+            return
+        if prim in ("pow",):
+            setout(self.emit("Pow", ins))
+            return
+        raise NotImplementedError(
+            f"ONNX export: primitive '{prim}' is outside the supported "
+            f"subset (see paddle_tpu.onnx docstring); simplify the model "
+            f"or extend the mapping")
+
+    def _pool_attrs(self, eqn):
+        wd = list(eqn.params["window_dimensions"])
+        ws = list(eqn.params["window_strides"])
+        pads = list(eqn.params["padding"])
+        if wd[0] != 1 or wd[1] != 1:
+            raise NotImplementedError(
+                "ONNX export: pooling over batch/channel dims")
+        spatial = len(wd) - 2
+        return [
+            P.attr_ints("kernel_shape", wd[2:]),
+            P.attr_ints("strides", ws[2:]),
+            P.attr_ints("pads", [p[0] for p in pads[2:]]
+                        + [p[1] for p in pads[2:]]),
+        ]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 13,
+           **configs) -> str:
+    """Export ``layer`` to ``<path>.onnx`` (reference: paddle.onnx.export).
+
+    ``input_spec``: list of InputSpec/arrays fixing input shapes.  The
+    exported graph is SHAPE-SPECIALIZED: a ``None`` dim traces (and is
+    recorded) as 1 — re-export per serving batch size, exactly like the
+    AOT shape buckets the Predictor compiles.  Symbolic batch dims are
+    not emitted (the traced constants, e.g. Reshape targets, would still
+    pin them)."""
+    from ..jit.static_function import InputSpec
+
+    specs = []
+    for s in (input_spec or []):
+        if isinstance(s, InputSpec):
+            shape = [1 if d is None else int(d) for d in s.shape]
+            specs.append(jax.ShapeDtypeStruct(tuple(shape),
+                                              jnp.dtype(s.dtype)))
+        else:
+            a = s.data if isinstance(s, Tensor) else jnp.asarray(s)
+            specs.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+    if not specs:
+        raise ValueError("paddle.onnx.export needs input_spec")
+
+    params = [p.data for p in param_list(layer)]
+    bufs = buffer_arrays(layer)
+    layer.eval()
+    key = jax.random.key(0)   # outside the trace: unused in eval mode,
+    # so no RNG primitives land in the jaxpr
+
+    def fwd(*xs):
+        with autograd.no_grad(), rng.seed_scope(key):
+            with bind(layer, list(params), list(bufs)):
+                out = layer(*[Tensor(x) for x in xs])
+        return jax.tree.map(
+            lambda t: t.data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    closed = jax.make_jaxpr(fwd)(*specs)
+    conv = _Converter()
+    in_names = [f"input_{i}" for i in range(len(specs))]
+    out_names = conv.convert(closed.jaxpr, closed.consts, in_names)
+
+    g_inputs = [
+        P.value_info(nm, P._NP2ONNX[np.dtype(s.dtype)], list(s.shape))
+        for nm, s in zip(in_names, specs)]
+    out_avals = [v.aval for v in closed.jaxpr.outvars]
+    g_outputs = [
+        P.value_info(nm, P._NP2ONNX[np.dtype(a.dtype)], list(a.shape))
+        for nm, a in zip(out_names, out_avals)]
+    gb = P.graph(conv.nodes, getattr(layer, "__class__").__name__,
+                 conv.initializers, g_inputs, g_outputs)
+    mb = P.model(gb, opset=opset_version)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    d = os.path.dirname(out_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(out_path, "wb") as f:
+        f.write(mb)
+    return out_path

@@ -8,6 +8,6 @@
 - :mod:`checkpoint` — auto-checkpointed epoch ranges (reference:
   incubate/checkpoint/auto_checkpoint.py train_epoch_range).
 """
-from . import checkpoint, custom_op, monitor, op_version  # noqa: F401
+from . import checkpoint, crypto, custom_op, fs, monitor, op_version  # noqa: F401
 from .checkpoint import train_epoch_range  # noqa: F401
 from .custom_op import register_custom_op  # noqa: F401

@@ -8,7 +8,9 @@ TPU-native: state is whatever exposes ``state_dict``/``set_state_dict``
 (Layers, optimizers, GradScalers, LR schedules); snapshots go through
 ``paddle_tpu.save`` (npz pytrees) plus a small json meta, written
 atomically (tmp + rename) so a preemption mid-save can't corrupt the
-latest checkpoint.
+latest checkpoint.  ``checkpoint_dir`` may carry a registered filesystem
+scheme (``hdfs://...`` — utils/fs.py, reference framework/io/fs.cc), so
+fleet preemption recovery can land on a remote store.
 """
 from __future__ import annotations
 
@@ -16,6 +18,7 @@ import json
 import os
 from typing import Dict, Iterator, Optional
 
+from . import fs as _fsmod
 from ..framework_io import load as _load
 from ..framework_io import save as _save
 
@@ -38,7 +41,8 @@ class TrainEpochRange:
         self.dir = checkpoint_dir
         self.interval = max(1, int(save_checkpoint_inter))
         self._objects: Dict[str, object] = dict(objects)
-        os.makedirs(self.dir, exist_ok=True)
+        self._fs = _fsmod.get_fs(checkpoint_dir)
+        self._fs.mkdir(self.dir)
 
     def register(self, name: str, obj):
         """Add a state_dict-bearing object to the snapshot set."""
@@ -46,14 +50,17 @@ class TrainEpochRange:
         return self
 
     # -- persistence -------------------------------------------------------
+    def _join(self, *parts):
+        return "/".join([self.dir.rstrip("/")] + list(parts))
+
     def _meta_path(self):
-        return os.path.join(self.dir, "range_meta.json")
+        return self._join("range_meta.json")
 
     def _load_meta(self) -> Optional[dict]:
         try:
-            with open(self._meta_path()) as f:
-                return json.load(f)
-        except (OSError, ValueError):
+            with self._fs.open_read(self._meta_path()) as f:
+                return json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError, RuntimeError):
             return None
 
     def _save(self, epoch: int):
@@ -62,30 +69,32 @@ class TrainEpochRange:
         # either the previous complete snapshot or the new complete one —
         # never a mixed-epoch state
         snap = f"epoch_{epoch}"
-        sdir = os.path.join(self.dir, snap)
-        os.makedirs(sdir, exist_ok=True)
+        sdir = self._join(snap)
+        self._fs.mkdir(sdir)
         for name, obj in self._objects.items():
-            _save(obj.state_dict(), os.path.join(sdir, f"{name}.pdparams"))
+            _save(obj.state_dict(), f"{sdir}/{name}.pdparams")
         tmp = self._meta_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"finished_epoch": epoch, "snapshot": snap,
-                       "objects": sorted(self._objects)}, f)
-        os.replace(tmp, self._meta_path())  # atomic publish
+        with self._fs.open_write(tmp) as f:
+            f.write(json.dumps(
+                {"finished_epoch": epoch, "snapshot": snap,
+                 "objects": sorted(self._objects)}).encode("utf-8"))
+        self._fs.mv(tmp, self._meta_path())  # atomic publish
         # prune superseded snapshots
-        import shutil
-        for d in os.listdir(self.dir):
+        for d in self._fs.list(self.dir):
             if d.startswith("epoch_") and d != snap:
-                shutil.rmtree(os.path.join(self.dir, d),
-                              ignore_errors=True)
+                try:
+                    self._fs.remove(self._join(d))
+                except (RuntimeError, OSError):
+                    pass  # prune is best-effort (shared dirs, perms)
 
     def _restore(self) -> int:
         meta = self._load_meta()
         if meta is None:
             return 0
-        sdir = os.path.join(self.dir, meta.get("snapshot", ""))
+        sdir = self._join(meta.get("snapshot", ""))
         for name, obj in self._objects.items():
-            path = os.path.join(sdir, f"{name}.pdparams")
-            if os.path.exists(path):
+            path = f"{sdir}/{name}.pdparams"
+            if self._fs.exists(path):
                 obj.set_state_dict(_load(path))
         return int(meta.get("finished_epoch", -1)) + 1
 

@@ -108,3 +108,97 @@ class Cifar10(Dataset):
 
 class Cifar100(Cifar10):
     NUM_CLASSES = 100
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subdirectory dataset (reference:
+    vision/datasets/folder.py DatasetFolder): ``root/<class>/<file>``
+    layouts, with classes sorted alphabetically into label ids.
+
+    Supports ``.npy`` arrays natively and standard image files via PIL
+    when installed (the reference uses cv2/PIL loaders)."""
+
+    IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(e.lower() for e in (extensions or
+                                         (".npy",) + self.IMG_EXTS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(
+                f"DatasetFolder: no class subdirectories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    path = os.path.join(dirpath, fn)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fn.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(
+                f"DatasetFolder: no files with extensions {exts} under "
+                f"{root}")
+
+    @staticmethod
+    def _default_loader(path):
+        if path.lower().endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+        except ImportError as e:
+            raise RuntimeError(
+                f"loading {path} needs PIL (or pass loader=)") from e
+        with Image.open(path) as im:
+            return np.asarray(im.convert("RGB"))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """reference: folder.py ImageFolder — unlabeled flat/recursive image
+    tree; __getitem__ returns just the image."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(e.lower() for e in (extensions or
+                                         (".npy",) + self.IMG_EXTS))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(exts))
+                if ok:
+                    self.samples.append((path, -1))
+        if not self.samples:
+            raise ValueError(f"ImageFolder: no images under {root}")
+
+    def __getitem__(self, idx):
+        path, _ = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img

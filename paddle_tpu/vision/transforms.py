@@ -152,7 +152,10 @@ class BrightnessTransform(BaseTransform):
 
     def _apply_image(self, img):
         alpha = 1 + np.random.uniform(-self.value, self.value)
-        return np.clip(img * alpha, 0, 1).astype(np.float32)
+        out = img.astype(np.float32) * alpha
+        if np.issubdtype(np.asarray(img).dtype, np.integer):
+            return np.clip(out, 0, 255).astype(img.dtype)
+        return np.clip(out, 0, 1).astype(np.float32)
 
 
 class Pad(BaseTransform):
@@ -169,3 +172,201 @@ class Pad(BaseTransform):
                           constant_values=self.fill)
         return np.pad(img, ((t, b), (l, r)) + ((0, 0),) * (img.ndim - 2),
                       constant_values=self.fill)
+
+
+# -- round-4 breadth (reference: transforms/transforms.py full suite) -----
+
+class ContrastTransform(BaseTransform):
+    """reference: transforms.py ContrastTransform — blend with the mean."""
+
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        import random
+        f = 1.0 + random.uniform(-self.value, self.value)
+        x = img.astype(np.float32)
+        mean = x.mean()
+        out = mean + (x - mean) * f
+        return _like(out, img)
+
+
+class SaturationTransform(BaseTransform):
+    """Blend with the grayscale image (HWC or CHW, 3 channels)."""
+
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        import random
+        f = 1.0 + random.uniform(-self.value, self.value)
+        x = img.astype(np.float32)
+        gray = _to_gray(x)
+        out = gray + (x - gray) * f
+        return _like(out, img)
+
+
+class HueTransform(BaseTransform):
+    """Channel-roll hue approximation in RGB space (value in [0, 0.5])."""
+
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        import random
+        f = random.uniform(-self.value, self.value)
+        x = img.astype(np.float32)
+        ch_axis = 0 if x.shape[0] in (1, 3) else -1
+        if x.shape[ch_axis] != 3:
+            return img
+        other = x.sum(axis=ch_axis, keepdims=True) - x
+        out = x + f * (other / 2.0 - x)
+        return _like(out, img)
+
+
+class ColorJitter(BaseTransform):
+    """reference: transforms.py ColorJitter — random order of
+    brightness/contrast/saturation/hue."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        import random
+        order = list(self.ts)
+        random.shuffle(order)
+        for t in order:
+            img = t._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        x = img.astype(np.float32)
+        gray = _to_gray(x)
+        ch_axis = 0 if x.shape[0] in (1, 3) else -1
+        take = [0] * self.n
+        out = np.take(gray, take, axis=ch_axis)
+        return _like(out, img)
+
+
+class RandomResizedCrop(BaseTransform):
+    """reference: transforms.py RandomResizedCrop (scale/ratio sampling,
+    resize to target)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        import random
+        chw = img.ndim == 3 and img.shape[0] in (1, 3)
+        h, w = (img.shape[1:], img.shape[:2])[0 if chw else 1]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            cw = int(round((target * ar) ** 0.5))
+            ch = int(round((target / ar) ** 0.5))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                if chw:
+                    crop = img[:, top:top + ch, left:left + cw]
+                else:
+                    crop = img[top:top + ch, left:left + cw]
+                return Resize(self.size)._apply_image(crop)
+        return Resize(self.size)._apply_image(img)
+
+
+class RandomRotation(BaseTransform):
+    """Nearest-neighbour rotation about the center (reference
+    RandomRotation without the PIL resample modes)."""
+
+    def __init__(self, degrees):
+        self.degrees = ((-degrees, degrees)
+                        if isinstance(degrees, numbers.Number)
+                        else tuple(degrees))
+
+    def _apply_image(self, img):
+        import random
+        ang = np.deg2rad(random.uniform(*self.degrees))
+        chw = img.ndim == 3 and img.shape[0] in (1, 3)
+        x = img if chw else (np.moveaxis(img, -1, 0)
+                             if img.ndim == 3 else img[None])
+        C, H, W = x.shape
+        cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+        yy, xx = np.mgrid[0:H, 0:W]
+        ys = cy + (yy - cy) * np.cos(ang) - (xx - cx) * np.sin(ang)
+        xs = cx + (yy - cy) * np.sin(ang) + (xx - cx) * np.cos(ang)
+        yi = np.clip(np.round(ys).astype(int), 0, H - 1)
+        xi = np.clip(np.round(xs).astype(int), 0, W - 1)
+        valid = (ys >= 0) & (ys <= H - 1) & (xs >= 0) & (xs <= W - 1)
+        out = x[:, yi, xi] * valid[None]
+        out = out.astype(img.dtype)
+        if chw:
+            return out
+        return np.moveaxis(out, 0, -1) if img.ndim == 3 else out[0]
+
+
+class RandomErasing(BaseTransform):
+    """reference: transforms.py RandomErasing — cutout regularizer."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        import random
+        if random.random() > self.prob:
+            return img
+        chw = img.ndim == 3 and img.shape[0] in (1, 3)
+        h, w = (img.shape[1:] if chw else img.shape[:2])
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            eh = int(round((target / ar) ** 0.5))
+            ew = int(round((target * ar) ** 0.5))
+            if eh < h and ew < w:
+                top = random.randint(0, h - eh)
+                left = random.randint(0, w - ew)
+                out = img.copy()
+                if chw:
+                    out[:, top:top + eh, left:left + ew] = self.value
+                else:
+                    out[top:top + eh, left:left + ew] = self.value
+                return out
+        return img
+
+
+def _to_gray(x):
+    ch_axis = 0 if x.shape[0] in (1, 3) else -1
+    if x.shape[ch_axis] == 1:
+        return x
+    wts = np.asarray([0.299, 0.587, 0.114], np.float32)
+    shape = [1, 1, 1]
+    shape[ch_axis] = 3
+    g = (x * wts.reshape(shape)).sum(axis=ch_axis, keepdims=True)
+    return np.repeat(g, 3, axis=ch_axis)
+
+
+def _like(out, img):
+    if np.issubdtype(img.dtype, np.integer):
+        return np.clip(out, 0, 255).astype(img.dtype)
+    return out.astype(img.dtype)

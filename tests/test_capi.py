@@ -1,0 +1,123 @@
+"""C-ABI inference binding (VERDICT r4 missing #10).
+
+Reference: fluid/inference/capi/paddle_c_api.h + go/paddle/predictor.go.
+Two layers of proof: the ctypes harness (in-process, shared interpreter)
+and a genuinely external C program that embeds Python itself.
+"""
+import os
+import subprocess
+import sys
+import sysconfig
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+from paddle_tpu.jit import InputSpec
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    paddle.seed(91)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    pfx = str(tmp_path_factory.mktemp("capi") / "m")
+    jit.save(net, pfx, input_spec=[InputSpec([None, 8], "float32")])
+    x = np.random.RandomState(1).randn(3, 8).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    return pfx, x, ref
+
+
+def test_capi_ctypes_roundtrip(saved_model):
+    from paddle_tpu.inference.capi import CPredictor
+    pfx, x, ref = saved_model
+    p = CPredictor(pfx)
+    out = p.run([x])
+    assert len(out) == 1
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-6)
+    # second run (cached executable path)
+    out2 = p.run([x * 2])
+    assert out2[0].shape == ref.shape
+    p.close()
+
+
+_C_MAIN = r"""
+#include <stdio.h>
+#include <stdint.h>
+
+typedef struct PT_Predictor PT_Predictor;
+typedef struct { float* data; int64_t* shape; int32_t ndim;
+                 int64_t numel; } PT_Output;
+extern PT_Predictor* PT_NewPredictor(const char*);
+extern int32_t PT_PredictorRun(PT_Predictor*, const float* const*,
+                               const int64_t* const*, const int32_t*,
+                               int32_t);
+extern int32_t PT_GetOutput(PT_Predictor*, int32_t, PT_Output*);
+extern void PT_FreeOutput(PT_Output*);
+extern void PT_DeletePredictor(PT_Predictor*);
+
+int main(int argc, char** argv) {
+  PT_Predictor* p = PT_NewPredictor(argv[1]);
+  if (!p) { printf("FAIL new\n"); return 1; }
+  float x[3 * 8];
+  for (int i = 0; i < 24; ++i) x[i] = (float)i * 0.1f;
+  const float* inputs[1] = {x};
+  int64_t shape[2] = {3, 8};
+  const int64_t* shapes[1] = {shape};
+  int32_t ndims[1] = {2};
+  int32_t n = PT_PredictorRun(p, inputs, shapes, ndims, 1);
+  if (n != 1) { printf("FAIL run %d\n", n); return 1; }
+  PT_Output out;
+  if (PT_GetOutput(p, 0, &out) != 0) { printf("FAIL out\n"); return 1; }
+  double sum = 0;
+  for (int64_t i = 0; i < out.numel; ++i) sum += out.data[i];
+  printf("OK shape=%lldx%lld sum=%.6f\n", (long long)out.shape[0],
+         (long long)out.shape[1], sum);
+  PT_FreeOutput(&out);
+  PT_DeletePredictor(p);
+  return 0;
+}
+"""
+
+
+def test_capi_from_external_c_program(saved_model):
+    """The real product claim: a plain C program (no Python in main)
+    drives the predictor through the shared library, like predictor.go."""
+    from paddle_tpu.inference.capi import load_capi, _CSRC
+    load_capi()                       # ensure the .so exists
+    pfx, x, ref = saved_model
+    so = os.path.join(_CSRC, "libpaddle_tpu_capi.so")
+    with tempfile.TemporaryDirectory() as td:
+        c = os.path.join(td, "main.c")
+        exe = os.path.join(td, "main")
+        with open(c, "w") as f:
+            f.write(_C_MAIN)
+        ver = f"{sys.version_info.major}.{sys.version_info.minor}"
+        libdir = sysconfig.get_config_var("LIBDIR") or ""
+        subprocess.run(
+            ["gcc", c, "-o", exe, so, f"-L{libdir}", f"-lpython{ver}",
+             f"-Wl,-rpath,{os.path.dirname(so)}", f"-Wl,-rpath,{libdir}"],
+            check=True, capture_output=True)
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        # don't leak the test harness's 8-device virtual mesh into the
+        # embedded interpreter (the artifact was compiled single-device)
+        env.pop("XLA_FLAGS", None)
+        r = subprocess.run([exe, pfx], capture_output=True, text=True,
+                           env=env, timeout=300)
+        assert r.returncode == 0, (r.stdout, r.stderr[-800:])
+        assert r.stdout.startswith("OK shape=3x4"), r.stdout
+        # checksum matches the in-process reference
+        xin = (np.arange(24, dtype=np.float32) * 0.1).reshape(3, 8)
+        expect = float(paddle.to_tensor(
+            np.asarray(_ref_model_out(pfx, xin))).numpy().sum())
+        got = float(r.stdout.strip().split("sum=")[1])
+        np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+
+def _ref_model_out(pfx, x):
+    loaded = paddle.jit.load(pfx)
+    return loaded(paddle.to_tensor(x)).numpy()

@@ -1,0 +1,153 @@
+"""Remote-fs checkpoint hooks + model crypto (VERDICT r4 missing #9).
+
+Reference: framework/io/fs.cc (localfs_*/hdfs_* shell CLI),
+framework/io/crypto (AES model encryption).
+"""
+import io
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.utils import crypto, fs
+
+
+class MemFS(fs.FileSystem):
+    """In-memory FileSystem standing in for a remote store."""
+
+    def __init__(self):
+        self.files = {}
+        self.dirs = set()
+
+    def open_read(self, path):
+        if path not in self.files:
+            raise OSError(f"no such file {path}")
+        return io.BytesIO(self.files[path])
+
+    def open_write(self, path):
+        files = self.files
+
+        class _B(io.BytesIO):
+            def close(s):
+                files[path] = s.getvalue()
+                super().close()
+
+        return _B()
+
+    def exists(self, path):
+        return path in self.files or path in self.dirs
+
+    def mkdir(self, path):
+        self.dirs.add(path)
+
+    def remove(self, path):
+        self.files = {k: v for k, v in self.files.items()
+                      if not k.startswith(path)}
+        self.dirs.discard(path)
+
+    def list(self, path):
+        out = set()
+        for k in set(self.files) | self.dirs:
+            if k.startswith(path.rstrip("/") + "/"):
+                out.add(k[len(path.rstrip("/")) + 1:].split("/")[0])
+        return sorted(out)
+
+    def mv(self, src, dst):
+        self.files[dst] = self.files.pop(src)
+
+
+@pytest.fixture()
+def memfs():
+    m = MemFS()
+    fs.register_fs("mem", m)
+    yield m
+    fs._REGISTRY.pop("mem", None)
+
+
+def test_save_load_through_registered_fs(memfs):
+    net = nn.Linear(4, 3)
+    sd = net.state_dict()
+    paddle.save(sd, "mem://ckpt/model.pdparams")
+    assert "mem://ckpt/model.pdparams" in memfs.files
+    loaded = paddle.load("mem://ckpt/model.pdparams")
+    np.testing.assert_allclose(np.asarray(loaded["weight"].data),
+                               np.asarray(sd["weight"].data))
+
+
+def test_train_epoch_range_on_remote_fs(memfs):
+    """Preemption recovery against a remote store: snapshot, 'crash',
+    resume from the published epoch (auto_checkpoint.py semantics)."""
+    from paddle_tpu.utils.checkpoint import TrainEpochRange
+
+    paddle.seed(80)
+    net = nn.Linear(2, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    seen = []
+    w_published = None
+    r = TrainEpochRange(5, "mem://jobs/run1", model=net, opt=opt)
+    for epoch in r:
+        seen.append(epoch)
+        net.weight.data = net.weight.data + 1.0
+        if epoch == 1:
+            w_published = np.asarray(net.weight.data).copy()
+        if epoch == 2:
+            # simulated preemption DURING epoch 2 — its snapshot never
+            # publishes, so the resume point is after epoch 1
+            break
+
+    # new process: fresh objects resume from the last published snapshot
+    paddle.seed(81)
+    net2 = nn.Linear(2, 2)
+    opt2 = optimizer.SGD(learning_rate=0.1, parameters=net2.parameters())
+    r2 = TrainEpochRange(5, "mem://jobs/run1", model=net2, opt=opt2)
+    resumed = [e for e in r2]
+    assert resumed == [2, 3, 4]
+    np.testing.assert_allclose(np.asarray(net2.weight.data), w_published)
+
+
+def test_unregistered_scheme_is_loud():
+    with pytest.raises(ValueError, match="register_fs"):
+        fs.get_fs("s3://bucket/x")
+
+
+def test_shellfs_missing_cli_is_loud():
+    sf = fs.ShellFS("definitely_not_a_real_binary_xyz")
+    with pytest.raises(RuntimeError, match="CLI not found"):
+        sf.open_read("hdfs://x/y")
+
+
+def test_encrypted_save_load_roundtrip(tmp_path):
+    net = nn.Linear(3, 3)
+    sd = net.state_dict()
+    p = str(tmp_path / "enc.pdparams")
+    paddle.save(sd, p, encryption_key="secret-key")
+    raw = open(p, "rb").read()
+    assert crypto.is_encrypted(raw[:8])
+    # weights are not visible in the ciphertext
+    w = np.asarray(sd["weight"].data).tobytes()
+    assert w[:16] not in raw
+    loaded = paddle.load(p, encryption_key="secret-key")
+    np.testing.assert_allclose(np.asarray(loaded["weight"].data),
+                               np.asarray(sd["weight"].data))
+
+
+def test_wrong_key_and_missing_key_are_loud(tmp_path):
+    p = str(tmp_path / "enc2.pdparams")
+    paddle.save({"a": paddle.ones([2])}, p, encryption_key="k1")
+    with pytest.raises(ValueError, match="encrypted"):
+        paddle.load(p)
+    with pytest.raises(ValueError, match="wrong key|corrupted"):
+        paddle.load(p, encryption_key="k2")
+
+
+def test_key_file_flow(tmp_path):
+    kf = str(tmp_path / "model.key")
+    key = crypto.generate_key_file(kf)
+    assert len(key) == 32
+    p = str(tmp_path / "enc3.pdparams")
+    paddle.save({"a": paddle.ones([4])}, p,
+                encryption_key=open(kf, "rb").read())
+    out = paddle.load(p, encryption_key=open(kf, "rb").read())
+    np.testing.assert_allclose(np.asarray(out["a"].data), 1.0)

