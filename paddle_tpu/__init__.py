@@ -95,3 +95,18 @@ def in_dynamic_mode():
 def is_grad_enabled():
     from .core import autograd
     return autograd.grad_enabled()
+
+
+# -- round-4 top-level parity (reference: paddle/__init__.py aliases) ----
+from .framework_compat import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa
+                               TPUPlace, XPUPlace, create_parameter,
+                               disable_dygraph, enable_dygraph, flops,
+                               get_cuda_rng_state, get_cudnn_version,
+                               in_dygraph_mode, set_cuda_rng_state,
+                               set_printoptions)
+from .hapi import callbacks  # noqa: E402,F401
+from .ops.linalg import cholesky, histogram, inverse  # noqa: E402,F401
+from .ops.manipulation import (crop_tensor, scatter_, shard_index,  # noqa
+                               slice, squeeze_, strided_slice, unsqueeze_)
+from .ops.math import (add_n, broadcast_shape, mv, rank, shape,  # noqa
+                       tanh_)

@@ -1,0 +1,178 @@
+"""Framework-level API-parity pieces.
+
+Reference surfaces re-homed for TPU: Place classes (fluid/framework.py —
+device handles users pass to executors/DataLoaders), dygraph mode toggles
+(fluid/framework.py enable_dygraph:
+this build is dygraph-first, static via paddle.enable_static), CUDA RNG
+state shims (the TPU analog is paddle.seed's key), printoptions, and
+paddle.flops (hapi/dynamic_flops.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class _Place:
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and \
+            self.device_id == other.device_id
+
+
+class CPUPlace(_Place):
+    pass
+
+
+class TPUPlace(_Place):
+    pass
+
+
+class CUDAPlace(_Place):
+    """Accepted for API compatibility: CUDA code ported to this framework
+    runs on the TPU (there is no CUDA runtime here); the Place carries
+    the device ordinal like the reference's."""
+
+
+class CUDAPinnedPlace(_Place):
+    """Maps to host ('pinned_host') memory placement on TPU."""
+
+
+class XPUPlace(_Place):
+    pass
+
+
+# -- dygraph mode (fluid/framework.py:enable_dygraph) ---------------------
+_dygraph = True
+
+
+def enable_dygraph(place=None):
+    global _dygraph
+    _dygraph = True
+
+
+def disable_dygraph():
+    global _dygraph
+    _dygraph = False
+
+
+def in_dygraph_mode() -> bool:
+    return _dygraph
+
+
+# -- RNG state shims (the reference's cuda Generator state) ---------------
+def get_cuda_rng_state():
+    """TPU analog: the global PRNG state (core/rng.py seed + counter)."""
+    from .core import rng
+    g = rng.default_generator()
+    return [np.asarray([g._seed, g._counter], np.int64)]
+
+
+def set_cuda_rng_state(state):
+    from .core import rng
+    g = rng.default_generator()
+    seed, counter = (int(v) for v in np.asarray(state[0]))
+    g.manual_seed(seed)
+    g._counter = counter
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU — None, like reference CPU builds."""
+    return None
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: tensor/to_string.py set_printoptions — Tensor repr goes
+    through numpy, so numpy's printoptions are the single knob."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Top-level paddle.create_parameter (fluid/layers/tensor.py:70)."""
+    import jax.numpy as jnp
+
+    from .core.tensor import Parameter
+    from .nn import initializer as I
+    init = default_initializer or (
+        I.Constant(0.0) if is_bias else I.XavierNormal())
+    data = jnp.zeros(shape, dtype=jnp.dtype(dtype))
+    p = Parameter(data, name=name)
+    init(p)
+    return p
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False) -> int:
+    """paddle.flops (reference: hapi/dynamic_flops.py): multiply-add count
+    of a forward pass, via forward hooks on conv/linear layers."""
+    from . import nn
+    from .core import autograd
+    from .core.tensor import Tensor
+
+    counts = {}
+    handles = []
+
+    def hook(name, kind):
+        def fn(layer, inputs, outputs):
+            o = outputs[0] if isinstance(outputs, (list, tuple)) \
+                else outputs
+            # MAC convention matches the reference (dynamic_flops.py
+            # count_convNd:122 / count_linear): one multiply-add = 1 op,
+            # +1 per output element when a bias exists
+            if kind == "conv":
+                w = layer.weight
+                out_elems = int(np.prod(o.shape))
+                per_out = int(np.prod(w.shape[1:]))
+                bias_ops = 1 if layer.bias is not None else 0
+                counts[name] = counts.get(name, 0) + out_elems * (per_out + bias_ops)
+            elif kind == "linear":
+                w = layer.weight
+                out_rows = int(np.prod(o.shape)) // o.shape[-1]
+                counts[name] = counts.get(name, 0) + out_rows * int(np.prod(w.shape))
+            return outputs
+        return fn
+
+    for name, sub in net.named_sublayers():
+        if isinstance(sub, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):
+            handles.append(sub.register_forward_post_hook(
+                hook(name, "conv")))
+        elif isinstance(sub, nn.Linear):
+            handles.append(sub.register_forward_post_hook(
+                hook(name, "linear")))
+        elif custom_ops and type(sub) in custom_ops:
+            cnt = custom_ops[type(sub)]
+            handles.append(sub.register_forward_post_hook(
+                lambda l, i, o, _n=name, _c=cnt: counts.__setitem__(
+                    _n, _c(l, i, o)) or o))
+    x = Tensor(np.zeros([d if d else 1 for d in input_size], np.float32))
+    was = net.training
+    net.eval()
+    try:
+        with autograd.no_grad():
+            net(x)
+    finally:
+        if was:
+            net.train()
+        for h in handles:
+            h.remove()
+    total = sum(counts.values())
+    if print_detail:
+        for k, v in counts.items():
+            print(f"{k}: {v:,}")
+        print(f"Total FLOPs: {total:,}")
+    return total

@@ -34,3 +34,22 @@ from .layer.transformer import (MultiHeadAttention, Transformer,  # noqa
                                 TransformerEncoder, TransformerEncoderLayer)
 
 from .decode import BeamSearchDecoder, dynamic_decode, gather_tree  # noqa
+
+# -- round-4 parity additions --------------------------------------------
+from .layer.activation import LogSigmoid  # noqa: F401,E402
+from .layer.common import (Dropout3D, PairwiseDistance,  # noqa: F401,E402
+                           UpsamplingBilinear2D, UpsamplingNearest2D)
+from .layer.conv import Conv1DTranspose, Conv3DTranspose  # noqa: F401,E402
+from .layer.loss import HSigmoidLoss  # noqa: F401,E402
+from .layer.pooling import (AdaptiveAvgPool3D,  # noqa: F401,E402
+                            AdaptiveMaxPool1D, AdaptiveMaxPool3D)
+# gradient-clip classes ride in paddle.nn too (reference nn/__init__.py)
+from ..optimizer.clip import (ClipGradByGlobalNorm,  # noqa: F401,E402
+                              ClipGradByNorm, ClipGradByValue)
+# reference exposes the layer submodules as paddle.nn.<name>
+from .layer import (activation, common, conv, loss, norm,  # noqa: F401
+                    pooling, rnn)
+from .layer import common as extension  # noqa: F401,E402
+from .layer import conv as vision  # noqa: F401,E402
+from .utils import remove_weight_norm, weight_norm  # noqa: F401,E402
+from . import utils as weight_norm_hook  # noqa: F401,E402

@@ -19,6 +19,7 @@ from ...core.dispatch import apply, as_array
 from ...core.rng import next_key
 from ...core.tensor import Tensor
 from ...ops.manipulation import pad as _pad_op
+from ...ops.manipulation import squeeze, unsqueeze  # noqa: F401
 
 # ---------------------------------------------------------------------------
 # activations (reference: operators/activation_op.cc kernel zoo)
@@ -331,13 +332,28 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
         pads = _conv_padding(padding, n)
         if isinstance(pads, str):
             raise ValueError("string padding unsupported for conv_transpose")
+        # output_size disambiguates the stride>1 output length
+        # (conv_transpose_op.cc InferShape): it overrides output_padding
+        outpad_eff = list(outpad)
+        if output_size is not None:
+            os_ = _norm_tuple(tuple(output_size), n)
+            for i in range(n):
+                kk = (w.shape[2 + i] - 1) * dilation[i] + 1
+                lo, hi = pads[i]
+                base = (a_.shape[2 + i] - 1) * stride[i] - lo - hi + kk
+                op = os_[i] - base
+                if not 0 <= op < max(stride[i], 1) + 1:
+                    raise ValueError(
+                        f"conv_transpose: output_size[{i}]={os_[i]} not "
+                        f"reachable (base {base}, stride {stride[i]})")
+                outpad_eff[i] = op
         # gradient-of-conv formulation: dilate input by stride, full-pad
         lhs_dilation = stride
         pad_list = []
         for i in range(n):
             kk = (w.shape[2 + i] - 1) * dilation[i] + 1
             lo, hi = pads[i]
-            pad_list.append((kk - 1 - lo, kk - 1 - hi + outpad[i]))
+            pad_list.append((kk - 1 - lo, kk - 1 - hi + outpad_eff[i]))
         w_flip = jnp.flip(w, axis=(2, 3))
         w_t = jnp.swapaxes(w_flip, 0, 1)  # -> [out_c, in_c, H, W]
         if groups > 1:
@@ -1190,3 +1206,380 @@ def diag_embed(x, offset=0, dim1=-2, dim2=-1):
         idx = jnp.arange(n)
         return out.at[..., idx, idx].set(a)
     return apply(_de, x, op_name="diag_embed")
+
+
+# ---------------------------------------------------------------------------
+# round-4 functional parity (reference: nn/functional full surface)
+# ---------------------------------------------------------------------------
+
+def log_sigmoid(x, name=None):
+    """reference: activation.py log_sigmoid."""
+    return apply(jax.nn.log_sigmoid, x, op_name="log_sigmoid",
+                 cacheable=True)
+
+
+def _thresholded_relu_fn(a, *, threshold):
+    return jnp.where(a > threshold, a, 0.0)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(_thresholded_relu_fn, x, op_name="thresholded_relu",
+                 threshold=float(threshold), cacheable=True)
+
+
+def elu_(x, alpha=1.0, name=None):
+    out = elu(x, alpha)
+    x._rebind(out)
+    return x
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._rebind(out)
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._rebind(out)
+    return x
+
+
+def tanh_(x, name=None):
+    from ...ops.math import tanh as _tanh
+    out = _tanh(x)
+    x._rebind(out)
+    return x
+
+
+def square_error_cost(input, label, name=None):
+    """reference: loss.py square_error_cost — elementwise (x - y)^2."""
+    return apply(lambda a, b: (a - b) ** 2, input, label,
+                 op_name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """reference: loss.py log_loss — binary cross-entropy on
+    probabilities."""
+    def fn(p, y):
+        return (-y * jnp.log(p + epsilon)
+                - (1.0 - y) * jnp.log(1.0 - p + epsilon))
+    return apply(fn, input, label, op_name="log_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference: loss.py dice_loss — 1 - dice coefficient over the
+    class probabilities (input [N, ..., C] softmax outputs, label int)."""
+    def fn(p, y):
+        yf = jax.nn.one_hot(y.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * yf, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(yf, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+    return apply(fn, input, label, op_name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """reference: loss.py npair_loss (Sohn 2016): softmax CE over
+    anchor·positiveᵀ similarities + L2 on the embeddings."""
+    def fn(a, p, y):
+        sim = a @ p.T                                 # [B, B]
+        lab = (y[:, None] == y[None, :]).astype(a.dtype)
+        lab = lab / jnp.maximum(lab.sum(axis=1, keepdims=True), 1.0)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -(lab * logp).sum(axis=1).mean()
+        reg = l2_reg * ((a ** 2).sum(axis=1) + (p ** 2).sum(axis=1)
+                        ).mean() * 0.25
+        return ce + reg
+    return apply(fn, anchor, positive, labels, op_name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference: loss.py hsigmoid_loss /
+    operators/hierarchical_sigmoid_op.cc).
+
+    Default tree: complete binary tree over ``num_classes`` leaves (leaf
+    of class c = node c + num_classes - 1, parent (i-1)//2, code = is-
+    right-child) — the reference's non-custom-tree path.  Custom trees
+    ride in ``path_table``/``path_code`` [N, L] (padded with -1)."""
+    import numpy as np_
+
+    if path_table is None:
+        depth = max(int(np_.ceil(np_.log2(max(num_classes, 2)))), 1)
+        tbl = np_.full((num_classes, depth), -1, np_.int64)
+        code = np_.zeros((num_classes, depth), np_.float32)
+        for c in range(num_classes):
+            node = c + num_classes - 1
+            path = []
+            while node > 0:
+                parent = (node - 1) // 2
+                path.append((parent, float(node == 2 * parent + 2)))
+                node = parent
+            for d, (pn, bit) in enumerate(reversed(path)):
+                tbl[c, d] = pn
+                code[c, d] = bit
+        la = as_array(label).reshape(-1)
+        path_table = Tensor(jnp.asarray(tbl)[la])
+        path_code = Tensor(jnp.asarray(code)[la])
+    elif path_code is None:
+        raise ValueError(
+            "hsigmoid_loss: a custom path_table requires path_code")
+
+    args = [input, label, path_table, path_code, weight] + (
+        [bias] if bias is not None else [])
+
+    def fn(x, y, tbl, code, w, *mb):
+        valid = (tbl >= 0)
+        t = jnp.maximum(tbl, 0)
+        wn = w[t]                                 # [N, L, D]
+        logits = jnp.einsum("nd,nld->nl", x, wn)
+        if mb:
+            logits = logits + mb[0][t]
+        # BCE with the path code at every valid node
+        ls = jax.nn.log_sigmoid(logits)
+        lns = jax.nn.log_sigmoid(-logits)
+        bce = -(code * ls + (1.0 - code) * lns)
+        per_ex = (bce * valid).sum(axis=1)
+        return per_ex[:, None]                     # [N, 1] like reference
+
+    return apply(fn, *args, op_name="hsigmoid_loss")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """reference: vision.py affine_grid — sampling grid [N, H, W, 2] from
+    2x3 affine matrices."""
+    if hasattr(out_shape, "data"):
+        out_shape = [int(v) for v in np_asarray(out_shape)]
+    N, C, H, W = [int(v) for v in out_shape]
+
+    def fn(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+            xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)      # [H, W, 3]
+        return jnp.einsum("hwk,njk->nhwj", base, th)   # [N, H, W, 2]
+
+    return apply(fn, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """reference: vision.py grid_sample — sample NCHW input at normalized
+    grid locations [N, H', W', 2] (x, y order)."""
+    if mode not in ("bilinear", "nearest"):
+        raise NotImplementedError(f"grid_sample mode {mode!r}")
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sample padding_mode {padding_mode!r}")
+
+    def fn(a, g):
+        N, C, H, W = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1.0) * (W - 1) / 2.0
+            fy = (gy + 1.0) * (H - 1) / 2.0
+        else:
+            fx = ((gx + 1.0) * W - 1.0) / 2.0
+            fy = ((gy + 1.0) * H - 1.0) / 2.0
+
+        def gather(yi, xi):
+            yi = jnp.clip(yi, 0, H - 1)
+            xi = jnp.clip(xi, 0, W - 1)
+            bidx = jnp.arange(N)[:, None, None]
+            return a[bidx, :, yi, xi]              # [N, H', W', C]
+
+        # zeros padding masks PER TAP (the rounded/nearest index for
+        # 'nearest', each corner for 'bilinear') so boundary-straddling
+        # samples keep their partial in-bounds contribution — reference
+        # grid_sampler semantics
+        inb_idx = lambda yy, xx: ((yy >= 0) & (yy <= H - 1)
+                                  & (xx >= 0) & (xx <= W - 1))
+        if mode == "nearest":
+            yi = jnp.round(fy).astype(jnp.int32)
+            xi = jnp.round(fx).astype(jnp.int32)
+            out = gather(yi, xi)
+            if padding_mode == "zeros":
+                out = out * inb_idx(yi, xi)[..., None]
+        else:
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            wy = fy - y0
+            wx = fx - x0
+            vals = 0.0
+            for dy, dx, wgt in (
+                    (0, 0, (1 - wy) * (1 - wx)), (0, 1, (1 - wy) * wx),
+                    (1, 0, wy * (1 - wx)), (1, 1, wy * wx)):
+                yi, xi = y0 + dy, x0 + dx
+                v = gather(yi, xi)
+                if padding_mode == "zeros":
+                    v = v * inb_idx(yi, xi)[..., None]
+                vals = vals + v * wgt[..., None]
+            out = vals
+        return jnp.moveaxis(out, -1, 1)            # -> [N, C, H', W']
+
+    return apply(fn, x, grid, op_name="grid_sample")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    os = _norm_tuple(output_size, 3)
+    channels_last = not data_format.startswith("NC")
+
+    def fn(a):
+        if channels_last:                # NDHWC -> NCDHW
+            a = jnp.moveaxis(a, -1, 1)
+        N, C, D, H, W = a.shape
+        if D % os[0] == 0 and H % os[1] == 0 and W % os[2] == 0:
+            out = a.reshape(N, C, os[0], D // os[0], os[1], H // os[1],
+                            os[2], W // os[2])
+            out = out.mean(axis=(3, 5, 7))
+            return jnp.moveaxis(out, 1, -1) if channels_last else out
+        cells = jnp.zeros((N, C) + tuple(os), a.dtype)
+        for i in range(os[0]):
+            for j in range(os[1]):
+                for k in range(os[2]):
+                    blk = a[:, :,
+                            (i * D) // os[0]:-(-(i + 1) * D // os[0]),
+                            (j * H) // os[1]:-(-(j + 1) * H // os[1]),
+                            (k * W) // os[2]:-(-(k + 1) * W // os[2])]
+                    cells = cells.at[:, :, i, j, k].set(
+                        blk.mean(axis=(2, 3, 4)))
+        return jnp.moveaxis(cells, 1, -1) if channels_last else cells
+    return apply(fn, x, op_name="adaptive_avg_pool3d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d: return_mask (argmax indices) is not "
+            "implemented — dropping it silently would break "
+            "reference-parity unpacking")
+    os = _norm_tuple(output_size, 3)
+
+    def fn(a):
+        N, C, D, H, W = a.shape
+        if D % os[0] == 0 and H % os[1] == 0 and W % os[2] == 0:
+            out = a.reshape(N, C, os[0], D // os[0], os[1], H // os[1],
+                            os[2], W // os[2])
+            return out.max(axis=(3, 5, 7))
+        cells = jnp.zeros((N, C) + tuple(os), a.dtype)
+        for i in range(os[0]):
+            for j in range(os[1]):
+                for k in range(os[2]):
+                    blk = a[:, :,
+                            (i * D) // os[0]:-(-(i + 1) * D // os[0]),
+                            (j * H) // os[1]:-(-(j + 1) * H // os[1]),
+                            (k * W) // os[2]:-(-(k + 1) * W // os[2])]
+                    cells = cells.at[:, :, i, j, k].set(
+                        blk.max(axis=(2, 3, 4)))
+        return cells
+    return apply(fn, x, op_name="adaptive_max_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool1d: return_mask (argmax indices) is not "
+            "implemented — dropping it silently would break "
+            "reference-parity unpacking")
+    os = int(output_size)
+
+    def fn(a):
+        N, C, L = a.shape
+        if L % os == 0:
+            return a.reshape(N, C, os, L // os).max(axis=3)
+        return jnp.stack(
+            [a[:, :, (i * L) // os:-(-(i + 1) * L // os)].max(axis=2)
+             for i in range(os)], axis=-1)
+    return apply(fn, x, op_name="adaptive_max_pool1d")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    """reference: conv.py conv1d_transpose — via the 2-D kernel with a
+    unit width axis."""
+    channels_first = data_format.startswith("NC")
+    # NCL -> NCLW (unit W after spatial); NLC -> NL1C (unit W axis 2,
+    # keeping channels last)
+    x4 = unsqueeze(x, -1 if channels_first else 2)
+    w4 = unsqueeze(weight, -1)
+    fmt = "NCHW" if channels_first else "NHWC"
+    if output_size is not None:
+        output_size = [_norm_tuple(output_size, 1)[0], 1]
+    out = conv2d_transpose(
+        x4, w4, bias, stride=(_norm_tuple(stride, 1)[0], 1),
+        padding=(_norm_tuple(padding, 1)[0], 0),
+        output_padding=(_norm_tuple(output_padding, 1)[0], 0),
+        dilation=(_norm_tuple(dilation, 1)[0], 1), groups=groups,
+        output_size=output_size, data_format=fmt)
+    return squeeze(out, -1 if channels_first else 2)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    """reference: conv.py conv3d_transpose (gradient-of-conv3d)."""
+    n = 3
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    outpad = _norm_tuple(output_padding, n)
+    if groups != 1:
+        raise NotImplementedError("conv3d_transpose: groups > 1")
+    channels_last = not data_format.startswith("NC")
+
+    def fn(a, w):
+        a_ = jnp.moveaxis(a, -1, 1) if channels_last else a
+        pads = _conv_padding(padding, n)
+        if isinstance(pads, str):
+            raise ValueError(
+                "string padding unsupported for conv_transpose")
+        outpad_eff = list(outpad)
+        if output_size is not None:
+            os_ = _norm_tuple(tuple(output_size), n)
+            for i in range(n):
+                kk = (w.shape[2 + i] - 1) * dilation[i] + 1
+                lo, hi = pads[i]
+                base = (a_.shape[2 + i] - 1) * stride[i] - lo - hi + kk
+                op = os_[i] - base
+                if not 0 <= op < max(stride[i], 1) + 1:
+                    raise ValueError(
+                        f"conv3d_transpose: output_size[{i}]={os_[i]} "
+                        f"not reachable (base {base}, stride {stride[i]})")
+                outpad_eff[i] = op
+        pad_list = []
+        for i in range(n):
+            kk = (w.shape[2 + i] - 1) * dilation[i] + 1
+            lo, hi = pads[i]
+            pad_list.append((kk - 1 - lo, kk - 1 - hi + outpad_eff[i]))
+        w_t = jnp.swapaxes(jnp.flip(w, axis=(2, 3, 4)), 0, 1)
+        dn = jax.lax.conv_dimension_numbers(
+            a_.shape, w_t.shape, ("NCDHW", "OIDHW", "NCDHW"))
+        out = jax.lax.conv_general_dilated(
+            a_, w_t, window_strides=(1, 1, 1), padding=pad_list,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn)
+        return jnp.moveaxis(out, 1, -1) if channels_last else out
+
+    out = apply(fn, x, weight, op_name="conv3d_transpose")
+    if bias is not None:
+        shape = [1] * 5
+        shape[-1 if channels_last else 1] = -1
+        out = out + bias.reshape(shape)
+    return out
+
+
+def np_asarray(x):
+    import numpy as _np
+    return _np.asarray(x.data if hasattr(x, "data") else x)
+
+
+from ..decode import gather_tree  # noqa: F401,E402
+
+from . import activation, common, conv, extension, loss, pooling  # noqa

@@ -1,0 +1,3 @@
+"""Namespace alias (reference exposes paddle.nn.functional.pooling as a
+submodule); every function lives in the parent package."""
+from paddle_tpu.nn.functional import *  # noqa: F401,F403

@@ -165,3 +165,8 @@ class ThresholdedReLU(Layer):
         t = self._threshold
         return apply(lambda a: jnp.where(a > t, a, 0.0), x,
                      op_name="thresholded_relu")
+
+
+class LogSigmoid(Layer):
+    def forward(self, x):
+        return F.log_sigmoid(x)

@@ -195,3 +195,63 @@ class Unfold(Layer):
 
     def forward(self, x):
         return F.unfold(x, *self.args)
+
+
+class Dropout3D(Layer):
+    """reference: common.py Dropout3D — whole-channel 3-D dropout."""
+
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, self.training, self.data_format)
+
+
+class PairwiseDistance(Layer):
+    """reference: distance.py PairwiseDistance — p-norm of x - y."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ...ops import linalg
+        diff = x - y + self.epsilon
+        return linalg.norm(diff, p=self.p, axis=-1, keepdim=self.keepdim)
+
+
+class UpsamplingNearest2D(Layer):
+    """reference: common.py UpsamplingNearest2D."""
+
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode="nearest", data_format=self.data_format)
+
+
+class UpsamplingBilinear2D(Layer):
+    """reference: common.py UpsamplingBilinear2D (align_corners=True)."""
+
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode="bilinear", align_corners=True,
+                             data_format=self.data_format)

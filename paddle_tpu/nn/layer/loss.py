@@ -143,3 +143,22 @@ class CosineEmbeddingLoss(Layer):
     def forward(self, input1, input2, label):
         return F.cosine_embedding_loss(input1, input2, label, self.margin,
                                        self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """reference: loss.py HSigmoidLoss (hierarchical sigmoid)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight, self.bias, path_table,
+                               path_code)

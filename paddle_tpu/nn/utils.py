@@ -1,0 +1,80 @@
+"""paddle.nn.utils — weight normalization (reference:
+python/paddle/nn/utils/weight_norm_hook.py).
+
+Reparameterises ``layer.<name>`` as ``g * v / ||v||`` with trainable
+``<name>_g`` / ``<name>_v``; a forward-pre-hook recomputes the derived
+weight as a TENSOR expression each call, so gradients flow to g and v
+through the tape exactly like the reference's WeightNorm hook (which
+also swaps the attribute for a computed Variable per forward)."""
+from __future__ import annotations
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm"]
+
+
+def _norm_tensor(v: Tensor, dim):
+    sq = (v * v)
+    if dim is None:
+        return sq.sum().sqrt()
+    axes = [i for i in range(len(v.shape_tuple)) if i != dim]
+    return sq.sum(axis=axes, keepdim=True).sqrt()
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Apply weight normalization to ``layer.<name>`` (in place)."""
+    w = getattr(layer, name)
+    if not isinstance(w, Parameter):
+        raise ValueError(f"{name!r} is not a Parameter of {layer}")
+    import numpy as np
+
+    v0 = w.data
+    g0 = _norm_tensor(Tensor(v0), dim).data
+    g = layer.create_parameter(list(np.asarray(g0).shape),
+                               dtype=str(v0.dtype))
+    g.data = g0
+    v = layer.create_parameter(list(v0.shape), dtype=str(v0.dtype))
+    v.data = v0
+    setattr(layer, f"{name}_g", g)
+    setattr(layer, f"{name}_v", v)
+    # the plain weight leaves the parameter set (reference hook does the
+    # same); it becomes a derived tensor recomputed per forward
+    layer._parameters.pop(name, None)
+    object.__setattr__(layer, name, Tensor(v0))
+    layer._wn_dim = dim
+
+    def pre_hook(lyr, inputs):
+        gg = getattr(lyr, f"{name}_g")
+        vv = getattr(lyr, f"{name}_v")
+        n = _norm_tensor(vv, dim)
+        derived = gg.reshape(n.shape_tuple) * vv / n if dim is not None \
+            else gg * vv / n
+        object.__setattr__(lyr, name, derived)
+        return inputs
+
+    layer._wn_hook = layer.register_forward_pre_hook(pre_hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g/v back into a plain trainable ``layer.<name>``."""
+    if not hasattr(layer, f"{name}_g"):
+        raise ValueError(f"{layer} has no weight_norm on {name!r}")
+    g = getattr(layer, f"{name}_g")
+    v = getattr(layer, f"{name}_v")
+    dim = layer._wn_dim
+    n = _norm_tensor(Tensor(v.data), dim)
+    folded = (Tensor(g.data).reshape(n.shape_tuple) * Tensor(v.data) / n
+              if dim is not None else Tensor(g.data) * Tensor(v.data) / n)
+    layer._wn_hook.remove()
+    for suffix in ("_g", "_v"):
+        pname = f"{name}{suffix}"
+        layer._parameters.pop(pname, None)
+        if hasattr(layer, pname):
+            try:
+                object.__delattr__(layer, pname)
+            except AttributeError:
+                pass
+    w = Parameter(folded.data)
+    setattr(layer, name, w)
+    return layer

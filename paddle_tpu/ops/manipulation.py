@@ -9,6 +9,7 @@ import numpy as np
 
 from ..core.dispatch import apply, as_array
 from ..core.dtype import convert_dtype
+from builtins import slice as builtins_slice
 from ..core.tensor import Tensor
 
 
@@ -440,5 +441,79 @@ def setitem(x, idx, value):
     def _set(a, v):
         return a.at[nidx].set(v.astype(a.dtype) if hasattr(v, "astype") else v)
     out = apply(_set, x, value, op_name="set_value")
+    x._rebind(out)
+    return x
+
+
+def slice(input, axes, starts, ends, name=None):  # noqa: A001
+    """reference: operators/slice_op.cc — slice along the given axes."""
+    a = as_array(input)
+    idx = [builtins_slice(None)] * a.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        dim = a.shape[ax]
+        s = int(s) if s >= 0 else int(s) + dim
+        e = int(e) if e >= 0 else int(e) + dim
+        idx[ax] = builtins_slice(max(s, 0), min(e, dim))
+    return apply(lambda x: x[tuple(idx)], input, op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    """reference: operators/strided_slice_op.cc."""
+    a = as_array(x)
+    idx = [builtins_slice(None)] * a.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        dim = a.shape[ax]
+        s = int(s) if s >= 0 else int(s) + dim
+        e = int(e) if e >= 0 else int(e) + dim
+        idx[ax] = builtins_slice(s, e, int(st))
+    return apply(lambda v: v[tuple(idx)], x, op_name="strided_slice")
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """reference: operators/crop_tensor_op.cc — crop ``shape`` starting at
+    ``offsets`` (defaults: zero offsets, full shape)."""
+    a = as_array(x)
+    shape = list(shape if shape is not None else a.shape)
+    offsets = list(offsets if offsets is not None else [0] * a.ndim)
+    shape = [a.shape[i] - offsets[i] if s in (-1, None) else s
+             for i, s in enumerate(shape)]
+    idx = tuple(builtins_slice(o, o + s) for o, s in zip(offsets, shape))
+    return apply(lambda v: v[idx], x, op_name="crop_tensor")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """reference: operators/shard_index_op.cc — recode global ids into a
+    shard-local id space (the PS sharded-embedding helper): ids inside
+    this shard's [shard_id*size, (shard_id+1)*size) window map to
+    id - shard_id*size, everything else to ``ignore_value``."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for nshards {nshards}")
+    size = (index_num + nshards - 1) // nshards
+
+    def fn(ids):
+        lo = shard_id * size
+        inside = (ids >= lo) & (ids < lo + size)
+        return jnp.where(inside, ids - lo, ignore_value)
+
+    return apply(fn, input, op_name="shard_index", nondiff=True)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    """In-place scatter (reference inplace op scatter_): mutates x.data."""
+    out = scatter(x, index, updates, overwrite)
+    x._rebind(out)
+    return x
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._rebind(out)
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
     x._rebind(out)
     return x

@@ -250,3 +250,46 @@ def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
     return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
                                           neginf=neginf), x,
                  op_name="nan_to_num")
+
+
+def add_n(inputs, name=None):
+    """reference: operators/sum_op.cc — elementwise sum of a tensor list."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+
+    def fn(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+
+    return apply(fn, *inputs, op_name="add_n")
+
+
+def mv(x, vec, name=None):
+    """reference: operators/mv_op.cc — matrix @ vector."""
+    return apply(lambda a, v: a @ v, x, vec, op_name="mv")
+
+
+def tanh_(x, name=None):
+    """Inplace tanh (reference inplace op tanh_)."""
+    out = tanh(x)
+    x._rebind(out)
+    return x
+
+
+def broadcast_shape(x_shape, y_shape):
+    """reference: tensor/manipulation broadcast_shape."""
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def rank(input, name=None):
+    """Tensor rank as a 0-d int tensor (tensor/attribute.py rank)."""
+    from ..core.dispatch import as_array
+    return Tensor(jnp.asarray(as_array(input).ndim, jnp.int32))
+
+
+def shape(input, name=None):
+    """Runtime shape as a 1-d int tensor (tensor/attribute.py shape)."""
+    from ..core.dispatch import as_array
+    return Tensor(jnp.asarray(as_array(input).shape, jnp.int32))
