@@ -81,6 +81,40 @@ def build_model(vocab, hidden, layers, heads, ffn, seq, dropout):
     return BertMLM()
 
 
+# Transient tunnel/RPC failure markers (round-4 postmortem: the driver's
+# bench run died on "remote_compile: read body: response body closed" —
+# a one-shot tunnel hiccup, not a code bug).  Any bench attempt that dies
+# with one of these is retried from scratch (fresh model/optimizer state:
+# donated buffers may be invalidated by a failed dispatch).
+_TRANSIENT_MARKERS = (
+    "remote_compile", "read body", "response body closed", "UNAVAILABLE",
+    "DEADLINE_EXCEEDED", "Connection reset", "Socket closed",
+)
+
+
+def _is_transient(exc) -> bool:
+    s = f"{type(exc).__name__}: {exc}"
+    return any(m.lower() in s.lower() for m in _TRANSIENT_MARKERS)
+
+
+def _retry_bench(fn, *args, attempts=3):
+    """Run a whole bench function, retrying on transient tunnel errors.
+
+    Retries rebuild the model from scratch: after a failed dispatch the
+    donated input buffers of the in-flight step are in an undefined
+    state, so resuming the same step loop is unsound."""
+    for i in range(attempts):
+        try:
+            return fn(*args)
+        except Exception as e:  # noqa: BLE001 - classify then re-raise
+            if i == attempts - 1 or not _is_transient(e):
+                raise
+            sys.stderr.write(
+                f"[bench] transient failure (attempt {i + 1}/{attempts}), "
+                f"retrying: {type(e).__name__}: {e}\n")
+            time.sleep(3.0 * (i + 1))
+
+
 def _timed_steps(step, feeds, warmup, steps, profile_dir=None):
     for _ in range(max(warmup, 1)):  # >=1: compile outside timed region
         loss = step(*feeds)
@@ -185,6 +219,184 @@ def bench_bert(args, dev, on_tpu):
         "dtype": dtype,
         "donated": True,
         "profile_dir": prof,
+    }
+
+
+def build_gpt(vocab, hidden, layers, heads, ffn, seq, dropout):
+    """GPT-shaped causal decoder LM (BASELINE.json configs[4] single-chip
+    proxy; reference shapes: PaddleNLP gpt/modeling.py, fed by the fleet
+    hybrid runtime section_worker.cc:128-165).  Pre-norm blocks, tied
+    input/output embedding (the vocab projection reuses ``tok.weight`` via
+    the fused chunked linear_cross_entropy loss), causal Pallas flash
+    attention."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    import paddle_tpu.nn.functional as F
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.ln1 = nn.LayerNorm(hidden)
+            self.q = nn.Linear(hidden, hidden)
+            self.k = nn.Linear(hidden, hidden)
+            self.v = nn.Linear(hidden, hidden)
+            self.proj = nn.Linear(hidden, hidden)
+            self.ln2 = nn.LayerNorm(hidden)
+            self.fc1 = nn.Linear(hidden, ffn)
+            self.fc2 = nn.Linear(ffn, hidden)
+            self.drop = nn.Dropout(dropout)
+
+        def forward(self, x):
+            B, S = x.shape[0], x.shape[1]
+            h = self.ln1(x)
+            hd = hidden // heads
+            q = self.q(h).reshape([B, S, heads, hd])
+            k = self.k(h).reshape([B, S, heads, hd])
+            v = self.v(h).reshape([B, S, heads, hd])
+            a = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=dropout,
+                training=self.training)
+            x = x + self.drop(self.proj(a.reshape([B, S, hidden])))
+            h = self.ln2(x)
+            x = x + self.drop(self.fc2(F.gelu(self.fc1(h),
+                                              approximate=True)))
+            return x
+
+    # GPT-2 init: N(0, 0.02) embeddings — with the tied head this keeps
+    # initial logits O(1) (paddle default N(0,1) embeddings would give
+    # CE ~ 10x ln(V) at step 0 through the tied projection)
+    emb_attr = paddle.ParamAttr(
+        initializer=nn.initializer.Normal(0.0, 0.02))
+
+    class GPT(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.tok = nn.Embedding(vocab, hidden, weight_attr=emb_attr)
+            self.pos = nn.Embedding(seq, hidden, weight_attr=emb_attr)
+            self.drop = nn.Dropout(dropout)
+            self.blocks = nn.LayerList([Block() for _ in range(layers)])
+            self.ln_f = nn.LayerNorm(hidden)
+
+        def forward(self, ids):
+            from paddle_tpu.parallel import recompute
+            pos_ids = paddle.arange(ids.shape[1]).unsqueeze(0)
+            x = self.drop(self.tok(ids) + self.pos(pos_ids))
+            for blk in self.blocks:
+                # per-block remat: peak bwd memory = one block's
+                # internals + per-block boundary activations (whole-model
+                # jax.checkpoint would keep every layer's temps live in
+                # one rematted backward — measured 21.8 GB at 760M)
+                x = recompute(blk, x)
+            return self.ln_f(x)
+
+    return GPT()
+
+
+# single-chip GPT presets: "largest that fits" on a 16 GB v5e with fp32
+# AdamW state (param bf16 2B + master 4B + m 4B + v 4B = 14 B/param).
+# 1.3B proper (H=2048 L=24) needs 18.4 GB of state alone — does not fit
+# one chip; 760M-class is the largest standard GPT size that leaves
+# activation/workspace headroom.  BASELINE configs[4] runs 1.3B across a
+# pod; the multi-chip sharding for that is exercised in
+# __graft_entry__.dryrun_multichip.
+_GPT_PRESETS = {
+    "760m": dict(vocab=50257, hidden=1536, layers=24, heads=16, ffn=6144,
+                 seq=1024, dropout=0.1),
+    "1b": dict(vocab=50257, hidden=1792, layers=24, heads=14, ffn=7168,
+               seq=1024, dropout=0.1),
+}
+
+
+def bench_gpt(args, dev, on_tpu):
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.optimizer.clip import ClipGradByGlobalNorm
+
+    if on_tpu:
+        preset = os.environ.get("BENCH_GPT_PRESET", "760m")
+        cfg = dict(_GPT_PRESETS[preset],
+                   batch=int(os.environ.get("BENCH_GPT_BATCH", "16")))
+        steps = args.steps or 10
+        dtype = "bfloat16"
+    else:
+        preset = "cpu_smoke"
+        cfg = dict(vocab=1000, hidden=128, layers=2, heads=4, ffn=512,
+                   seq=128, dropout=0.1, batch=4)
+        steps = args.steps or 3
+        dtype = "float32"
+
+    paddle.seed(2024)
+    model = build_gpt(cfg["vocab"], cfg["hidden"], cfg["layers"],
+                      cfg["heads"], cfg["ffn"], cfg["seq"], cfg["dropout"])
+    opt = optimizer.AdamW(
+        learning_rate=2e-4, parameters=model.parameters(), weight_decay=0.01,
+        grad_clip=ClipGradByGlobalNorm(1.0),
+        multi_precision=(dtype != "float32"))
+    if dtype != "float32":
+        model, opt = amp.decorate(model, opt, level="O2", dtype=dtype)
+
+    def loss_fn(out, labels):
+        # tied head: logits = out @ tok.weight^T, fused+chunked so the
+        # [tokens, 50257] logits never materialize
+        w = paddle.transpose(model.tok.weight, [1, 0])
+        bias = paddle.zeros([cfg["vocab"]], dtype=w.dtype)
+        return F.linear_cross_entropy(
+            out.reshape([-1, cfg["hidden"]]), w, bias, labels.reshape([-1]),
+            chunk=1024)
+
+    step = TrainStep(model, loss_fn, opt, n_inputs=1, donate=True)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, cfg["vocab"],
+                                (cfg["batch"], cfg["seq"]), dtype=np.int32))
+    y = jnp.asarray(rng.randint(0, cfg["vocab"],
+                                (cfg["batch"], cfg["seq"]), dtype=np.int32))
+
+    # profile only when gpt is the selected suite (under --suite all the
+    # trace dir belongs to the flagship bert run)
+    prof = args.profile if args.suite == "gpt" else None
+    dt, last = _timed_steps(step, (x, y), args.warmup, steps,
+                            profile_dir=prof)
+    steps_per_sec = steps / dt
+    tokens = cfg["batch"] * cfg["seq"]
+
+    n_params = sum(int(np.prod(p.shape_tuple)) for p in model.parameters())
+    n_embed = (cfg["vocab"] + cfg["seq"]) * cfg["hidden"]
+    # dense matmul FLOPs: the tied vocab projection does a real
+    # [T,H]x[H,V] matmul in the loss, so add it back to the dense count;
+    # causal attention does half the S^2 work (flash skips masked blocks)
+    n_matmul = (n_params - n_embed) + cfg["vocab"] * cfg["hidden"]
+    flops_per_step = (6 * n_matmul * tokens
+                      + 6 * cfg["layers"] * cfg["batch"]
+                      * cfg["seq"] ** 2 * cfg["hidden"])
+    peak = _peak_flops(dev)
+    mfu = flops_per_step * steps_per_sec / peak if peak else 0.0
+
+    return {
+        "metric": (f"gpt_{preset}_pretrain_tokens_per_sec_per_chip"
+                   if on_tpu else "gpt_tiny_cpu_smoke_tokens_per_sec"),
+        "value": round(tokens * steps_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4) if peak else 0.0,
+        "mfu": round(mfu, 4),
+        "steps_per_sec": round(steps_per_sec, 4),
+        "step_time_ms": round(1000 * dt / steps, 2),
+        "model_flops_per_step": flops_per_step,
+        "n_params": n_params,
+        "final_loss": round(last, 4),
+        "config": cfg,
+        "dtype": dtype,
+        "recompute": "per_block",
+        "tied_embedding": True,
+        "flops_accounting": "6*N*T dense (+tied head) + causal attn S^2/2",
+        "note": ("single-chip proxy of BASELINE configs[4]; 1.3B optimizer "
+                 "state (18.4 GB fp32 AdamW) exceeds one 16 GB chip — "
+                 "largest-that-fits preset; pod-scale hybrid sharding "
+                 "exercised in dryrun_multichip"),
     }
 
 
@@ -331,7 +543,7 @@ def main():
     ap.add_argument("--small", action="store_true",
                     help="force the tiny CPU config")
     ap.add_argument("--suite", type=str, default="all",
-                    choices=["all", "bert", "resnet", "lenet"],
+                    choices=["all", "bert", "gpt", "resnet", "lenet"],
                     help="which benchmarks to run (default: all)")
     args = ap.parse_args()
 
@@ -343,19 +555,39 @@ def main():
     extra = {}
     if args.suite in ("all", "resnet"):
         try:
-            extra["resnet50"] = bench_resnet50(args, dev, on_tpu)
+            extra["resnet50"] = _retry_bench(bench_resnet50, args, dev,
+                                             on_tpu)
         except Exception as e:
             extra["resnet50"] = {
                 "metric": "resnet50_train_images_per_sec_per_chip",
                 "error": f"{type(e).__name__}: {e}"}
+    if args.suite in ("all", "gpt"):
+        try:
+            extra["gpt"] = _retry_bench(bench_gpt, args, dev, on_tpu)
+        except Exception as e:
+            extra["gpt"] = {
+                "metric": "gpt_pretrain_tokens_per_sec_per_chip",
+                "error": f"{type(e).__name__}: {e}"}
     if args.suite in ("all", "lenet"):
         extra["lenet_dygraph"] = bench_lenet_dygraph(args)
 
+    result = None
     if args.suite in ("all", "bert"):
-        result = bench_bert(args, dev, on_tpu)
-    else:
-        k = next(iter(extra))
-        result = extra.pop(k)
+        try:
+            result = _retry_bench(bench_bert, args, dev, on_tpu)
+        except Exception as e:
+            extra["bert_error"] = {"error": f"{type(e).__name__}: {e}"}
+    if result is None:
+        # never exit non-zero without a JSON line: promote the first
+        # successful secondary result (round-4 lesson — rc=1 loses the
+        # round's perf evidence entirely)
+        for k in ("gpt", "resnet50", "lenet_dygraph"):
+            if k in extra and "error" not in extra[k]:
+                result = extra.pop(k)
+                break
+    if result is None:
+        result = {"metric": "bench_failed", "value": 0.0, "unit": "none",
+                  "vs_baseline": 0.0}
 
     result.setdefault("device", getattr(dev, "device_kind", dev.platform))
     result.setdefault("platform", dev.platform)
