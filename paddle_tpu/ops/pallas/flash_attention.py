@@ -191,7 +191,13 @@ def _fwd_kernel(q_off_ref, k_off_ref, seed_ref, q_ref, k_ref, v_ref,
     m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m, l, acc))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0, 0] = jnp.where(l > 0, m + jnp.log(l_safe), NEG_INF)
+    # lse block is (8, bq): positions on the LANE dim, replicated over 8
+    # sublanes — the minimal Mosaic-legal tile.  A trailing unit dim
+    # ([..., Lq, 1]) would make XLA tile-pad the HBM buffer 1 -> 128
+    # lanes (128x memory — measured ~200 MB/layer residual at BERT-base
+    # scale); the (bq, 1) -> (1, bq) relayout is a few hundred f32/block
+    lse = jnp.where(l > 0, m + jnp.log(l_safe), NEG_INF)
+    lse_ref[0, 0] = jnp.broadcast_to(lse.reshape(1, -1), (8, lse.shape[0]))
 
 
 def _qkv_fwd_specs(block_q, Lk, D):
@@ -207,7 +213,7 @@ def _qkv_fwd_specs(block_q, Lk, D):
 
 def _fwd(q, k, v, q_off, k_off, seed, scale, causal, block_q, block_k,
          aligned, dropout_p=0.0):
-    """q/k/v: [B, H, L, D] → (out [B,H,Lq,D], lse [B,H,Lq,1])."""
+    """q/k/v: [B, H, L, D] → (out [B,H,Lq,D], lse [B,H,Lq])."""
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     grid = (B, H, Lq // block_q)
@@ -220,15 +226,17 @@ def _fwd(q, k, v, q_off, k_off, seed, scale, causal, block_q, block_k,
         in_specs=_qkv_fwd_specs(block_q, Lk, D),
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, h, i: (b, h, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Lq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, 8, Lq), jnp.float32),
         ],
         interpret=_interpret(),
     )(q_off, k_off, seed, q, k, v)
-    return out, lse
+    # compact [B, H, Lq] is the residual / public lse shape; the 8-sublane
+    # replication exists only at the kernel boundary
+    return out, lse[:, :, 0, :]
 
 
 # ---------------------------------------------------------------------------
@@ -241,8 +249,8 @@ def _bwd_dq_kernel(q_off_ref, k_off_ref, seed_ref, q_ref, k_ref, v_ref,
     qi = pl.program_id(2)
     q = q_ref[0, 0]                                       # [BQ, D]
     do = do_ref[0, 0]
-    lse = lse_ref[0, 0]                                   # [BQ, 1]
-    delta = delta_ref[0, 0]
+    lse = lse_ref[0, 0][0:1, :].reshape(-1, 1)            # [BQ, 1]
+    delta = delta_ref[0, 0][0:1, :].reshape(-1, 1)
     bq, d = q.shape
     dq = jnp.zeros((bq, d), jnp.float32)
 
@@ -287,8 +295,10 @@ def _bwd_dkv_kernel(q_off_ref, k_off_ref, seed_ref, q_ref, k_ref, v_ref,
         dk, dv = carry
         q = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
         do = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, 0, 0:1,
+                      pl.ds(i * block_q, block_q)].reshape(-1, 1)
+        delta = delta_ref[0, 0, 0:1,
+                          pl.ds(i * block_q, block_q)].reshape(-1, 1)
         s = _dot(q, k, ((1,), (1,))) * scale
         # rows are q positions (loop index i), cols are this k block (kj)
         s = _mask_scores(s, causal, i, kj, q_off_ref, k_off_ref, block_q,
@@ -316,9 +326,12 @@ def _bwd(q, k, v, q_off, k_off, seed, out, lse, do, dlse, scale, causal,
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)               # [B, H, Lq, 1]
+                    axis=-1)                              # [B, H, Lq]
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
+    # 8-sublane replication at the kernel boundary (see _fwd_kernel note)
+    lse8 = jnp.broadcast_to(lse[:, :, None, :], (B, H, 8, Lq))
+    delta8 = jnp.broadcast_to(delta[:, :, None, :], (B, H, 8, Lq))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
@@ -327,14 +340,14 @@ def _bwd(q, k, v, q_off, k_off, seed, out, lse, do, dlse, scale, causal,
         grid=(B, H, Lq // block_q),
         in_specs=_qkv_fwd_specs(block_q, Lk, D) + [
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, h, i: (b, h, 0, i)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, h, i: (b, h, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
         interpret=_interpret(),
-    )(q_off, k_off, seed, q, k, v, do, lse, delta)
+    )(q_off, k_off, seed, q, k, v, do, lse8, delta8)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
@@ -349,8 +362,8 @@ def _bwd(q, k, v, q_off, k_off, seed, out, lse, do, dlse, scale, causal,
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, Lq, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Lq, 1), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Lq, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, 8, Lq), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, 8, Lq), lambda b, h, j: (b, h, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
@@ -361,7 +374,7 @@ def _bwd(q, k, v, q_off, k_off, seed, out, lse, do, dlse, scale, causal,
             jax.ShapeDtypeStruct((B, H, Lk, D), v.dtype),
         ],
         interpret=_interpret(),
-    )(q_off, k_off, seed, q, k, v, do, lse, delta)
+    )(q_off, k_off, seed, q, k, v, do, lse8, delta8)
     return dq, dk, dv
 
 
@@ -469,7 +482,7 @@ def flash_attention_block(q_bhld, k_bhld, v_bhld, q_off, k_off, scale,
                           block_q: int = 512, block_k: int = 512):
     """Ring-attention building block: [B, H, L, D] layout, traced global
     position offsets (float32 [1,1] arrays), always position-masked.
-    Returns (out normalized [B,H,L,D], lse [B,H,L,1]); fully-masked rows
+    Returns (out normalized [B,H,L,D], lse [B,H,L]); fully-masked rows
     give out=0, lse≈-inf — ready for logsumexp merging across rounds."""
     block_q = min(block_q, q_bhld.shape[2])
     block_k = min(block_k, k_bhld.shape[2])

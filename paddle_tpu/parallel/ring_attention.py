@@ -133,17 +133,17 @@ def ring_attention_per_device_flash(q, k, v, axis_name: str, is_causal: bool,
         o_b, lse_b = flash_attention_block(
             qt, jnp.swapaxes(k_blk, 1, 2), jnp.swapaxes(v_blk, 1, 2),
             q_off, k_off, scale)
-        lse_new = jnp.logaddexp(lse, lse_b)
+        lse_new = jnp.logaddexp(lse, lse_b)               # [B, H, Lq]
         finite = jnp.isfinite(lse_new)
         w_old = jnp.where(finite, jnp.exp(lse - lse_new), 0.0)
         w_new = jnp.where(finite, jnp.exp(lse_b - lse_new), 0.0)
-        o = o * w_old + o_b.astype(jnp.float32) * w_new
+        o = o * w_old[..., None] + o_b.astype(jnp.float32) * w_new[..., None]
         k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
         return (k_nxt, v_nxt, o, lse_new), None
 
     o0 = jnp.zeros((B, H, Lq, D), jnp.float32)
-    lse0 = jnp.full((B, H, Lq, 1), -jnp.inf, jnp.float32)
+    lse0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
     (_, _, o, _), _ = jax.lax.scan(step, (k, v, o0, lse0), jnp.arange(S))
     return jnp.swapaxes(o, 1, 2).astype(q.dtype)
 
