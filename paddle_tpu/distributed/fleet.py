@@ -221,4 +221,8 @@ class _FleetUtil:
         return files[i::w]
 
 
+# fleet-side dataset entry points (reference: fleet.DatasetFactory)
+from ..io.dataset_dist import (DatasetFactory, InMemoryDataset,  # noqa: E402
+                               QueueDataset)
+
 fleet = Fleet()

@@ -398,3 +398,7 @@ class DataLoader:
 
 def get_worker_info():
     return None  # single-process loader: no worker context
+
+# distributed dataset family (reference: fluid/dataset.py + data_set.h)
+from .dataset_dist import (DatasetFactory, InMemoryDataset,  # noqa: F401,E402
+                           QueueDataset)

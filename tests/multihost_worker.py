@@ -70,6 +70,34 @@ def main():
         "EXPECT_LOSSES", "").split(",") if v]
     if expect:
         np.testing.assert_allclose(losses, expect, rtol=2e-4)
+
+    # distributed data pipeline: each process loads a disjoint file
+    # shard and global-shuffles across the two REAL processes (spool
+    # protocol over the shared dir); the parent test checks the union
+    data_dir = os.environ.get("DATASET_DIR")
+    if data_dir:
+        import json
+
+        from paddle_tpu.io import InMemoryDataset
+        ds = InMemoryDataset()  # rank/world from PADDLE_TRAINER_* env
+        assert ds._rank == dist.get_rank() and ds._world == 2
+        files = sorted(
+            os.path.join(data_dir, f) for f in os.listdir(data_dir)
+            if f.startswith("part-"))
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        pre = list(ds)
+        for epoch in (0, 1):
+            ds.set_epoch(epoch)
+            ds.global_shuffle(
+                spool_dir=os.path.join(data_dir, "spool"))
+            with open(os.path.join(
+                    data_dir, f"out_e{epoch}_r{ds._rank}.json"),
+                    "w") as f:
+                json.dump(list(ds), f)
+            # reload the raw shard so each epoch shuffles the same base
+            ds.load_into_memory()
+        assert list(ds) == pre
     print(f"rank {dist.get_rank()} OK losses={losses}", flush=True)
 
 

@@ -1,0 +1,219 @@
+"""Distributed dataset / global shuffle (reference: data_set.h:43-211,
+GlobalShuffle :111; fluid/dataset.py DatasetFactory).
+
+The cross-worker protocol is exercised two ways: simulated workers in
+threads here (shared tmpdir spool), and two REAL launched processes in
+test_multihost.py."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DatasetFactory, InMemoryDataset, QueueDataset
+
+
+def _write_files(tmp_path, n_files=4, per_file=5):
+    files, all_recs = [], []
+    for i in range(n_files):
+        p = os.path.join(str(tmp_path), f"part-{i:03d}.txt")
+        lines = [f"f{i}r{j}" for j in range(per_file)]
+        with open(p, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        files.append(p)
+        all_recs.extend(lines)
+    return files, all_recs
+
+
+def test_factory_and_load(tmp_path):
+    files, recs = _write_files(tmp_path)
+    ds = DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    assert list(ds) == recs
+    assert len(ds) == len(recs) and ds[0] == "f0r0"
+    assert ds.get_memory_data_size() == len(recs)
+    with pytest.raises(ValueError):
+        DatasetFactory().create_dataset("NopeDataset")
+
+
+def test_single_worker_global_shuffle_is_seeded_permutation(tmp_path):
+    files, recs = _write_files(tmp_path)
+    ds = InMemoryDataset()
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    ds.global_shuffle(seed=3)
+    out1 = list(ds)
+    assert out1 != recs and sorted(out1) == sorted(recs)
+    ds.release_memory()
+    ds.load_into_memory()
+    ds.global_shuffle(seed=3)
+    assert list(ds) == out1  # deterministic
+    ds.load_into_memory()
+    ds.global_shuffle(seed=4)
+    assert list(ds) != out1  # seed-sensitive
+
+
+def test_requires_load_before_shuffle(tmp_path):
+    ds = InMemoryDataset()
+    ds.set_filelist([])
+    with pytest.raises(RuntimeError):
+        ds.global_shuffle(seed=0)
+
+
+def _run_workers(files, tmp_path, world, seed, epoch=None):
+    """Run `world` simulated workers concurrently; return per-rank
+    records.  Threads are required: the spool protocol has real
+    sentinel-file barriers."""
+    results = [None] * world
+    errors = []
+
+    def work(rank):
+        try:
+            ds = InMemoryDataset(rank=rank, world_size=world)
+            ds.set_filelist(files)
+            ds.load_into_memory()
+            if epoch is not None:
+                ds.set_epoch(epoch)
+                ds.global_shuffle(spool_dir=str(tmp_path))
+            else:
+                ds.global_shuffle(seed=seed, spool_dir=str(tmp_path))
+            results[rank] = list(ds)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(r,))
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert all(r is not None for r in results)
+    return results
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_multiworker_global_shuffle_exact_once(tmp_path, world):
+    files, recs = _write_files(tmp_path, n_files=5, per_file=4)
+    spool = tmp_path / "spool1"
+    spool.mkdir()
+    shards = _run_workers(files, spool, world, seed=11)
+    union = [r for shard in shards for r in shard]
+    # disjoint, exactly-once union — the GlobalShuffle contract
+    assert sorted(union) == sorted(recs)
+    assert len(set(union)) == len(recs)
+    # balanced within 1
+    sizes = [len(s) for s in shards]
+    assert max(sizes) - min(sizes) <= 1
+    # deterministic: same seed in a fresh spool -> identical shards
+    spool2 = tmp_path / "spool2"
+    spool2.mkdir()
+    again = _run_workers(files, spool2, world, seed=11)
+    assert again == shards
+    # a different epoch seed reshuffles
+    spool3 = tmp_path / "spool3"
+    spool3.mkdir()
+    other = _run_workers(files, spool3, world, seed=12)
+    assert other != shards
+    assert sorted(r for s in other for r in s) == sorted(recs)
+
+
+def test_repeated_shuffle_same_spool_same_seed(tmp_path):
+    """Persistent datasets re-shuffling with the SAME seed in the SAME
+    spool dir: the generation counter must keep the sentinel barriers
+    from matching a previous call's files, and rank 0 reaps the finished
+    previous generation."""
+    files, recs = _write_files(tmp_path, n_files=4, per_file=3)
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    world = 2
+    dss = [InMemoryDataset(rank=r, world_size=world) for r in range(world)]
+    for ds in dss:
+        ds.set_filelist(files)
+
+    rounds = []
+    for _ in range(3):
+        results = [None] * world
+        def work(rank):
+            dss[rank].load_into_memory()
+            dss[rank].global_shuffle(seed=42, spool_dir=str(spool))
+            results[rank] = list(dss[rank])
+        ts = [threading.Thread(target=work, args=(r,))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert all(r is not None for r in results)
+        union = [x for s in results for x in s]
+        assert sorted(union) == sorted(recs)
+        rounds.append(results)
+    assert rounds[0] == rounds[1] == rounds[2]  # same seed, same result
+    # generations 0 and 1 were reaped after later rounds completed
+    left = sorted(os.listdir(spool))
+    assert left == ["gs_2_42"], left
+
+
+def test_epoch_folded_seed(tmp_path):
+    files, recs = _write_files(tmp_path, n_files=4, per_file=3)
+    spool_a = tmp_path / "ea"
+    spool_a.mkdir()
+    e0 = _run_workers(files, spool_a, 2, seed=None, epoch=0)
+    spool_b = tmp_path / "eb"
+    spool_b.mkdir()
+    e1 = _run_workers(files, spool_b, 2, seed=None, epoch=1)
+    assert e0 != e1
+    assert (sorted(r for s in e1 for r in s) == sorted(recs))
+
+
+def test_pipe_command_and_parse_fn(tmp_path):
+    files, _ = _write_files(tmp_path, n_files=1, per_file=3)
+    ds = InMemoryDataset()
+    ds.set_filelist(files)
+    # reference pipe semantics: file bytes | shell command -> lines
+    ds.set_pipe_command("sed s/^f/F/")
+    ds.set_parse_fn(lambda ln: ln.upper())
+    ds.load_into_memory()
+    assert list(ds) == ["F0R0", "F0R1", "F0R2"]
+
+
+def test_queue_dataset_streams_shard(tmp_path):
+    files, recs = _write_files(tmp_path, n_files=4, per_file=2)
+    a = QueueDataset(rank=0, world_size=2)
+    b = QueueDataset(rank=1, world_size=2)
+    for ds in (a, b):
+        ds.set_filelist(files)
+    got = list(a) + list(b)
+    assert sorted(got) == sorted(recs)
+    with pytest.raises(RuntimeError):
+        a.global_shuffle()
+    with pytest.raises(RuntimeError):
+        a.local_shuffle()
+
+
+def test_local_shuffle_decorrelates_ranks(tmp_path):
+    files, _ = _write_files(tmp_path, n_files=2, per_file=50)
+    a = InMemoryDataset(rank=0, world_size=2)
+    b = InMemoryDataset(rank=1, world_size=2)
+    for ds in (a, b):
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        ds.local_shuffle(seed=5)
+    # same seed, different ranks -> different orders (decorrelated)
+    assert [r[1:] for r in a] != [r[1:] for r in b]
+
+
+def test_dataloader_interop(tmp_path):
+    from paddle_tpu.io import DataLoader
+    files, recs = _write_files(tmp_path, n_files=2, per_file=8)
+    ds = InMemoryDataset()
+    ds.set_filelist(files)
+    ds.set_parse_fn(lambda ln: np.float32(len(ln)))
+    ds.load_into_memory()
+    ds.global_shuffle(seed=1)
+    dl = DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(dl)
+    assert len(batches) == 4
+    total = sum(float(np.asarray(b).sum()) for b in batches)
+    assert total == sum(float(len(r)) for r in recs)

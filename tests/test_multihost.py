@@ -20,7 +20,7 @@ def _free_port():
     return p
 
 
-def test_two_process_bootstrap_and_training():
+def test_two_process_bootstrap_and_training(tmp_path):
     import jax
     import jax.numpy as jnp
 
@@ -46,6 +46,17 @@ def test_two_process_bootstrap_and_training():
     os.environ["EXPECT_LOSSES"] = ",".join(f"{v:.8f}" for v in expect)
     # workers must not inherit this process's single-chip/cpu jax state
     os.environ.pop("XLA_FLAGS", None)
+
+    # dataset fixture for the cross-process global shuffle leg
+    data_dir = tmp_path / "dataset"
+    (data_dir / "spool").mkdir(parents=True)
+    all_recs = []
+    for i in range(5):
+        lines = [f"f{i}r{j}" for j in range(4)]
+        (data_dir / f"part-{i:03d}.txt").write_text(
+            "\n".join(lines) + "\n")
+        all_recs.extend(lines)
+    os.environ["DATASET_DIR"] = str(data_dir)
     try:
         # retry once with a fresh port: _free_port has a TOCTOU window
         # under parallel test runs
@@ -58,3 +69,18 @@ def test_two_process_bootstrap_and_training():
         os.environ.clear()
         os.environ.update(env_backup)
     assert rc == 0, f"multihost workers failed (exit {rc})"
+
+    # GlobalShuffle contract across two REAL processes (data_set.h:111):
+    # per-epoch the two shards are a disjoint exactly-once cover of the
+    # dataset, deterministic in the epoch seed, re-shuffled across epochs
+    import json
+    epochs = {}
+    for e in (0, 1):
+        shards = [json.loads((data_dir / f"out_e{e}_r{r}.json")
+                             .read_text()) for r in (0, 1)]
+        union = shards[0] + shards[1]
+        assert sorted(union) == sorted(all_recs)
+        assert len(set(union)) == len(all_recs)
+        assert abs(len(shards[0]) - len(shards[1])) <= 1
+        epochs[e] = union
+    assert epochs[0] != epochs[1]  # epoch seed reshuffles
