@@ -1,130 +1,15 @@
-"""paddle_tpu.text (reference: python/paddle/text/datasets/: imdb.py,
-wmt14.py, wmt16.py, conll05.py, movielens.py, uci_housing.py).
+"""paddle_tpu.text (reference: python/paddle/text/).
 
-Zero-egress: datasets synthesize deterministic corpora with realistic
-shapes/vocabulary when no local file is provided (documented divergence —
-the reference downloads from bcebos.com)."""
+``paddle.text.datasets`` holds real-format parsers for the 7 reference
+datasets (imdb, imikolov, movielens, conll05st, uci_housing, wmt14,
+wmt16) — see datasets.py.  Zero-egress divergence: archives must be
+local files in the ORIGINAL formats; there is no downloader.
+
+The native fast WordPiece tokenizer (C++ MaxMatch) lives in
+fast_tokenizer.py."""
 from __future__ import annotations
 
-import os
-
-import numpy as np
-
-from ..io import Dataset
-
-
-class _SyntheticSeqDataset(Dataset):
-    def __init__(self, n, seq_len, vocab_size, num_classes, seed):
-        rs = np.random.RandomState(seed)
-        self.data = rs.randint(1, vocab_size, (n, seq_len)).astype(np.int64)
-        self.labels = rs.randint(0, num_classes, n).astype(np.int64)
-        # weak signal: class parity of token sums
-        for i in range(n):
-            self.labels[i] = int(self.data[i].sum() % num_classes)
-
-    def __getitem__(self, idx):
-        return self.data[idx], np.asarray(self.labels[idx])
-
-    def __len__(self):
-        return len(self.data)
-
-
-class Imdb(_SyntheticSeqDataset):
-    """reference: text/datasets/imdb.py (binary sentiment)."""
-
-    def __init__(self, data_file=None, mode="train", cutoff=150):
-        self.vocab_size = 5147
-        super().__init__(2000 if mode == "train" else 500, 128,
-                         self.vocab_size, 2,
-                         seed=10 if mode == "train" else 11)
-        self.word_idx = {f"w{i}": i for i in range(self.vocab_size)}
-
-
-class WMT14(Dataset):
-    """reference: text/datasets/wmt14.py (en-fr pairs)."""
-
-    def __init__(self, data_file=None, mode="train", dict_size=30000):
-        self.dict_size = dict_size
-        rs = np.random.RandomState(20 if mode == "train" else 21)
-        n = 1000 if mode == "train" else 200
-        self.src = rs.randint(3, dict_size, (n, 24)).astype(np.int64)
-        self.tgt = rs.randint(3, dict_size, (n, 24)).astype(np.int64)
-
-    def __getitem__(self, idx):
-        src = self.src[idx]
-        tgt = self.tgt[idx]
-        return src, tgt[:-1], tgt[1:]
-
-    def __len__(self):
-        return len(self.src)
-
-    def get_dict(self, lang="en", reverse=False):
-        d = {f"tok{i}": i for i in range(self.dict_size)}
-        return {v: k for k, v in d.items()} if reverse else d
-
-
-class WMT16(WMT14):
-    pass
-
-
-class UCIHousing(Dataset):
-    """reference: text/datasets/uci_housing.py (13-feature regression)."""
-
-    def __init__(self, data_file=None, mode="train"):
-        if data_file and os.path.exists(data_file):
-            raw = np.loadtxt(data_file).astype(np.float32)
-        else:
-            rs = np.random.RandomState(30)
-            X = rs.rand(506, 13).astype(np.float32)
-            w = rs.rand(13).astype(np.float32)
-            y = (X @ w + 0.1 * rs.rand(506)).astype(np.float32)
-            raw = np.concatenate([X, y[:, None]], axis=1)
-        n_train = int(len(raw) * 0.8)
-        self.data = raw[:n_train] if mode == "train" else raw[n_train:]
-
-    def __getitem__(self, idx):
-        row = self.data[idx]
-        return row[:-1], row[-1:]
-
-    def __len__(self):
-        return len(self.data)
-
-
-class Conll05st(_SyntheticSeqDataset):
-    def __init__(self, data_file=None, mode="train"):
-        super().__init__(500, 32, 5000, 10, seed=40)
-
-
-class Movielens(Dataset):
-    def __init__(self, data_file=None, mode="train"):
-        rs = np.random.RandomState(50)
-        n = 2000 if mode == "train" else 400
-        self.users = rs.randint(0, 944, n).astype(np.int64)
-        self.movies = rs.randint(0, 1683, n).astype(np.int64)
-        self.ratings = ((self.users * 7 + self.movies * 3) % 5 + 1
-                        ).astype(np.float32)
-
-    def __getitem__(self, idx):
-        return (self.users[idx], self.movies[idx],
-                np.asarray([self.ratings[idx]]))
-
-    def __len__(self):
-        return len(self.users)
-
-
-datasets = None  # namespacing below mirrors paddle.text.datasets.*
-
-
-class _DatasetsNS:
-    Imdb = Imdb
-    WMT14 = WMT14
-    WMT16 = WMT16
-    UCIHousing = UCIHousing
-    Conll05st = Conll05st
-    Movielens = Movielens
-
-
-datasets = _DatasetsNS()
-
-
-from .fast_tokenizer import FastWordPieceTokenizer  # noqa: F401,E402
+from . import datasets  # noqa: F401  (paddle.text.datasets.* namespace)
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
+                       UCIHousing, WMT14, WMT16)
+from .fast_tokenizer import FastWordPieceTokenizer  # noqa: F401

@@ -202,3 +202,131 @@ class ImageFolder(DatasetFolder):
         if self.transform is not None:
             img = self.transform(img)
         return img
+
+
+class _LazyTarReader:
+    """Per-thread tarfile handles over one archive path: a single shared
+    TarFile is neither picklable (DataLoader worker processes) nor
+    thread-safe (the prefetch threads seek one shared offset).  TarInfo
+    members carry their own data offsets, so any handle can serve any
+    member; the handle cache is excluded from pickling."""
+
+    def _init_tar(self, data_file):
+        import tarfile
+        import threading
+        self._tar_path = data_file
+        self._tar_local = threading.local()
+        with tarfile.open(data_file) as tf:
+            self.name2mem = {m.name: m for m in tf.getmembers()}
+
+    def _read_member(self, name):
+        import tarfile
+        tf = getattr(self._tar_local, "tf", None)
+        if tf is None:
+            tf = tarfile.open(self._tar_path)
+            self._tar_local.tf = tf
+        return tf.extractfile(self.name2mem[name]).read()
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_tar_local", None)
+        return state
+
+    def __setstate__(self, state):
+        import threading
+        self.__dict__.update(state)
+        self._tar_local = threading.local()
+
+
+class Flowers(_LazyTarReader, Dataset):
+    """reference: vision/datasets/flowers.py:47 (102flowers jpg tarball +
+    imagelabels.mat 'labels' + setid.mat subset indices; NOTE the
+    reference maps train->'tstid' and test->'trnid' on purpose — the
+    official split has more test data, flowers.py:37-40).  Images decode
+    lazily per __getitem__, exactly like the reference."""
+
+    MODE_FLAG_MAP = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True,
+                 backend="cv2"):
+        assert mode.lower() in self.MODE_FLAG_MAP, mode
+        self.flag = self.MODE_FLAG_MAP[mode.lower()]
+        self.transform = transform
+        for name, p in (("data_file", data_file),
+                        ("label_file", label_file),
+                        ("setid_file", setid_file)):
+            if p is None or not os.path.exists(p):
+                raise ValueError(
+                    f"Flowers: {name} must point at a local file "
+                    f"(102flowers.tgz / imagelabels.mat / setid.mat; no "
+                    f"downloads in this environment), got {p!r}")
+        import scipy.io as scio
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[self.flag][0]
+        self._init_tar(data_file)
+
+    def _decode(self, raw):
+        import io as _io
+
+        from PIL import Image
+        with Image.open(_io.BytesIO(raw)) as im:
+            return np.asarray(im.convert("RGB"))
+
+    def __getitem__(self, idx):
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]])
+        img = self._decode(self._read_member(f"jpg/image_{index:05d}.jpg"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label.astype(np.int64)
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(_LazyTarReader, Dataset):
+    """reference: vision/datasets/voc2012.py:40 (VOCdevkit tar;
+    ImageSets/Segmentation/{flag}.txt name lists; JPEGImages/{name}.jpg
+    inputs and SegmentationClass/{name}.png masks; train->'trainval',
+    test->'train', valid->'val' per voc2012.py:37)."""
+
+    SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+    MODE_FLAG_MAP = {"train": "trainval", "test": "train", "valid": "val"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        assert mode.lower() in self.MODE_FLAG_MAP, mode
+        self.flag = self.MODE_FLAG_MAP[mode.lower()]
+        self.transform = transform
+        if data_file is None or not os.path.exists(data_file):
+            raise ValueError(
+                f"VOC2012: data_file must point at a local VOCtrainval "
+                f"tar (no downloads in this environment), got "
+                f"{data_file!r}")
+        self._init_tar(data_file)
+        names = self._read_member(self.SET_FILE.format(self.flag))
+        self.name_list = [ln.strip() for ln in names.decode().splitlines()
+                          if ln.strip()]
+
+    def _decode(self, raw, mode):
+        import io as _io
+
+        from PIL import Image
+        with Image.open(_io.BytesIO(raw)) as im:
+            return np.asarray(im if mode is None else im.convert(mode))
+
+    def __getitem__(self, idx):
+        name = self.name_list[idx]
+        image = self._decode(
+            self._read_member(self.DATA_FILE.format(name)), "RGB")
+        label = self._decode(
+            self._read_member(self.LABEL_FILE.format(name)), None)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label.astype(np.int64)
+
+    def __len__(self):
+        return len(self.name_list)

@@ -236,17 +236,37 @@ def test_transforms():
 
 # ---------------- text ----------------
 
-def test_text_datasets():
-    from paddle_tpu.text import Imdb, UCIHousing, WMT14
-    ds = Imdb(mode="train")
+def test_text_datasets(tmp_path):
+    """Real-format fixtures through the public loaders (the deep format
+    tests live in test_text_datasets.py)."""
+    import io as _io
+    import tarfile
+
+    from paddle_tpu.text import Imdb, UCIHousing
+
+    def _add(tf, name, data):
+        info = tarfile.TarInfo(name)
+        info.size = len(data)
+        tf.addfile(info, _io.BytesIO(data))
+
+    p = str(tmp_path / "aclImdb_v1.tar.gz")
+    with tarfile.open(p, "w:gz") as tf:
+        for i in range(4):
+            sub = "pos" if i % 2 == 0 else "neg"
+            _add(tf, f"aclImdb/train/{sub}/{i}.txt",
+                 b"fine movie " * 8)
+    ds = Imdb(data_file=p, mode="train", cutoff=2)
     x, y = ds[0]
-    assert x.shape == (128,) and int(y) in (0, 1)
-    h = UCIHousing(mode="test")
+    assert x.shape == (16,) and int(y) in (0, 1)
+
+    hp = str(tmp_path / "housing.data")
+    rows = np.random.RandomState(0).rand(20, 14)
+    with open(hp, "w") as f:
+        for r in rows:
+            f.write(" ".join(f"{v:.5f}" for v in r) + "\n")
+    h = UCIHousing(data_file=hp, mode="test")
     feat, target = h[0]
     assert feat.shape == (13,) and target.shape == (1,)
-    w = WMT14(mode="train")
-    src, tin, tout = w[0]
-    assert src.shape == (24,) and tin.shape == (23,)
 
 
 # ---------------- hapi Model ----------------
