@@ -103,3 +103,41 @@ def next_key():
         scope[1] += 1
         return jax.random.fold_in(scope[0], scope[1])
     return _global_generator.next_key()
+
+
+class StableDraw:
+    """A random-op key source that is STABLE across re-executions of the
+    same op but still per-run under a :func:`seed_scope`.
+
+    Random ops (dropout and friends) must draw their key inside the
+    traced function so compiled programs (static Executor, TrainStep)
+    can thread a per-run key — but the eager tape's double-backward
+    replays the stored fn in Python, and a plain :func:`next_key` there
+    would advance the generator and regenerate a DIFFERENT mask than the
+    forward that produced the first-order grads.  A StableDraw fixes the
+    draw's identity at op-construction time (one generator tick) and
+    resolves it lazily:
+
+    - inside a seed_scope: ``fold_in(scope_key, id)`` — per-run via the
+      scope's (possibly traced) key, identical on every replay;
+    - eagerly: ``fold_in(base_key, id)`` — the same concrete key every
+      replay, matching the pre-scope behavior.
+    """
+
+    __slots__ = ("_id",)
+
+    def __init__(self):
+        g = _global_generator
+        with g._lock:
+            g._counter += 1
+            self._id = g._counter
+
+    def key(self):
+        scope = getattr(_tls, "scope", None)
+        if scope is not None:
+            return jax.random.fold_in(scope[0], self._id)
+        return jax.random.fold_in(_global_generator._base(), self._id)
+
+
+def stable_draw() -> StableDraw:
+    return StableDraw()

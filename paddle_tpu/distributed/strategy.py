@@ -240,3 +240,18 @@ def validate_toggles(strategy: "DistributedStrategy") -> None:
             "tables (paddle_tpu.parallel.ShardedEmbedding), which are "
             "synchronous by construction.  Use strategy.localsgd for "
             "reduced-frequency synchronisation.")
+    sm = strategy.pipeline_configs.schedule_mode
+    if sm not in ("1F1B", "F-then-B"):
+        raise ValueError(
+            f"pipeline_configs.schedule_mode must be '1F1B' or "
+            f"'F-then-B' (section_worker.cc:115-127), got {sm!r}")
+    if strategy.pipeline and sm == "F-then-B":
+        raise NotImplementedError(
+            "pipeline_configs.schedule_mode='F-then-B': the scan-based "
+            "pipeline (parallel/pipeline.py:17-29) differentiates one "
+            "fill-drain scan, which collapses the F-then-B/1F1B "
+            "distinction — the backward schedule is derived by autodiff "
+            "and in-flight state is O(microbatch) either way.  There is "
+            "no separate all-forwards-then-all-backwards executor to "
+            "select, so this knob cannot take effect; keep the default "
+            "'1F1B' (semantically what the compiled schedule delivers).")

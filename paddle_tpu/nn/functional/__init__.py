@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.dispatch import apply, as_array
-from ...core.rng import next_key
+from ...core.rng import next_key, stable_draw
 from ...core.tensor import Tensor
 from ...ops.manipulation import pad as _pad_op
 from ...ops.manipulation import squeeze, unsqueeze  # noqa: F401
@@ -127,9 +127,9 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
-    key = next_key()
+    draw = stable_draw()  # in-trace + replay-stable (see core.rng)
     def _gs(a):
-        g = jax.random.gumbel(key, a.shape, a.dtype)
+        g = jax.random.gumbel(draw.key(), a.shape, a.dtype)
         y = jax.nn.softmax((a + g) / temperature, axis=axis)
         if hard:
             idx = jnp.argmax(y, axis=axis)
@@ -704,9 +704,15 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return apply(lambda a: a * jnp.asarray(1.0 - p, a.dtype), x,
                          op_name="dropout")
         return x if isinstance(x, Tensor) else Tensor(x)
-    key = next_key()
+
+    draw = stable_draw()
 
     def _dropout(a):
+        # key resolved INSIDE the traced fn: under a seed_scope
+        # (TrainStep, static Executor runs) it folds the per-run key so
+        # static programs reseed per exe.run; the StableDraw identity
+        # keeps double-backward tape replays on the SAME mask
+        key = draw.key()
         shape = list(a.shape)
         if axis is not None:
             axes = axis if isinstance(axis, (list, tuple)) else [axis]
@@ -732,13 +738,13 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
 def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return x if isinstance(x, Tensor) else Tensor(x)
-    key = next_key()
+    draw = stable_draw()  # in-trace + replay-stable (see core.rng)
 
     def _ad(a):
         alpha = 1.6732632423543772848170429916717
         scale = 1.0507009873554804934193349852946
         neg = -alpha * scale
-        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        keep = jax.random.bernoulli(draw.key(), 1.0 - p, a.shape)
         q = 1.0 - p
         A = (q + neg ** 2 * q * p) ** -0.5
         B = -A * p * neg
@@ -1055,21 +1061,27 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         if flash_attention_supported(q_shape, k_shape, dtype, attn_mask,
                                      eff_dropout):
             if eff_dropout > 0.0:
-                sd = jax.random.bits(next_key(), (1, 1),
-                                     jnp.uint32).astype(jnp.int32)
+                fdraw = stable_draw()  # in-trace + replay-stable seed
                 return apply(
-                    lambda q, k, v, s: flash_attention(
-                        q, k, v, causal=is_causal,
-                        dropout_p=eff_dropout, seed=s),
-                    query, key, value, Tensor(sd),
+                    lambda q, k, v: flash_attention(
+                        q, k, v, causal=is_causal, dropout_p=eff_dropout,
+                        seed=jax.random.bits(fdraw.key(), (1, 1),
+                                             jnp.uint32)
+                        .astype(jnp.int32)),
+                    query, key, value,
                     op_name="flash_attention")
             return apply(
                 lambda q, k, v: flash_attention(q, k, v, causal=is_causal),
                 query, key, value, op_name="flash_attention")
 
-    dkey = next_key() if (dropout_p > 0.0 and training) else None
+    use_dropout = dropout_p > 0.0 and training
+
+    sdpa_draw = stable_draw() if use_dropout else None
 
     def _sdpa(q, k, v, *m):
+        # key resolved in-trace (see dropout): static/jitted programs
+        # fold the per-run key instead of a record-time constant
+        dkey = sdpa_draw.key() if use_dropout else None
         mask = m[0] if m else None
         B, Lq, H, D = q.shape
         scale = 1.0 / math.sqrt(D)

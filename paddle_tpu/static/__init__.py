@@ -8,9 +8,11 @@ style static training script — data → layers → loss → minimize →
 ``exe.run(feed, fetch_list)`` — compiles to a single donated XLA
 computation per feed signature.
 
+Random ops (dropout) reseed per ``exe.run`` — the Executor threads a
+per-run key through ``rng.seed_scope`` (reference static dropout
+semantics); pass ``exe.run(seed=...)`` to reproduce a specific run.
+
 Known deviations (documented, by design):
-- random ops (dropout) draw their key at build time — static programs are
-  deterministic per build (reference static dropout has per-run seeds).
 - dygraph Layers with running-stat buffers (BatchNorm) keep their eager
   buffers constant inside a static program; use static.nn.batch_norm or
   dygraph mode for running-stat training.
@@ -29,18 +31,94 @@ __all__ = [
     "Program", "Variable", "data", "default_main_program",
     "default_startup_program", "program_guard", "Executor",
     "global_scope", "save_inference_model", "load_inference_model",
-    "InputSpec", "nn", "CompiledProgram", "reset_default_programs",
+    "InputSpec", "nn", "BuildStrategy", "CompiledProgram",
+    "reset_default_programs",
 ]
+
+
+class BuildStrategy:
+    """reference: fluid/compiler.py BuildStrategy (pass toggles consumed
+    by ParallelExecutor's graph passes).
+
+    On TPU every listed pass is XLA's job and runs UNCONDITIONALLY as
+    part of normal compilation, so the toggles in ``_ABSORBED`` are
+    accepted (setting them is satisfied by construction).  Knobs that
+    would select a *different execution strategy* the XLA design does
+    not have raise loudly instead of being swallowed (round-3 rule:
+    every toggle real or loud)."""
+
+    # reference pass -> what XLA does instead, always on
+    _ABSORBED = {
+        "fuse_elewise_add_act_ops": "XLA elementwise fusion",
+        "fuse_bn_act_ops": "XLA elementwise fusion",
+        "fuse_bn_add_act_ops": "XLA elementwise fusion",
+        "fuse_broadcast_ops": "XLA fusion",
+        "fuse_all_optimizer_ops": "whole-step jit (one executable)",
+        "fuse_all_reduce_ops": "GSPMD collective combining",
+        "fuse_relu_depthwise_conv": "XLA conv fusion",
+        "enable_inplace": "XLA buffer assignment + donation",
+        "memory_optimize": "XLA buffer reuse",
+        "enable_auto_fusion": "XLA fusion",
+        "cache_runtime_context": "compiled-executable caching",
+        "sync_batch_norm": "mesh-wide psum in nn.SyncBatchNorm",
+        "enable_addto": "XLA buffer assignment",
+    }
+    _UNSUPPORTED = {
+        "reduce_strategy": "Reduce-mode grad scattering (vs AllReduce) — "
+                           "sharded grads are strategy.sharding (ZeRO)",
+        "gradient_scale_strategy": "customized per-device loss scaling — "
+                                   "scale inside the loss function",
+        "build_cuda_graph": "CUDA-only",
+        "fused_attention": "use FLAGS_use_pallas_kernels (flash kernel)",
+        "fused_feedforward": "XLA fuses the FFN automatically",
+    }
+
+    def __init__(self):
+        for k in self._ABSORBED:
+            object.__setattr__(self, k, False)
+
+    def __setattr__(self, key, value):
+        if key in self._ABSORBED:
+            object.__setattr__(self, key, value)
+            return
+        if key in self._UNSUPPORTED:
+            raise NotImplementedError(
+                f"BuildStrategy.{key}: {self._UNSUPPORTED[key]} "
+                f"(no silent toggles — fluid/compiler.py parity shim)")
+        raise AttributeError(
+            f"BuildStrategy has no toggle {key!r}; known toggles: "
+            f"{sorted(self._ABSORBED)}")
 
 
 class CompiledProgram:
     """Parity shim (reference: fluid/compiler.py CompiledProgram): the
-    Executor already compiles whole programs; this wrapper exists so
-    reference scripts run unchanged."""
+    Executor already compiles whole programs in one jit, so compilation
+    itself needs no wrapper.  ``build_strategy`` is VALIDATED, not
+    ignored: pass toggles XLA subsumes are accepted, anything else
+    raises (see BuildStrategy)."""
 
     def __init__(self, program, build_strategy=None):
         self._program = program
+        if build_strategy is not None and not isinstance(build_strategy,
+                                                         BuildStrategy):
+            raise TypeError(
+                f"CompiledProgram(build_strategy=...) expects a "
+                f"paddle.static.BuildStrategy (got "
+                f"{type(build_strategy).__name__}); its toggles are "
+                f"checked against what XLA actually does — there is no "
+                f"silent pass-through")
         self._build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        """reference: compiler.py with_data_parallel — superseded by the
+        SPMD path; raises to avoid pretending multi-device replication
+        happened (use paddle_tpu.parallel.SpmdTrainStep)."""
+        raise NotImplementedError(
+            "CompiledProgram.with_data_parallel: multi-device execution "
+            "is SPMD over a mesh (parallel.SpmdTrainStep / "
+            "static.Executor runs one donated XLA program); replicated "
+            "ParallelExecutor graphs do not exist in this design")
 
     def __getattr__(self, item):
         return getattr(self._program, item)

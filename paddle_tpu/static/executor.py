@@ -83,6 +83,7 @@ class Executor:
         self.place = place
         self._cache: Dict[tuple, object] = {}
         self._opt_states: Dict[int, list] = {}
+        self._run_counts: Dict[int, int] = {}
 
     def close(self):
         self._cache.clear()
@@ -90,7 +91,7 @@ class Executor:
     # -- main entry --------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed=None,
             fetch_list: Optional[Sequence] = None, return_numpy=True,
-            **unused):
+            seed=None, **unused):
         # loaded inference programs (load_inference_model) call through
         if hasattr(program, "_run_loaded"):
             return program._run_loaded(feed, fetch_list, return_numpy)
@@ -123,6 +124,15 @@ class Executor:
             compiled = self._build(program, params, feed_names, fetch_names)
             self._cache[key] = compiled
 
+        # per-run randomness (reference: static dropout reseeds per run):
+        # random ops in the program fold this key via seed_scope; an
+        # explicit ``seed`` reproduces a run, the default auto-increments
+        run_i = self._run_counts.get(id(program), 0) + 1
+        self._run_counts[id(program)] = run_i
+        rng_key = jax.random.fold_in(
+            jax.random.PRNGKey(program.random_seed),
+            run_i if seed is None else int(seed))
+
         p_arrays = [p.data for p in params]
         if program._optimizer is not None:
             opt = program._optimizer[0]
@@ -134,12 +144,12 @@ class Executor:
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             step_i = jnp.asarray(opt._step_count, jnp.float32)
             fetches, new_p, new_state = compiled(
-                p_arrays, state, lr, step_i, *feed_arrays)
+                p_arrays, state, lr, step_i, rng_key, *feed_arrays)
             self._opt_states[id(program)] = new_state
             for p, arr in zip(params, new_p):
                 p.data = arr
         else:
-            fetches = compiled(p_arrays, *feed_arrays)
+            fetches = compiled(p_arrays, rng_key, *feed_arrays)
 
         if return_numpy:
             return [np.asarray(f) for f in fetches]
@@ -157,10 +167,14 @@ class Executor:
             pmap = {id(p): a for p, a in zip(params, p_arrays)}
             return _interp(nodes, env, pmap)
 
+        from ..core import rng as _rng
+
         if opt_pack is None:
             @jax.jit
-            def run_fn(p_arrays, *feed_arrays):
-                env = forward_env(p_arrays, feed_arrays)
+            def run_fn(p_arrays, rng_key, *feed_arrays):
+                # random ops (dropout) draw from the per-run key
+                with _rng.seed_scope(rng_key):
+                    env = forward_env(p_arrays, feed_arrays)
                 return [env[n] for n in fetch_names]
             return run_fn
 
@@ -181,14 +195,16 @@ class Executor:
         params_meta = [params[i] for i in t_idx]
 
         @jax.jit
-        def train_fn(p_arrays, opt_state, lr, step_i, *feed_arrays):
+        def train_fn(p_arrays, opt_state, lr, step_i, rng_key,
+                     *feed_arrays):
             p_arrays = list(p_arrays)
 
             def loss_of(tlist):
                 full = list(p_arrays)
                 for j, a in zip(t_idx, tlist):
                     full[j] = a
-                env = forward_env(full, feed_arrays)
+                with _rng.seed_scope(rng_key):
+                    env = forward_env(full, feed_arrays)
                 return env[loss_var.name], env
 
             t_arrays = [p_arrays[i] for i in t_idx]

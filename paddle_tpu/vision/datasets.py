@@ -214,10 +214,32 @@ class _LazyTarReader:
     def _init_tar(self, data_file):
         import tarfile
         import threading
-        self._tar_path = data_file
+        self._tar_path = self._ensure_seekable(data_file)
         self._tar_local = threading.local()
-        with tarfile.open(data_file) as tf:
+        with tarfile.open(self._tar_path) as tf:
             self.name2mem = {m.name: m for m in tf.getmembers()}
+
+    @staticmethod
+    def _ensure_seekable(data_file):
+        """gzip has no random access: a seek backwards inside a .tgz
+        re-decompresses from byte 0, making shuffled epochs
+        quasi-quadratic.  Decompress ONCE to an uncompressed temp tar
+        and serve offsets from that (deleted at interpreter exit)."""
+        import gzip as _gz
+        with open(data_file, "rb") as f:
+            magic = f.read(2)
+        if magic != b"\x1f\x8b":
+            return data_file
+        import atexit
+        import shutil
+        import tempfile
+        tmp = tempfile.NamedTemporaryFile(suffix=".tar", delete=False)
+        with _gz.open(data_file, "rb") as src:
+            shutil.copyfileobj(src, tmp)
+        tmp.close()
+        atexit.register(lambda p=tmp.name: os.path.exists(p)
+                        and os.unlink(p))
+        return tmp.name
 
     def _read_member(self, name):
         import tarfile

@@ -81,3 +81,47 @@ def test_first_backward_frees_replay():
     assert node.replay is not None
     y.backward()
     assert node.replay is None and node.vjp_fn is None
+
+
+def test_dropout_double_backward_replays_same_mask():
+    """create_graph replay must regenerate the IDENTICAL dropout mask:
+    the tape re-executes the op fn in Python, and a naive in-trace key
+    draw would advance the generator and differentiate a different
+    forward (core.rng.StableDraw keeps the draw identity fixed)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(77)
+    x = paddle.to_tensor(np.ones((64, 64), np.float32),
+                         stop_gradient=False)
+    y = F.dropout(x, p=0.5, training=True)
+    g = paddle.grad(y.sum(), x, create_graph=True)[0]
+    # y = x * mask -> g == mask (0 or 2); second-order pass replays the
+    # dropout fn to rebuild its vjp: the replayed mask must match
+    h = paddle.grad((g * x).sum(), x)[0]
+    np.testing.assert_array_equal(np.asarray(h.data), np.asarray(g.data))
+    assert set(np.unique(np.asarray(g.data))) == {0.0, 2.0}
+
+
+def test_stable_draw_semantics():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import rng
+
+    kd = jax.random.key_data  # PRNGKey arrays don't coerce to numpy
+
+    d = rng.stable_draw()
+    # eager: same key on every resolve (replay determinism)
+    np.testing.assert_array_equal(kd(d.key()), kd(d.key()))
+    d2 = rng.stable_draw()
+    assert not np.array_equal(kd(d.key()), kd(d2.key()))  # distinct
+    # under a seed_scope: folds the scope key, still replay-stable
+    with rng.seed_scope(jax.random.PRNGKey(1)):
+        a = d.key()
+        b = d.key()
+    np.testing.assert_array_equal(kd(a), kd(b))
+    assert not np.array_equal(kd(a), kd(d.key()))  # scope changes key
+    with rng.seed_scope(jax.random.PRNGKey(2)):
+        c = d.key()
+    assert not np.array_equal(kd(a), kd(c))  # per-run keys differ

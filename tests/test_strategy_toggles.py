@@ -393,3 +393,70 @@ def test_fleet_save_inference_model_loud_without_model():
     f = Fleet()
     with pytest.raises(ValueError, match="no model"):
         f.save_inference_model(dirname="/tmp/x")
+
+
+# ---- round-5 knob kills (VERDICT r4 #4): work or raise, never silent ----
+
+def test_schedule_mode_f_then_b_raises():
+    from paddle_tpu.distributed.strategy import (DistributedStrategy,
+                                                 validate_toggles)
+    s = DistributedStrategy()
+    s.pipeline = True
+    s.pipeline_configs.schedule_mode = "F-then-B"
+    with pytest.raises(NotImplementedError, match="F-then-B"):
+        validate_toggles(s)
+    # default 1F1B passes; unknown value rejected outright
+    s.pipeline_configs.schedule_mode = "1F1B"
+    validate_toggles(s)
+    s.pipeline_configs.schedule_mode = "zigzag"
+    with pytest.raises(ValueError, match="schedule_mode"):
+        validate_toggles(s)
+
+
+def test_build_strategy_absorbed_vs_unsupported():
+    from paddle_tpu import static
+    bs = static.BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True     # XLA does this: accepted
+    bs.memory_optimize = True
+    prog = static.Program()
+    cp = static.CompiledProgram(prog, build_strategy=bs)
+    assert cp._build_strategy is bs
+    with pytest.raises(NotImplementedError, match="reduce_strategy"):
+        bs.reduce_strategy = 1
+    with pytest.raises(AttributeError, match="no toggle"):
+        bs.totally_made_up = True
+    with pytest.raises(TypeError, match="BuildStrategy"):
+        static.CompiledProgram(prog, build_strategy=object())
+    with pytest.raises(NotImplementedError, match="with_data_parallel"):
+        cp.with_data_parallel(loss_name="loss")
+
+
+def test_static_dropout_reseeds_per_run():
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    import paddle_tpu.nn.functional as F
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [64, 64], "float32")
+            y = F.dropout(x, p=0.5, training=True)
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((64, 64), np.float32)}
+        a = exe.run(main, feed=feed, fetch_list=[y])[0]
+        b = exe.run(main, feed=feed, fetch_list=[y])[0]
+        # per-run reseed: masks differ between runs (4096 cells — equal
+        # masks would mean the key was baked at build time)
+        assert (a != b).any()
+        assert set(np.unique(a)) <= {0.0, 2.0}
+        # explicit seed reproduces a run exactly
+        c = exe.run(main, feed=feed, fetch_list=[y], seed=123)[0]
+        d = exe.run(main, feed=feed, fetch_list=[y], seed=123)[0]
+        np.testing.assert_array_equal(c, d)
+        e = exe.run(main, feed=feed, fetch_list=[y], seed=124)[0]
+        assert (c != e).any()
+    finally:
+        paddle.disable_static()

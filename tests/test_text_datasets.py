@@ -277,7 +277,7 @@ def test_wmt16_builds_and_caches_dicts(tmp_path):
     assert trg_next.tolist() == [3, 1]
     # lang='de' swaps columns
     de = WMT16(data_file=p, mode="val", src_dict_size=5, trg_dict_size=5,
-               lang="de")
+               lang="de", dict_cache_dir=str(cache))
     s2 = de[0][0]
     assert s2.tolist()[1] == de.src_dict.get("welt", 2)
 
@@ -286,6 +286,6 @@ def test_wmt16_get_dict_reverse(tmp_path):
     p = str(tmp_path / "wmt16.tar")
     _make_wmt16(p)
     ds = WMT16(data_file=p, mode="train", src_dict_size=5,
-               trg_dict_size=5)
+               trg_dict_size=5, dict_cache_dir=str(tmp_path))
     rev = ds.get_dict("en", reverse=True)
     assert rev[3] == "hello"

@@ -169,7 +169,8 @@ class Seq2Seq(nn.Layer):
 
 def test_wmt16_seq2seq_beam_decode_smoke(wmt16_file):
     ds = WMT16(data_file=wmt16_file, mode="test", src_dict_size=200,
-               trg_dict_size=200)
+               trg_dict_size=200,
+               dict_cache_dir=os.path.dirname(wmt16_file))
     src, tgt_in, tgt_out = ds[0]
     assert src.shape == (24,) and tgt_in.shape == (23,)
 
