@@ -27,10 +27,18 @@ Loops (reference: loop_transformer.py + break_continue_transformer.py):
 4. ``for i in range(...): <assign-only body>`` → the same, with a
    synthetic counter carry (``range`` over a traced tensor bound works
    after conversion — it would be a TypeError in plain Python);
-5. a single ``if c: break`` / ``if c: continue`` as the first statement,
-   or ``if c: break`` as the last statement of the loop body → a carried
-   done-flag and predicated (select) state updates, the
-   break_continue_transformer's early-exit semantics.
+5. exit-ifs — ``if c: [assignments;] break|continue|return <expr>`` —
+   at ANY position in the loop body, any number of them
+   (break_continue_transformer + return_transformer semantics):
+   statements after an exit-if become the else-branch of a nested
+   ``_jst_cond``, break/return ride a carried done-flag in the loop
+   test, and an early ``return`` carries a value slot surfaced as
+   ``if flag: return value`` after the loop (fused with the trailing
+   return by a second if-pass);
+6. calls to USER functions (bare names resolvable at conversion time)
+   are routed through ``_jst_call`` (call_transformer parity): the
+   callee is converted too, lazily and memoized, so helpers with tensor
+   control flow work when invoked from a converted function.
 
 Loop-carried variables follow the reference's rule: every assigned name
 that is read by the loop test, read before it is written in the body, or
@@ -99,28 +107,6 @@ def _jst_or(a, b):
 def _jst_lt(a, b):
     av, bv = _jst_bool(a), _jst_bool(b)
     return av < bv
-
-
-def _jst_select(pred, old_vals, new_fn):
-    """Predicated state update for converted break/continue: keep
-    ``old_vals`` where ``pred`` holds, else the values ``new_fn``
-    computes.  Eager concrete predicate short-circuits in Python."""
-    if not _is_traced(pred):
-        return tuple(old_vals) if bool(_jst_bool(pred)) else tuple(
-            new_fn())
-    import jax.numpy as jnp
-
-    from ..core.tensor import Tensor
-    p = _jst_bool(pred)
-    new_vals = tuple(new_fn())
-    out = []
-    for o, n in zip(old_vals, new_vals):
-        od = o.data if isinstance(o, Tensor) else o
-        nd = n.data if isinstance(n, Tensor) else n
-        sel = jnp.where(p, od, nd)
-        out.append(Tensor(sel) if isinstance(o, Tensor) or
-                   isinstance(n, Tensor) else sel)
-    return tuple(out)
 
 
 def _jst_while(cond_fn, body_fn, init):
@@ -359,10 +345,15 @@ class _LoopTransformer(ast.NodeTransformer):
     def __init__(self):
         self.count = 0
         self.converted = 0
+        self._prior_stores: Set[str] = set()
 
     # -- analysis ---------------------------------------------------------
     def _body_ok(self, stmts) -> bool:
         for s in stmts:
+            if self._exit_kind(s):
+                # exit-ifs are handled by _emit's branch nesting; their
+                # payloads are assignment-only by construction
+                continue
             if not isinstance(s, self._OK_STMTS):
                 return False
             if isinstance(s, ast.Expr) and not isinstance(
@@ -399,25 +390,30 @@ class _LoopTransformer(ast.NodeTransformer):
                     stack.extend(ast.iter_child_nodes(n))
         return True
 
-    def _split_break(self, body):
-        """Return (mode, pred, rest) where mode in {None, 'lead_break',
-        'lead_continue', 'tail_break'}."""
-        def is_exit_if(s, kind):
-            return (isinstance(s, ast.If) and not s.orelse
-                    and len(s.body) == 1 and isinstance(s.body[0], kind))
+    @staticmethod
+    def _exit_kind(s):
+        """'break' / 'continue' / 'return' when ``s`` is an exit-if —
+        ``if pred: [assignments...;] break|continue|return <expr>`` with
+        no else — otherwise None (reference:
+        break_continue_transformer.py, return_transformer.py)."""
+        if not (isinstance(s, ast.If) and not s.orelse and s.body):
+            return None
+        *payload, last = s.body
+        if not all(isinstance(q, (ast.Assign, ast.AugAssign))
+                   for q in payload):
+            return None
+        if isinstance(last, ast.Break):
+            return "break"
+        if isinstance(last, ast.Continue):
+            return "continue"
+        if isinstance(last, ast.Return) and last.value is not None:
+            return "return"
+        return None
 
-        if body and is_exit_if(body[0], ast.Break):
-            return "lead_break", body[0].test, body[1:]
-        if body and is_exit_if(body[0], ast.Continue):
-            return "lead_continue", body[0].test, body[1:]
-        if body and is_exit_if(body[-1], ast.Break):
-            return "tail_break", body[-1].test, body[:-1]
-        return None, None, body
-
-    def _carried(self, test, body_stmts, after_loads, brk_pred=None):
-        """Loop-carried names: assigned in body AND (read by the test or
-        the break/continue predicate, read before written in the body, or
-        read after the loop)."""
+    def _carried(self, test, body_stmts, after_loads):
+        """Loop-carried names: assigned in body AND (read by the test,
+        read before written in the body — exit-if predicates and
+        payloads included — or read after the loop)."""
         assigned: Set[str] = set()
         for s in body_stmts:
             assigned |= _stores(s)
@@ -433,30 +429,85 @@ class _LoopTransformer(ast.NodeTransformer):
                 live |= (_loads(s.value) & assigned) - written
                 written.add(s.target.id)
             else:
+                # exit-ifs land here: their predicate and payload reads
+                # count as live (they re-evaluate every iteration), and
+                # their conditional stores never count as written
                 live |= (_loads(s) & assigned) - written
         if test is not None:
             live |= _loads(test) & assigned
-        if brk_pred is not None:
-            # the break predicate is re-evaluated every iteration: any
-            # body-assigned name it reads must ride in the carry or it
-            # would see a stale pre-loop snapshot forever
-            live |= _loads(brk_pred) & assigned
         live |= after_loads & assigned
         # only live names ride in the carry (they must be bound before the
         # loop, the reference's loop-var rule); write-before-read temps
         # stay body-local
         return sorted(live)
 
+    # -- codegen ----------------------------------------------------------
+    def _emit(self, stmts, state, k, ind, uid):
+        """Emit loop-body source for ``stmts`` with exit-ifs at ANY
+        position (reference: break_continue_transformer.py /
+        return_transformer.py generality).  Statements after an exit-if
+        become the ELSE branch of a ``_jst_cond`` over the exit
+        predicate — nesting reproduces Python's 'skip the rest of this
+        iteration' semantics exactly, for eager (short-circuit) and
+        traced (lax.cond) alike.  ``state`` names are threaded through
+        branch closures via default-arg snapshots; plain temps flow by
+        lexical capture."""
+        lines = []
+        j = next((i for i, s in enumerate(stmts)
+                  if self._exit_kind(s)), None)
+        for s in stmts[:len(stmts) if j is None else j]:
+            for ln in ast.unparse(ast.fix_missing_locations(s)).splitlines():
+                lines.append(ind + ln)
+        if j is None:
+            return lines
+        ex = stmts[j]
+        kind = self._exit_kind(ex)
+        d = uid[0]
+        uid[0] += 1
+        p = f"__jst_p_{k}_{d}"
+        names = ", ".join(state)
+        tup = f"({names},)" if len(state) == 1 else f"({names})"
+        defaults = ", ".join(f"{n}={n}" for n in state)
+        lines.append(f"{ind}{p} = ({ast.unparse(ex.test)})")
+        lines.append(f"{ind}def __jst_then_{k}_{d}({defaults}):")
+        for s in ex.body[:-1]:
+            for ln in ast.unparse(s).splitlines():
+                lines.append(f"{ind}    {ln}")
+        if kind in ("break", "return"):
+            lines.append(f"{ind}    __jst_done_{k} = True")
+        if kind == "return":
+            lines.append(f"{ind}    __jst_rf_{k} = True")
+            rv = ast.unparse(ex.body[-1].value)
+            lines.append(f"{ind}    __jst_rv_{k} = ({rv})")
+        lines.append(f"{ind}    return {tup}")
+        lines.append(f"{ind}def __jst_else_{k}_{d}({defaults}):")
+        rest = self._emit(stmts[j + 1:], state, k, ind + "    ", uid)
+        lines.extend(rest)
+        lines.append(f"{ind}    return {tup}")
+        lines.append(f"{ind}{tup} = _jst_cond({p}, __jst_then_{k}_{d}, "
+                     f"__jst_else_{k}_{d})")
+        return lines
+
     # -- conversion -------------------------------------------------------
-    def _convert(self, node, after_loads):
+    def _convert(self, node, after_loads, tail_is_return=False):
         is_for = isinstance(node, ast.For)
         if node.orelse:
             return None
-        mode, brk_pred, body = self._split_break(list(node.body))
+        body = list(node.body)
         if not self._body_ok(body):
             return None
-        if mode is not None and brk_pred is None:
+        kinds = [self._exit_kind(s) for s in body]
+        has_break = "break" in kinds
+        has_return = "return" in kinds
+        ret_exprs = [s.body[-1].value for s, kd in zip(body, kinds)
+                     if kd == "return"]
+        if has_return and not tail_is_return:
+            # the surfaced `if flag: return value` is only fusable when
+            # the loop is immediately followed by the function's
+            # trailing return — otherwise a traced flag would hit a
+            # plain Python if; leave the loop to eager/loud handling
             return None
+
         if is_for:
             # for <name> in range(...)
             if not (isinstance(node.target, ast.Name)
@@ -487,69 +538,91 @@ class _LoopTransformer(ast.NodeTransformer):
             test_src = ast.unparse(node.test)
 
         carried = self._carried(node.test if not is_for else None, body,
-                                after_loads, brk_pred=brk_pred)
+                                after_loads)
         if is_for and ivar in carried:
             carried.remove(ivar)
         if not carried:
             return None
+
+        assigned: Set[str] = set()
+        for s in body:
+            assigned |= _stores(s)
+        # names whose ONLY body assignment sits inside an exit-if payload
+        # but that ride the carry (read after the loop) need a PRE-loop
+        # binding for the carry init — without a visible one the init
+        # tuple would raise UnboundLocalError where eager code worked;
+        # bail (prior_stores: names assigned earlier in the enclosing
+        # block, plus the function's parameters)
+        non_exit_stores: Set[str] = set()
+        for s, kd in zip(body, kinds):
+            if kd is None:
+                non_exit_stores |= _stores(s)
+        payload_only = (assigned - non_exit_stores) & set(carried)
+        if payload_only - self._prior_stores:
+            return None
+        for e in ret_exprs:
+            # the rv carry init evaluates the return expr PRE-loop: only
+            # carried body names (pre-bound by the loop-var rule) and the
+            # enclosing scope are available there — a body-local temp or
+            # the loop index would NameError
+            loads = _loads(e)
+            if loads & (assigned - set(carried)):
+                return None
+            if is_for and ivar in loads:
+                return None
+
         self.count += 1
         k = self.count
-        names = ", ".join(carried)
         done = f"__jst_done_{k}"
         ctr = f"__jst_i_{k}"
-        body_src = "\n".join(
-            ast.unparse(ast.fix_missing_locations(s)) for s in body
-        ) or "pass"
+        needs_done = has_break or has_return
 
-        args = ([ctr] if is_for else []) + carried + (
-            [done] if mode in ("lead_break", "tail_break") else [])
+        state = list(carried)
+        if needs_done:
+            state.append(done)
+        if has_return:
+            state += [f"__jst_rf_{k}", f"__jst_rv_{k}"]
+        args = ([ctr] if is_for else []) + state
         argl = ", ".join(args)
+        atup = f"({argl},)" if len(args) == 1 else f"({argl})"
+
         lines = []
         if is_for:
             lines.append(f"{ctr} = {start}")
             lines.append(f"__jst_n_{k} = {stop}")
-        if mode in ("lead_break", "tail_break"):
+        if needs_done:
             lines.append(f"{done} = False")
+        if has_return:
+            # the rv carry needs a shape/dtype-compatible init: the
+            # return expr evaluated with PRE-loop values (verified above
+            # to read only carried — hence pre-bound — or outer names);
+            # never observed unless the flag is set
+            lines.append(f"__jst_rf_{k} = False")
+            lines.append(f"__jst_rv_{k} = ({ast.unparse(ret_exprs[0])})")
         # cond
         base_test = (f"_jst_lt({ctr}, __jst_n_{k})" if is_for
                      else f"({test_src})")
-        if mode in ("lead_break", "tail_break"):
-            cond_ret = f"_jst_and({base_test}, _jst_not({done}))"
-        else:
-            cond_ret = base_test
+        cond_ret = (f"_jst_and({base_test}, _jst_not({done}))"
+                    if needs_done else base_test)
         lines.append(f"def __jst_cond_{k}({argl}):")
         lines.append(f"    return {cond_ret}")
-        # body
+        # body: exit-ifs anywhere via _jst_cond nesting (_emit)
         lines.append(f"def __jst_body_{k}({argl}):")
         if is_for:
             lines.append(f"    {node.target.id} = {ctr}")
-        if mode in ("lead_break", "lead_continue"):
-            pred = ast.unparse(brk_pred)
-            defaults = ", ".join(f"{c}={c}" for c in carried)
-            lines.append(f"    __jst_p_{k} = {pred}")
-            lines.append(f"    def __jst_rest_{k}({defaults}):")
-            for ln in body_src.splitlines():
-                lines.append(f"        {ln}")
-            lines.append(f"        return ({names},)")
-            lines.append(f"    ({names},) = _jst_select(__jst_p_{k}, "
-                         f"({names},), __jst_rest_{k})")
-            if mode == "lead_break":
-                lines.append(f"    {done} = _jst_or({done}, __jst_p_{k})")
-        else:
-            for ln in body_src.splitlines():
-                lines.append(f"    {ln}")
-            if mode == "tail_break":
-                lines.append(f"    {done} = {ast.unparse(brk_pred)}")
+        lines.extend(self._emit(body, state, k, "    ", [0]))
         if is_for:
             lines.append(f"    {ctr} = {ctr} + {step}")
-        lines.append(f"    return ({argl},)" if len(args) == 1
-                     else f"    return ({argl})")
+        lines.append(f"    return {atup}")
         # dispatch
-        lines.append(f"({argl},) = _jst_while(__jst_cond_{k}, "
-                     f"__jst_body_{k}, ({argl},))"
-                     if len(args) == 1 else
-                     f"({argl}) = _jst_while(__jst_cond_{k}, "
-                     f"__jst_body_{k}, ({argl}))")
+        lines.append(f"{atup} = _jst_while(__jst_cond_{k}, "
+                     f"__jst_body_{k}, {atup})")
+        if has_return:
+            # early return surfaces after the loop; the second if-pass
+            # (convert_control_flow) fuses this with the function's
+            # trailing return for traced predicates
+            lines.append(f"if __jst_rf_{k}:")
+            lines.append(f"    return __jst_rv_{k}")
         src = "\n".join(lines)
         try:
             new_stmts = ast.parse(src).body
@@ -558,23 +631,36 @@ class _LoopTransformer(ast.NodeTransformer):
         self.converted += 1
         return new_stmts
 
-    def _rewrite(self, stmts, extra_after: Optional[Set[str]] = None):
+    def _rewrite(self, stmts, extra_after: Optional[Set[str]] = None,
+                 prior: Optional[Set[str]] = None):
         out = []
+        prior_stores: Set[str] = set(prior or ())
         for i, s in enumerate(stmts):
             if isinstance(s, (ast.While, ast.For)):
                 after_loads: Set[str] = set(extra_after or ())
                 for t in stmts[i + 1:]:
                     after_loads |= _loads(t)
-                conv = self._convert(s, after_loads)
+                rest = stmts[i + 1:]
+                tail_is_return = (len(rest) == 1
+                                  and isinstance(rest[0], ast.Return)
+                                  and rest[0].value is not None)
+                self._prior_stores = prior_stores
+                conv = self._convert(s, after_loads,
+                                     tail_is_return=tail_is_return)
                 if conv is not None:
                     out.extend(conv)
+                    prior_stores |= _stores(s)
                     continue
+            prior_stores |= _stores(s)
             out.append(s)
         return out
 
     def visit_FunctionDef(self, node):
         self.generic_visit(node)
-        node.body = self._rewrite(node.body)
+        params = {a.arg for a in (node.args.args
+                                  + node.args.posonlyargs
+                                  + node.args.kwonlyargs)}
+        node.body = self._rewrite(node.body, prior=params)
         return node
 
     def visit_While(self, node):
@@ -589,6 +675,59 @@ class _LoopTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         node.body = self._rewrite(node.body,
                                   extra_after=_loads(node))
+        return node
+
+
+import weakref
+
+# weak keys: dynamically created helpers (per-step closures, factory
+# products) must stay collectable — a strong cache would pin every
+# function object (and its closed-over arrays) for the process lifetime
+_CALL_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_SKIP_ROOTS = {"paddle_tpu", "jax", "jaxlib", "numpy", "np", "builtins",
+               "math", "functools", "itertools", "flax", "optax", "torch"}
+
+
+def _convertible_user_fn(f) -> bool:
+    import types
+    if not isinstance(f, types.FunctionType):
+        return False
+    mod = (getattr(f, "__module__", "") or "").split(".")[0]
+    return mod not in _SKIP_ROOTS
+
+
+def _jst_call(f):
+    """Runtime hook for converted call sites (reference:
+    call_transformer.py convert_call): user helper functions get
+    control-flow conversion too, lazily and memoized; anything else
+    (builtins, library fns, shadowed names) passes through untouched."""
+    if not _convertible_user_fn(f):
+        return f
+    conv = _CALL_CACHE.get(f)
+    if conv is None:
+        conv = convert_control_flow(f)
+        _CALL_CACHE[f] = conv
+    return conv
+
+
+class _CallTransformer(ast.NodeTransformer):
+    """reference: call_transformer.py — wrap bare-name calls that resolve
+    (at conversion time) to plain user functions in ``_jst_call`` so
+    tensor control flow inside helpers converts as well."""
+
+    def __init__(self, resolver):
+        self.converted = 0
+        self._resolve = resolver
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name)
+                and not node.func.id.startswith(("_jst", "__jst"))
+                and self._resolve(node.func.id)):
+            node.func = ast.Call(
+                func=ast.Name(id="_jst_call", ctx=ast.Load()),
+                args=[node.func], keywords=[])
+            self.converted += 1
         return node
 
 
@@ -609,7 +748,27 @@ def convert_control_flow(fn: Callable) -> Callable:
     tr.visit(tree)
     lt = _LoopTransformer()
     lt.visit(tree)
-    if not (tr.converted or lt.converted):
+    tr2 = _IfElseTransformer()
+    if lt.converted:
+        # second if-pass: fuses loop-generated `if __jst_rf: return rv`
+        # early-return surfacing with the function's trailing return
+        tr2.visit(tree)
+
+    # nested calls (resolved against decoration-time globals/closure)
+    env = dict(fn.__globals__)
+    if fn.__closure__:
+        try:
+            env.update({k: c.cell_contents
+                        for k, c in zip(fn.__code__.co_freevars,
+                                        fn.__closure__)})
+        except ValueError:
+            pass
+    ct = _CallTransformer(
+        lambda name: _convertible_user_fn(env.get(name)))
+    ct.visit(tree)
+
+    if not (tr.converted or lt.converted or tr2.converted
+            or ct.converted):
         return fn
     ast.fix_missing_locations(tree)
     try:
@@ -618,8 +777,9 @@ def convert_control_flow(fn: Callable) -> Callable:
         return fn
     glb = dict(fn.__globals__)
     glb.update(_jst_cond=_jst_cond, _jst_while=_jst_while,
-               _jst_select=_jst_select, _jst_and=_jst_and,
-               _jst_or=_jst_or, _jst_not=_jst_not, _jst_lt=_jst_lt)
+               _jst_and=_jst_and,
+               _jst_or=_jst_or, _jst_not=_jst_not, _jst_lt=_jst_lt,
+               _jst_call=_jst_call)
     # snapshot closure cells into globals (documented limitation: the
     # converted function sees decoration-time closure values)
     if fn.__closure__:

@@ -240,14 +240,21 @@ def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
         # dead node — harmless): build the loop as a composite node
         return _static_while(probe.program)
     if not _is_traced(probe):
-        # eager: genuine Python loop, tape sees every op
+        # eager: genuine Python loop, tape sees every op.  The predicate
+        # can BECOME traced mid-loop (a dy2static break/done flag fed by
+        # a traced condition): iterations so far ran concretely, the
+        # remainder continues as lax.while_loop from the current state.
         if not isinstance(probe, bool) and probe is not None:
             probe = bool(_as_arr(probe))
         while probe:
             out = body(*loop_vars)
             loop_vars = list(out) if isinstance(out, (list, tuple)) else [out]
-            probe = bool(_as_arr(cond(*loop_vars)))
-        return loop_vars
+            probe = cond(*loop_vars)
+            if _is_traced(probe):
+                break
+            probe = bool(_as_arr(probe))
+        if not _is_traced(probe):
+            return loop_vars
 
     def c(arrs):
         return jnp.asarray(_as_arr(cond(*_wrap(arrs))), jnp.bool_)

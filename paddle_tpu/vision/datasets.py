@@ -219,8 +219,12 @@ class _LazyTarReader:
         with tarfile.open(self._tar_path) as tf:
             self.name2mem = {m.name: m for m in tf.getmembers()}
 
-    @staticmethod
-    def _ensure_seekable(data_file):
+    # archive identity -> decompressed temp path (one decompression per
+    # archive even across train/valid/test splits)
+    _SEEKABLE_CACHE: dict = {}
+
+    @classmethod
+    def _ensure_seekable(cls, data_file):
         """gzip has no random access: a seek backwards inside a .tgz
         re-decompresses from byte 0, making shuffled epochs
         quasi-quadratic.  Decompress ONCE to an uncompressed temp tar
@@ -230,6 +234,11 @@ class _LazyTarReader:
             magic = f.read(2)
         if magic != b"\x1f\x8b":
             return data_file
+        st = os.stat(data_file)
+        key = (os.path.abspath(data_file), st.st_size, st.st_mtime_ns)
+        cached = cls._SEEKABLE_CACHE.get(key)
+        if cached is not None and os.path.exists(cached):
+            return cached
         import atexit
         import shutil
         import tempfile
@@ -239,6 +248,7 @@ class _LazyTarReader:
         tmp.close()
         atexit.register(lambda p=tmp.name: os.path.exists(p)
                         and os.unlink(p))
+        cls._SEEKABLE_CACHE[key] = tmp.name
         return tmp.name
 
     def _read_member(self, name):

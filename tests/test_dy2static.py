@@ -381,3 +381,255 @@ def test_tensor_if_inside_tensor_while_converts():
     x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
     # s: [1,1](2) -> [2,2](4>2) ... iter1 sum0->else [1,1]; iter2 sum2->else [2,2]; iter3 sum4>2 -> [4,4]; sum8 stop
     np.testing.assert_allclose(f(x).numpy(), [4.0, 4.0])
+
+
+# ---- round-5 breadth (VERDICT r4 #5): break/continue anywhere, early
+# return in loops, converted nested calls --------------------------------
+
+def _eager_vs_static(fn, *inputs):
+    """Run eager and to_static on the same inputs; outputs must match."""
+    eager = fn(*inputs)
+    static = jit.to_static(fn)(*inputs)
+    np.testing.assert_allclose(np.asarray(static.data),
+                               np.asarray(eager.data), rtol=1e-6)
+    return static
+
+
+def test_mid_body_break():
+    def f(x):
+        s = paddle.zeros([2])
+        i = 0
+        while i < 10:
+            s = s + x
+            if s.sum() > 6:
+                break
+            s = s * 1.5
+            i = i + 1
+        return s
+
+    x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    _eager_vs_static(f, x)
+
+
+def test_mid_body_continue_in_for():
+    def f(x):
+        s = x * 0
+        for i in range(6):
+            s = s + x
+            if s.sum() > 4:
+                continue
+            s = s + 100 * x  # skipped once the running sum passes 4
+        return s
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    _eager_vs_static(f, x)
+
+
+def test_multiple_exits_mixed():
+    def f(x):
+        s = x * 0
+        for i in range(8):
+            if s.sum() > 20:
+                break
+            s = s + x
+            if s.sum() < 2:
+                continue
+            s = s * 2
+        return s
+
+    for v in (0.5, 1.0, 3.0):
+        x = paddle.to_tensor(np.array([v], np.float32))
+        _eager_vs_static(f, x)
+
+
+def test_break_with_payload_assignment():
+    def f(x):
+        s = x * 0
+        flag = paddle.zeros([1])
+        for i in range(5):
+            s = s + x
+            if s.sum() > 2:
+                flag = flag + 1
+                break
+        return s + flag * 10
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    _eager_vs_static(f, x)
+
+
+def test_early_return_inside_loop():
+    def f(x):
+        s = x * 0
+        for i in range(10):
+            s = s + x
+            if s.sum() > 3:
+                return s * 100
+        return s
+
+    # one input that trips the early return, one that does not
+    hit = paddle.to_tensor(np.array([1.0], np.float32))
+    miss = paddle.to_tensor(np.array([0.1], np.float32))
+    _eager_vs_static(f, hit)
+    _eager_vs_static(f, miss)
+
+
+def test_early_return_inside_while():
+    def f(x):
+        s = x * 0
+        i = 0
+        while i < 20:
+            s = s + x
+            if s.sum() > 5:
+                return -s
+            i = i + 1
+        return s
+
+    _eager_vs_static(f, paddle.to_tensor(np.array([2.0], np.float32)))
+    _eager_vs_static(f, paddle.to_tensor(np.array([0.1], np.float32)))
+
+
+def _helper_double_or_neg(v):
+    # module-level helper with a tensor if: must be converted when
+    # called from a to_static fn (call_transformer parity)
+    if v.sum() > 0:
+        return v * 2
+    return -v
+
+
+def test_nested_call_converts():
+    def f(x):
+        y = _helper_double_or_neg(x)
+        return y + 1
+
+    pos = paddle.to_tensor(np.array([2.0], np.float32))
+    neg = paddle.to_tensor(np.array([-2.0], np.float32))
+    _eager_vs_static(f, pos)
+    _eager_vs_static(f, neg)
+
+
+def test_nested_call_inside_loop_converts():
+    def f(x):
+        s = x * 0
+        for i in range(4):
+            s = _helper_double_or_neg(s + x)
+        return s
+
+    _eager_vs_static(f, paddle.to_tensor(np.array([1.0], np.float32)))
+    _eager_vs_static(f, paddle.to_tensor(np.array([-1.0], np.float32)))
+
+
+def test_nested_call_shadowed_name_stays_loud():
+    """A call through a local alias cannot be resolved at conversion
+    time: the callee runs UNCONVERTED, and its tensor-if raises the
+    loud trace error instead of silently mistracing (design rule)."""
+    def f(x):
+        _local = _helper_double_or_neg
+        return _local(x)
+
+    x = paddle.to_tensor(np.array([1.5], np.float32))
+    assert float(f(x).data[0]) == 3.0  # eager path unaffected
+    with pytest.raises(TypeError, match="paddle.cond"):
+        jit.to_static(f)(x)
+
+
+def test_jst_call_passthrough():
+    from paddle_tpu.jit.dy2static import _jst_call
+    assert _jst_call(len) is len            # builtin
+    assert _jst_call(range) is range        # type
+    assert _jst_call(np.sum) is np.sum      # library fn
+    obj = object()
+    assert _jst_call(obj) is obj            # arbitrary value
+    # user helper converts and is memoized
+    c1 = _jst_call(_helper_double_or_neg)
+    c2 = _jst_call(_helper_double_or_neg)
+    assert c1 is c2 and c1 is not _helper_double_or_neg
+
+
+def test_traced_loop_break_lowers_to_while():
+    """The converted loop must lower to ONE lax.while under to_static:
+    the body traces once, it does not run per iteration or unroll."""
+    calls = [0]
+
+    def probe(v):
+        calls[0] += 1  # python side effect: fires once per TRACE
+        return v
+
+    def f(x):
+        s = x * 0
+        for i in range(100):
+            s = s + probe(x)
+            if s.sum() > 10:
+                break
+        return s
+
+    g = jit.to_static(f)
+    out = g(paddle.to_tensor(np.array([3.0], np.float32)))
+    assert float(np.asarray(out.data)[0]) == 12.0  # 3,6,9,12 -> break
+    # bounded tracing (lax.while traces the body twice for the carry
+    # fixed-point) — NOT 4 eager iterations, not 100 unrolled
+    assert calls[0] <= 2, calls[0]
+
+
+def test_return_of_body_temp_bails_loudly():
+    """Early return of a body-local temp can't init the carry pre-loop:
+    the loop must stay unconverted and raise the LOUD trace error, never
+    a NameError from generated code."""
+    def f(x):
+        s = x * 0
+        for i in range(5):
+            t = x * 2.0
+            if t.sum() > 3:
+                return t
+            s = s + t
+        return s
+
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    assert float(f(x).data[0]) == 4.0  # eager: t=4 > 3 on iter 0
+    with pytest.raises(TypeError, match="paddle.cond"):
+        jit.to_static(f)(x)
+
+
+def test_return_reading_loop_index_bails_loudly():
+    def f(x):
+        s = x * 0
+        for i in range(5):
+            s = s + x
+            if s.sum() > 2:
+                return s * i
+        return s
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    with pytest.raises(TypeError, match="paddle.cond"):
+        jit.to_static(f)(x)
+
+
+def test_payload_name_without_preloop_binding_bails_loudly():
+    def f(x):
+        s = x * 0
+        for i in range(5):
+            s = s + x
+            if s.sum() > 2:
+                msg = s * 0
+                break
+        return s + msg  # noqa: F821 - bound only when the break fires
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    assert float(f(x).data[0]) == 3.0  # eager: break fires, msg bound
+    with pytest.raises(TypeError, match="paddle.cond"):
+        jit.to_static(f)(x)
+
+
+def test_return_in_loop_with_nontrailing_return_bails_loudly():
+    def f(x):
+        s = x * 0
+        for i in range(10):
+            s = s + x
+            if s.sum() > 3:
+                return s * 100
+        y = s * 2
+        return y
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    assert float(f(x).data[0]) == 400.0
+    with pytest.raises(TypeError, match="paddle.cond"):
+        jit.to_static(f)(x)
