@@ -8,6 +8,7 @@
 - :mod:`checkpoint` — auto-checkpointed epoch ranges (reference:
   incubate/checkpoint/auto_checkpoint.py train_epoch_range).
 """
-from . import checkpoint, crypto, custom_op, fs, monitor, op_version  # noqa: F401
+from . import (checkpoint, cpp_extension, crypto, custom_op,  # noqa: F401
+               fs, monitor, op_version)
 from .checkpoint import train_epoch_range  # noqa: F401
 from .custom_op import register_custom_op  # noqa: F401

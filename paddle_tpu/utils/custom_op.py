@@ -4,11 +4,16 @@ Reference: the C++ custom-operator extension path
 (paddle/fluid/framework/custom_operator.cc, python/paddle/utils/
 cpp_extension) where users compile kernels against the framework ABI.
 TPU-native re-design: a custom op is a PURE jnp/lax/Pallas function —
-no ABI, no compilation step; registering it wires it through the shared
-dispatch point so it gets tape recording, AMP casting, profiling, and
-static-graph capture exactly like built-in ops.  A custom backward is a
-``jax.custom_vjp`` pair, usable for ops whose gradient XLA cannot derive
-(e.g. external Pallas kernels).
+registering it wires it through the shared dispatch point so it gets
+tape recording, AMP casting, profiling, and static-graph capture exactly
+like built-in ops.  A custom backward is a ``jax.custom_vjp`` pair,
+usable for ops whose gradient XLA cannot derive.
+
+NATIVE kernels: compile C++ against the XLA FFI with
+:mod:`paddle_tpu.utils.cpp_extension` (``load(name, sources,
+functions)``) — the returned callables are pure jax fns and register
+here like any other, including a native backward as the vjp pair
+(tests/test_cpp_extension.py shows the full fwd+bwd flow).
 """
 from __future__ import annotations
 

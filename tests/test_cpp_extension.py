@@ -1,0 +1,154 @@
+"""Native custom-op path: compile a C++ XLA-FFI kernel in-test, register
+it through register_custom_op with a native backward, and check fwd+bwd
+numerics (reference: custom_operator.cc + utils/cpp_extension — the
+custom relu example from the reference docs).
+
+Host kernels register for the CPU platform (the conftest pins
+JAX_PLATFORMS=cpu)."""
+import functools
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+_SRC = r"""
+#include <cstddef>
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error LeakyReluFwdImpl(ffi::Buffer<ffi::F32> x,
+                                   ffi::ResultBuffer<ffi::F32> y,
+                                   float alpha) {
+  const float* xi = x.typed_data();
+  float* yo = y->typed_data();
+  for (size_t i = 0; i < x.element_count(); ++i)
+    yo[i] = xi[i] > 0.0f ? xi[i] : alpha * xi[i];
+  return ffi::Error::Success();
+}
+XLA_FFI_DEFINE_HANDLER_SYMBOL(LeakyReluFwd, LeakyReluFwdImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>()
+                                  .Attr<float>("alpha"));
+
+static ffi::Error LeakyReluBwdImpl(ffi::Buffer<ffi::F32> x,
+                                   ffi::Buffer<ffi::F32> ct,
+                                   ffi::ResultBuffer<ffi::F32> dx,
+                                   float alpha) {
+  const float* xi = x.typed_data();
+  const float* g = ct.typed_data();
+  float* out = dx->typed_data();
+  for (size_t i = 0; i < x.element_count(); ++i)
+    out[i] = xi[i] > 0.0f ? g[i] : alpha * g[i];
+  return ffi::Error::Success();
+}
+XLA_FFI_DEFINE_HANDLER_SYMBOL(LeakyReluBwd, LeakyReluBwdImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>()
+                                  .Attr<float>("alpha"));
+"""
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    from paddle_tpu.utils.cpp_extension import load
+    root = tmp_path_factory.mktemp("ext")
+    src = root / "leaky.cpp"
+    src.write_text(_SRC)
+    return load(
+        "leaky_ext", [str(src)],
+        functions={
+            "leaky_fwd": {"symbol": "LeakyReluFwd", "out": "like:0"},
+            "leaky_bwd": {"symbol": "LeakyReluBwd", "out": "like:0"},
+        },
+        build_directory=str(root / "build"))
+
+
+def test_ffi_forward_numerics(ext):
+    x = np.array([-2.0, -0.5, 0.0, 1.5], np.float32)
+    y = np.asarray(ext.leaky_fwd(x, alpha=np.float32(0.1)))
+    np.testing.assert_allclose(y, np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+
+
+def test_ffi_under_jit_and_vmap(ext):
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(functools.partial(ext.leaky_fwd, alpha=np.float32(0.2)))
+    x = jnp.asarray(np.linspace(-2, 2, 16, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(f(x)),
+                               np.where(x > 0, x, 0.2 * np.asarray(x)),
+                               rtol=1e-6)
+    xb = jnp.stack([x, -x])
+    vb = jax.vmap(lambda a: ext.leaky_fwd(a, alpha=np.float32(0.2)))(xb)
+    assert np.asarray(vb).shape == (2, 16)
+
+
+def test_register_custom_op_with_native_vjp(ext):
+    """The cpp_extension analog end-to-end: native fwd + native bwd wired
+    through register_custom_op's custom_vjp, driven by the eager tape."""
+    import paddle_tpu as paddle
+    from paddle_tpu.utils.custom_op import register_custom_op
+
+    alpha = np.float32(0.1)
+    fwd = functools.partial(ext.leaky_fwd, alpha=alpha)
+
+    def bwd(res, ct):
+        (x,) = res
+        return (ext.leaky_bwd(x, ct, alpha=alpha),)
+
+    op = register_custom_op("native_leaky_relu", fwd, backward=bwd)
+
+    xv = np.array([-3.0, -1.0, 2.0, 4.0], np.float32)
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    y = op(x)
+    np.testing.assert_allclose(np.asarray(y.data),
+                               np.where(xv > 0, xv, 0.1 * xv), rtol=1e-6)
+    # backward through the tape uses the NATIVE bwd kernel
+    (y * paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+     ).sum().backward()
+    expect = np.where(xv > 0, 1.0, 0.1) * np.array([1, 2, 3, 4],
+                                                   np.float32)
+    np.testing.assert_allclose(np.asarray(x.grad.data), expect, rtol=1e-6)
+
+
+def test_rebuild_only_when_stale(ext, tmp_path):
+    from paddle_tpu.utils.cpp_extension import load
+    so = ext.__so_path__
+    mtime = os.path.getmtime(so)
+    # same sources, same build dir: no recompilation
+    src_dir = os.path.dirname(so)
+    # (reload through the public API with an out spec callable)
+    import jax
+    mod = load("leaky_ext",
+               [os.path.join(os.path.dirname(src_dir), "leaky.cpp")],
+               functions={"leaky_fwd": {
+                   "symbol": "LeakyReluFwd",
+                   "out": lambda a: jax.ShapeDtypeStruct(a.shape,
+                                                         a.dtype)}},
+               build_directory=src_dir)
+    assert os.path.getmtime(so) == mtime
+    x = np.array([-1.0, 1.0], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(mod.leaky_fwd(x, alpha=np.float32(0.5))),
+        [-0.5, 1.0], rtol=1e-6)
+
+
+def test_load_errors_are_loud(tmp_path):
+    from paddle_tpu.utils.cpp_extension import CppExtension, load
+    bad = tmp_path / "bad.cpp"
+    bad.write_text("this is not C++")
+    with pytest.raises(RuntimeError, match="g\\+\\+ failed"):
+        load("badext", [str(bad)], functions={},
+             build_directory=str(tmp_path / "b"))
+    with pytest.raises(FileNotFoundError):
+        load("missing", [str(tmp_path / "nope.cpp")], functions={})
+    with pytest.raises(NotImplementedError, match="cpp_extension.load"):
+        CppExtension("x")
