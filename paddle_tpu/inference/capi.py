@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import sysconfig
 import threading
 from typing import List, Sequence
@@ -49,16 +48,12 @@ def load_capi():
         if os.path.exists(src) and (
                 not os.path.exists(so)
                 or os.path.getmtime(so) < os.path.getmtime(src)):
-            tmp = so + ".tmp"
-            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                   f"-I{inc}", src, "-o", tmp,
-                   f"-L{libdir}", f"-lpython{ver}"]
-            try:
-                subprocess.run(cmd, check=True, capture_output=True)
-            except subprocess.CalledProcessError as e:
-                raise RuntimeError(
-                    f"C ABI build failed:\n{e.stderr.decode()[:800]}")
-            os.replace(tmp, so)
+            from ..utils.native_build import build_shared_lib
+            build_shared_lib(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 f"-I{inc}"],
+                [src, f"-L{libdir}", f"-lpython{ver}"], so,
+                what="C ABI build")
         lib = ctypes.CDLL(so, mode=ctypes.RTLD_GLOBAL)
         lib.PT_NewPredictor.restype = ctypes.c_void_p
         lib.PT_NewPredictor.argtypes = [ctypes.c_char_p]

@@ -6,5 +6,7 @@ from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
 from .extras import (ExponentialMovingAverage, Lookahead,  # noqa: F401
                      LookaheadOptimizer, ModelAverage)
 from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,  # noqa
-                        Lamb, LarsMomentum, Momentum, Optimizer, RMSProp)
+                        DecayedAdagrad, Dpsgd, Ftrl, Lamb, LarsMomentum,
+                        Momentum, Optimizer, ProximalAdagrad, ProximalGD,
+                        RMSProp)
 from .regularizer import L1Decay, L2Decay  # noqa: F401

@@ -529,3 +529,137 @@ class LarsMomentum(Optimizer):
         v = (self._momentum * slots["velocity"]
              + local_lr * (g + self._lars_wd * p))
         return p - v, {"velocity": v}
+
+
+class Ftrl(Optimizer):
+    """reference: operators/optimizers/ftrl_op.h (FTRL-Proximal,
+    McMahan et al.; linear/squared accumulators, soft-threshold on the
+    linear term).  ``lr_power`` follows the reference's sign convention
+    (-0.5 means accum^0.5 in the denominators)."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0,
+                 lr_power=-0.5, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def init_slots(self, p):
+        return {"squared": jnp.zeros_like(p), "linear": jnp.zeros_like(p)}
+
+    def update_param(self, p, g, slots, lr, step):
+        sq, lin = slots["squared"], slots["linear"]
+        new_sq = sq + g * g
+        if self._lr_power == -0.5:
+            sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+            denom = jnp.sqrt(new_sq) / lr
+        else:
+            sigma = (new_sq ** -self._lr_power
+                     - sq ** -self._lr_power) / lr
+            denom = new_sq ** -self._lr_power / lr
+        new_lin = lin + g - sigma * p
+        x = self._l1 * jnp.sign(new_lin) - new_lin
+        y = denom + 2.0 * self._l2
+        new_p = jnp.where(jnp.abs(new_lin) > self._l1, x / y,
+                          jnp.zeros_like(p))
+        return new_p, {"squared": new_sq, "linear": new_lin}
+
+
+class Dpsgd(Optimizer):
+    """reference: operators/optimizers/dpsgd_op.h — differentially
+    private SGD: whole-gradient L2 clip to ``clip`` plus one shared
+    Gaussian noise draw scaled by 1/batch_size.
+
+    Divergence (documented): the reference seeds from time() when
+    seed==0, which cannot exist inside a compiled step — seed=0 here is
+    simply the literal seed, with the step index folded in so every
+    step draws fresh noise."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, seed=0, parameters=None, name=None):
+        super().__init__(learning_rate, parameters, None, None, name)
+        self._clip, self._batch = clip, batch_size
+        self._sigma, self._seed = sigma, seed
+        self._next_noise_id = 0
+
+    def init_slots(self, p):
+        # per-parameter noise id: the Gaussian-mechanism analysis needs
+        # INDEPENDENT noise per tensor — a (seed, step)-only key would
+        # hand every parameter the same draw
+        nid = self._next_noise_id
+        self._next_noise_id += 1
+        return {"noise_id": jnp.asarray(nid, jnp.int32)}
+
+    def update_param(self, p, g, slots, lr, step):
+        norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        scale = jnp.where(norm > self._clip, norm / self._clip, 1.0)
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed),
+                                 jnp.asarray(step, jnp.int32))
+        key = jax.random.fold_in(key, slots["noise_id"])
+        noise = self._sigma * jax.random.normal(key, (), jnp.float32)
+        upd = g / scale.astype(g.dtype) + (noise / self._batch).astype(
+            g.dtype)
+        return p - lr * upd, {"noise_id": slots["noise_id"]}
+
+
+class ProximalGD(Optimizer):
+    """reference: operators/optimizers/proximal_gd_op.h — plain GD step
+    followed by the L1 soft-threshold / L2 shrink proximal map."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._l1, self._l2 = l1, l2
+
+    def init_slots(self, p):
+        return {}
+
+    def _prox(self, prox_param, lr):
+        if self._l1 > 0:
+            return (jnp.sign(prox_param)
+                    * jnp.maximum(jnp.abs(prox_param) - lr * self._l1, 0.0)
+                    / (1.0 + lr * self._l2))
+        return prox_param / (1.0 + lr * self._l2)
+
+    def update_param(self, p, g, slots, lr, step):
+        return self._prox(p - lr * g, lr), slots
+
+
+class ProximalAdagrad(ProximalGD):
+    """reference: operators/optimizers/proximal_adagrad_op.h — Adagrad
+    step (accumulated g^2 scaling) followed by the same proximal map.
+
+    Divergence (documented): the reference divides by sqrt(moment) with
+    no epsilon, so an element whose accumulated g^2 is still zero (dead
+    unit, untouched row) becomes 0/0 = NaN and is destroyed; here a
+    zero accumulator takes a zero step instead."""
+
+    def init_slots(self, p):
+        return {"moment": jnp.zeros_like(p)}
+
+    def update_param(self, p, g, slots, lr, step):
+        mom = slots["moment"] + g * g
+        safe = jnp.where(mom > 0, mom, 1.0)
+        step_v = jnp.where(mom > 0, lr * g / jnp.sqrt(safe), 0.0)
+        return self._prox(p - step_v, lr), {"moment": mom}
+
+
+class DecayedAdagrad(Optimizer):
+    """reference: operators/optimizers/decayed_adagrad_op.h — Adagrad
+    with an exponentially decayed accumulator."""
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._decay, self._eps = decay, epsilon
+
+    def init_slots(self, p):
+        return {"moment": jnp.zeros_like(p)}
+
+    def update_param(self, p, g, slots, lr, step):
+        mom = self._decay * slots["moment"] + (1 - self._decay) * g * g
+        return p - lr * g / (jnp.sqrt(mom) + self._eps), {"moment": mom}

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import tempfile
 from types import SimpleNamespace
 from typing import Callable, Dict, Optional, Sequence, Union
@@ -105,10 +104,15 @@ def load(name: str, sources: Sequence[str],
     for s in srcs:
         if not os.path.exists(s):
             raise FileNotFoundError(f"cpp_extension.load: source {s}")
-    # cache key = source CONTENTS + flags: mtimes lie (CI cache
-    # restores, tarballs) and flag changes must rebuild
+    # cache key = source CONTENTS + flags + FFI header identity: mtimes
+    # lie (CI cache restores, tarballs), flag changes must rebuild, and
+    # a jaxlib upgrade must not reuse a .so built against old headers
     import hashlib
+
+    import jaxlib
     h = hashlib.sha1()
+    h.update(getattr(jaxlib, "__version__", "?").encode())
+    h.update(jax.ffi.include_dir().encode())
     for flag in (extra_cxx_cflags or []):
         h.update(flag.encode())
     for s in srcs:
@@ -119,21 +123,11 @@ def load(name: str, sources: Sequence[str],
                            f"lib{name}_{h.hexdigest()[:12]}.so")
 
     if not os.path.exists(so_path):
-        # compile to a private temp then os.replace: a concurrent
-        # process must never dlopen a half-written library (same
-        # pattern as inference/capi.py)
-        tmp_path = f"{so_path}.{os.getpid()}.tmp"
-        cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
-                f"-I{jax.ffi.include_dir()}"]
-               + list(extra_cxx_cflags or [])
-               + srcs + ["-o", tmp_path])
-        if verbose:
-            print("cpp_extension:", " ".join(cmd))
-        proc = subprocess.run(cmd, capture_output=True, text=True)
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"cpp_extension.load: g++ failed\n{proc.stderr}")
-        os.replace(tmp_path, so_path)
+        from .native_build import build_shared_lib
+        build_shared_lib(
+            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+             f"-I{jax.ffi.include_dir()}"] + list(extra_cxx_cflags or []),
+            srcs, so_path, verbose=verbose, what="cpp_extension.load")
 
     lib = ctypes.CDLL(so_path)
     ns = {}

@@ -1,0 +1,33 @@
+"""Shared native-library build helper.
+
+One implementation of the compile-to-private-temp + atomic-rename dance
+(a concurrent process must never dlopen a half-written .so), used by the
+C-ABI predictor (inference/capi.py) and the cpp_extension loader."""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Sequence
+
+__all__ = ["build_shared_lib"]
+
+
+def build_shared_lib(cmd_prefix: Sequence[str], sources: Sequence[str],
+                     so_path: str, verbose: bool = False,
+                     what: str = "native build") -> str:
+    """Run ``cmd_prefix + sources + ['-o', <pid-unique tmp>]`` and
+    atomically rename onto ``so_path``.  Raises RuntimeError with the
+    compiler's stderr on failure."""
+    tmp_path = f"{so_path}.{os.getpid()}.tmp"
+    cmd = list(cmd_prefix) + list(sources) + ["-o", tmp_path]
+    if verbose:
+        print(f"{what}:", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        try:
+            os.path.exists(tmp_path) and os.unlink(tmp_path)
+        except OSError:  # pragma: no cover
+            pass
+        raise RuntimeError(f"{what}: compiler failed\n{proc.stderr}")
+    os.replace(tmp_path, so_path)
+    return so_path

@@ -145,7 +145,7 @@ def test_load_errors_are_loud(tmp_path):
     from paddle_tpu.utils.cpp_extension import CppExtension, load
     bad = tmp_path / "bad.cpp"
     bad.write_text("this is not C++")
-    with pytest.raises(RuntimeError, match="g\\+\\+ failed"):
+    with pytest.raises(RuntimeError, match="compiler failed"):
         load("badext", [str(bad)], functions={},
              build_directory=str(tmp_path / "b"))
     with pytest.raises(FileNotFoundError):
