@@ -1,0 +1,45 @@
+// Example: load a jit.save'd paddle_tpu model and run one inference.
+//
+// Build (see go/README.md):
+//   export CGO_LDFLAGS="-L$REPO/paddle_tpu/inference/csrc -lpaddle_tpu_capi \
+//                       -L$(python3 -c 'import sysconfig;print(sysconfig.get_config_var(\"LIBDIR\"))') \
+//                       -lpython3.12"
+//   go build ./...
+//   LD_LIBRARY_PATH=... ./example <model_prefix>
+package main
+
+import (
+	"fmt"
+	"os"
+
+	"paddle_tpu/go/paddle"
+)
+
+func main() {
+	if len(os.Args) < 2 {
+		fmt.Fprintln(os.Stderr, "usage: example <model_prefix>")
+		os.Exit(2)
+	}
+	cfg := paddle.NewAnalysisConfig()
+	cfg.SetModel(os.Args[1], "")
+
+	pred, err := paddle.NewPredictor(cfg)
+	if err != nil {
+		panic(err)
+	}
+	defer pred.Delete()
+
+	in, err := paddle.NewTensor(make([]float32, 1*1*28*28),
+		[]int64{1, 1, 28, 28})
+	if err != nil {
+		panic(err)
+	}
+	outs, err := pred.Run([]*paddle.Tensor{in})
+	if err != nil {
+		panic(err)
+	}
+	for i, o := range outs {
+		fmt.Printf("output %d shape=%v first=%v\n", i, o.Shape,
+			o.Data[0])
+	}
+}

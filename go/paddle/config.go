@@ -1,0 +1,51 @@
+// Package paddle: Go inference binding over the paddle_tpu C ABI.
+//
+// Reference: go/paddle/config.go (AnalysisConfig over paddle_inference_c).
+// TPU-native differences: XLA owns device selection and graph
+// optimization, so the GPU/TensorRT/IR-pass knobs either no-op
+// truthfully (documented per method) or don't exist; the model is a
+// jit.save'd path prefix.
+package paddle
+
+// Config mirrors the reference AnalysisConfig surface that remains
+// meaningful here: model location.
+type Config struct {
+	modelPrefix string
+}
+
+// NewConfig returns an empty config (reference: NewAnalysisConfig).
+func NewConfig() *Config { return &Config{} }
+
+// AnalysisConfig is the reference-compatible alias.
+type AnalysisConfig = Config
+
+// NewAnalysisConfig matches the reference constructor name.
+func NewAnalysisConfig() *AnalysisConfig { return NewConfig() }
+
+// SetModel points at a jit.save'd model. The reference takes
+// (model_file, params_file); here one prefix addresses both artifacts,
+// and a non-empty params argument is ignored (single-file format).
+func (c *Config) SetModel(modelPrefix string, params string) {
+	c.modelPrefix = modelPrefix
+}
+
+// ModelDir returns the configured model prefix.
+func (c *Config) ModelDir() string { return c.modelPrefix }
+
+// ProgFile returns the model prefix (single-artifact format).
+func (c *Config) ProgFile() string { return c.modelPrefix }
+
+// ParamsFile returns the model prefix (single-artifact format).
+func (c *Config) ParamsFile() string { return c.modelPrefix }
+
+// DisableGpu is a truthful no-op: device placement belongs to XLA.
+func (c *Config) DisableGpu() {}
+
+// UseGpu always reports false: there is no CUDA path in this runtime.
+func (c *Config) UseGpu() bool { return false }
+
+// SwitchIrOptim is a truthful no-op: XLA always optimizes.
+func (c *Config) SwitchIrOptim(bool) {}
+
+// IrOptim reports true: compilation always optimizes (XLA).
+func (c *Config) IrOptim() bool { return true }

@@ -1,0 +1,50 @@
+/* C ABI for the paddle_tpu inference Predictor.
+ *
+ * Reference analog: paddle_inference_c API (paddle/fluid/inference/capi)
+ * consumed by go/paddle.  Implemented by predictor_capi.cpp (embeds
+ * CPython; link against libpaddle_tpu_capi.so and the Python runtime).
+ *
+ * Threading: every entry point acquires the GIL internally; any host
+ * thread may call.  All arrays are float32; shapes are int64.
+ */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PT_Predictor PT_Predictor;
+
+typedef struct PT_Output {
+  float* data;
+  int64_t* shape;
+  int32_t ndim;
+  int64_t numel;
+} PT_Output;
+
+/* Load a jit.save'd model (path prefix, no extension).  NULL on
+ * failure (error text on stderr). */
+PT_Predictor* PT_NewPredictor(const char* model_path_prefix);
+
+/* Run with n_inputs float32 buffers; shapes[i] has ndims[i] dims.
+ * Returns the number of outputs, < 0 on error. */
+int32_t PT_PredictorRun(PT_Predictor* p, const float* const* inputs,
+                        const int64_t* const* shapes,
+                        const int32_t* ndims, int32_t n_inputs);
+
+/* Copy output idx of the last successful run into *out (free with
+ * PT_FreeOutput).  0 on success. */
+int32_t PT_GetOutput(PT_Predictor* p, int32_t idx, PT_Output* out);
+
+void PT_FreeOutput(PT_Output* out);
+
+void PT_DeletePredictor(PT_Predictor* p);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* PADDLE_TPU_CAPI_H_ */

@@ -20,17 +20,14 @@
 #include <string>
 #include <vector>
 
+// the public contract lives in the header (consumed by go/paddle and C
+// clients); this TU provides PT_Predictor's definition
+#include "paddle_tpu_capi.h"
+
 extern "C" {
 
 struct PT_Predictor {
   PyObject* predictor;  // paddle_tpu.inference.Predictor
-};
-
-struct PT_Output {
-  float* data;
-  int64_t* shape;
-  int32_t ndim;
-  int64_t numel;
 };
 
 static int g_we_initialized = 0;

@@ -1,0 +1,121 @@
+"""Go binding (reference: go/paddle over paddle_inference_c).
+
+Two tiers:
+- no Go toolchain (this sandbox): static contract checks — the cgo
+  sources must reference only PT_* symbols the C header declares, and
+  the header must match the symbols libpaddle_tpu_capi.so exports;
+- with Go: `go vet` + `go build` and the example binary end-to-end
+  against a jit.save'd model."""
+import os
+import re
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GO_DIR = os.path.join(REPO, "go")
+HEADER = os.path.join(REPO, "paddle_tpu", "inference", "csrc",
+                      "paddle_tpu_capi.h")
+
+
+def _header_symbols():
+    src = open(HEADER).read()
+    fns = set(re.findall(r"\b(PT_[A-Za-z]+)\s*\(", src))
+    types = set(re.findall(r"\b(?:struct|typedef struct)\s+"
+                           r"(PT_[A-Za-z]+)", src))
+    return fns | types
+
+
+def _go_sources():
+    out = []
+    for root, _, files in os.walk(GO_DIR):
+        out += [os.path.join(root, f) for f in files if f.endswith(".go")]
+    return out
+
+
+def test_go_sources_reference_only_declared_symbols():
+    declared = _header_symbols()
+    assert {"PT_NewPredictor", "PT_PredictorRun", "PT_GetOutput",
+            "PT_FreeOutput", "PT_DeletePredictor"} <= declared
+    used = set()
+    for path in _go_sources():
+        used |= set(re.findall(r"C\.(PT_[A-Za-z]+)", open(path).read()))
+    assert used, "go sources must call the C ABI"
+    assert used <= declared, used - declared
+
+
+def test_header_matches_compiled_abi(tmp_path):
+    """The header must compile as C and agree with the .so's exports."""
+    if shutil.which("gcc") is None and shutil.which("g++") is None:
+        pytest.skip("no C toolchain")
+    probe = tmp_path / "probe.c"
+    probe.write_text(
+        '#include "paddle_tpu_capi.h"\n'
+        "int main(void) {\n"
+        "  PT_Output o; o.ndim = 0; (void)o;\n"
+        "  void* fns[] = {(void*)PT_NewPredictor, (void*)PT_PredictorRun,\n"
+        "                 (void*)PT_GetOutput, (void*)PT_FreeOutput,\n"
+        "                 (void*)PT_DeletePredictor};\n"
+        "  (void)fns; return 0;\n"
+        "}\n")
+    from paddle_tpu.inference.capi import load_capi
+    load_capi()  # ensures the .so exists
+    so_dir = os.path.dirname(HEADER)
+    cc = shutil.which("gcc") or shutil.which("g++")
+    out = tmp_path / "probe"
+    r = subprocess.run(
+        [cc, str(probe), f"-I{so_dir}", f"-L{so_dir}",
+         "-lpaddle_tpu_capi", "-o", str(out)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.skipif(shutil.which("go") is None,
+                    reason="no Go toolchain in this image")
+def test_go_build_and_run_example(tmp_path):
+    import sysconfig
+
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, jit, nn
+    from paddle_tpu.inference.capi import load_capi
+    from paddle_tpu.jit import InputSpec
+
+    load_capi()
+    paddle.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(784, 10))
+    prefix = str(tmp_path / "m")
+    jit.save(net, prefix,
+             input_spec=[InputSpec([None, 1, 28, 28], "float32")])
+
+    ver = f"{os.sys.version_info.major}.{os.sys.version_info.minor}"
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    so_dir = os.path.dirname(HEADER)
+    env = dict(os.environ,
+               CGO_CFLAGS=f"-I{so_dir}",
+               CGO_LDFLAGS=(f"-L{so_dir} -lpaddle_tpu_capi "
+                            f"-L{libdir} -lpython{ver}"),
+               PYTHONPATH=REPO,
+               LD_LIBRARY_PATH=f"{so_dir}:{libdir}")
+    # module setup + vet + build
+    if not os.path.exists(os.path.join(GO_DIR, "go.mod")):
+        subprocess.run(["go", "mod", "init", "paddle_tpu/go"],
+                       cwd=GO_DIR, env=env, check=True,
+                       capture_output=True)
+    r = subprocess.run(["go", "vet", "./..."], cwd=GO_DIR, env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    exe = str(tmp_path / "example")
+    r = subprocess.run(["go", "build", "-o", exe, "./example"],
+                       cwd=GO_DIR, env=env, capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run([exe, prefix], env=env, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "output 0 shape=[1 10]" in r.stdout
+    # numerics: example feeds zeros -> logits equal the bias
+    first = float(r.stdout.split("first=")[1].split()[0])
+    bias = np.asarray(net[1].bias.data)[0]
+    np.testing.assert_allclose(first, bias, rtol=1e-5)
