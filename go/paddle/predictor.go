@@ -103,6 +103,9 @@ func (p *Predictor) Run(inputs []*Tensor) ([]*Tensor, error) {
 	for i := range outs {
 		var raw C.PT_Output
 		if C.PT_GetOutput(p.ptr, C.int32_t(i), &raw) != 0 {
+			// the implementation may have allocated shape before
+			// failing; PT_FreeOutput is null-safe
+			C.PT_FreeOutput(&raw)
 			return nil, fmt.Errorf("paddle: PT_GetOutput(%d) failed", i)
 		}
 		shape := make([]int64, int(raw.ndim))
@@ -123,11 +126,4 @@ func (p *Predictor) Run(inputs []*Tensor) ([]*Tensor, error) {
 		outs[i] = &Tensor{Data: data, Shape: shape}
 	}
 	return outs, nil
-}
-
-// GetOutputNum reports the output count of the LAST Run (reference:
-// GetOutputNum; here outputs are returned by Run directly, so this is
-// a convenience for ported code).
-func (p *Predictor) GetOutputNum(lastOutputs []*Tensor) int {
-	return len(lastOutputs)
 }

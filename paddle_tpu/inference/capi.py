@@ -41,13 +41,16 @@ def load_capi():
             return _LIB
         _LIB_TRIED = True
         src = os.path.join(_CSRC, "predictor_capi.cpp")
+        hdr = os.path.join(_CSRC, "paddle_tpu_capi.h")
         so = os.path.join(_CSRC, "libpaddle_tpu_capi.so")
         inc = sysconfig.get_path("include")
         ver = f"{os.sys.version_info.major}.{os.sys.version_info.minor}"
         libdir = sysconfig.get_config_var("LIBDIR") or ""
+        newest_src = max((os.path.getmtime(f) for f in (src, hdr)
+                          if os.path.exists(f)), default=0.0)
         if os.path.exists(src) and (
                 not os.path.exists(so)
-                or os.path.getmtime(so) < os.path.getmtime(src)):
+                or os.path.getmtime(so) < newest_src):
             from ..utils.native_build import build_shared_lib
             build_shared_lib(
                 ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",

@@ -4,8 +4,12 @@
  * consumed by go/paddle.  Implemented by predictor_capi.cpp (embeds
  * CPython; link against libpaddle_tpu_capi.so and the Python runtime).
  *
- * Threading: every entry point acquires the GIL internally; any host
- * thread may call.  All arrays are float32; shapes are int64.
+ * Threading: every entry point acquires the GIL internally, so calls
+ * from any host thread are individually safe — but outputs are stashed
+ * per predictor, so a Run -> GetOutput SEQUENCE must be serialized per
+ * predictor by the caller (concurrent Runs on one predictor would
+ * interleave each other's outputs).  Distinct predictors are
+ * independent.  All arrays are float32; shapes are int64.
  */
 #ifndef PADDLE_TPU_CAPI_H_
 #define PADDLE_TPU_CAPI_H_

@@ -72,3 +72,45 @@ def test_record_event_nests_without_profiler():
     with profiler.RecordEvent("outer"):
         with profiler.RecordEvent("inner"):
             pass
+
+
+def test_trainstep_capture_produces_xla_trace_dir(tmp_path):
+    """Profiler(trace_dir=...) around a TrainStep must leave a non-empty
+    XLA trace directory (device/host .trace.json.gz or .xplane.pb from
+    jax.profiler) alongside the host span stats (SURVEY 5.1's 'TPU
+    equivalent' of the reference timeline)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import nn, optimizer, profiler
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = TrainStep(net, lambda o, l: F.cross_entropy(o, l), opt)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 4, 16).astype("int64"))
+    step(x, y)  # compile outside the capture
+
+    trace_dir = str(tmp_path / "trace")
+    p = profiler.Profiler(trace_dir=trace_dir)
+    p.start()
+    with profiler.RecordEvent("capture_step"):
+        loss = step(x, y)
+    float(loss)  # device sync inside the capture window
+    p.stop()
+
+    # host spans recorded
+    assert p._span_stats["capture_step"][0] == 1
+    # the XLA trace dir exists and holds real trace artifacts
+    import os
+    files = []
+    for root, _, names in os.walk(trace_dir):
+        files += [os.path.join(root, n) for n in names]
+    assert files, f"no trace files under {trace_dir}"
+    assert any(n.endswith((".xplane.pb", ".trace.json.gz", ".json.gz",
+                           ".pb")) for n in files), files
+    assert sum(os.path.getsize(f) for f in files) > 0
