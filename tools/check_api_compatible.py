@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Public-API compatibility checker.
+
+Reference: ``tools/check_api_compatible.py`` + ``print_signatures.py`` —
+CI diffs every public API signature against the develop branch and
+blocks silent breaking changes.
+
+Here the recorded truth is ``tools/api_spec.json`` (checked in):
+  python tools/check_api_compatible.py --dump     # refresh the spec
+  python tools/check_api_compatible.py            # verify current API
+
+Compatibility rules (reference semantics):
+- removing a public name is a BREAK;
+- removing a parameter, renaming one, or reordering existing
+  positionals is a BREAK;
+- ADDING a trailing parameter with a default, or adding new public
+  names, is allowed.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import inspect
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # run as `python tools/check_api_compatible.py`
+    sys.path.insert(0, _REPO)
+
+SPEC_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "api_spec.json")
+
+# the public import surface a user of the reference would reach for
+_MODULES = [
+    "paddle_tpu", "paddle_tpu.nn", "paddle_tpu.nn.functional",
+    "paddle_tpu.nn.initializer", "paddle_tpu.optimizer",
+    "paddle_tpu.optimizer.lr", "paddle_tpu.io", "paddle_tpu.amp",
+    "paddle_tpu.jit", "paddle_tpu.static", "paddle_tpu.distributed",
+    "paddle_tpu.distributed.fleet", "paddle_tpu.metric",
+    "paddle_tpu.vision.transforms", "paddle_tpu.vision.datasets",
+    "paddle_tpu.vision.ops", "paddle_tpu.text.datasets",
+    "paddle_tpu.distribution", "paddle_tpu.profiler",
+    "paddle_tpu.inference", "paddle_tpu.quantization",
+    "paddle_tpu.utils", "paddle_tpu.onnx",
+]
+
+
+def _sig_of(obj):
+    try:
+        sig = inspect.signature(obj)
+    except (ValueError, TypeError):
+        return None
+    return [
+        {"name": p.name, "kind": p.kind.name,
+         "has_default": p.default is not inspect.Parameter.empty}
+        for p in sig.parameters.values()
+    ]
+
+
+def collect():
+    spec = {}
+    for mod_name in _MODULES:
+        try:
+            mod = importlib.import_module(mod_name)
+        except ImportError as e:  # a missing module IS an API break
+            spec[mod_name] = {"__import_error__": str(e)}
+            continue
+        entry = {}
+        for name in sorted(dir(mod)):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            if inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                entry[name] = {"type": "class",
+                               "init": _sig_of(obj.__init__)}
+                # public methods ANYWHERE in the MRO: moving a method to
+                # a base class is not an API change
+                for m in sorted(dir(obj)):
+                    if m.startswith("_"):
+                        continue
+                    f = getattr(obj, m, None)
+                    if inspect.isfunction(f) or inspect.ismethod(f):
+                        entry[f"{name}.{m}"] = {"type": "method",
+                                                "sig": _sig_of(f)}
+            elif callable(obj):
+                entry[name] = {"type": "function", "sig": _sig_of(obj)}
+            else:
+                entry[name] = {"type": "value"}
+        spec[mod_name] = entry
+    return spec
+
+
+def _params_compatible(old, new, where, problems):
+    if old is None or new is None:
+        return
+    old_named = [p for p in old if p["kind"] in
+                 ("POSITIONAL_ONLY", "POSITIONAL_OR_KEYWORD",
+                  "KEYWORD_ONLY")]
+    new_by_name = {p["name"]: p for p in new}
+    new_order = [p["name"] for p in new]
+    for i, p in enumerate(old_named):
+        if p["name"] not in new_by_name:
+            problems.append(f"{where}: parameter {p['name']!r} removed")
+            continue
+        q = new_by_name[p["name"]]
+        if (p["kind"] in ("POSITIONAL_ONLY", "POSITIONAL_OR_KEYWORD")
+                and q["kind"] == "KEYWORD_ONLY"):
+            problems.append(
+                f"{where}: parameter {p['name']!r} became keyword-only")
+        if p["has_default"] and not q["has_default"]:
+            problems.append(
+                f"{where}: parameter {p['name']!r} lost its default")
+        if p["kind"] != "KEYWORD_ONLY":
+            # positional order of pre-existing params must not change
+            old_pos = [q["name"] for q in old_named
+                       if q["kind"] != "KEYWORD_ONLY"]
+            new_pos = [n for n in new_order
+                       if n in set(old_pos)
+                       and new_by_name[n]["kind"] != "KEYWORD_ONLY"]
+            if [n for n in old_pos if n in set(new_pos)] != new_pos:
+                problems.append(f"{where}: positional order changed")
+                break
+    for p in new:
+        if (p["name"] not in {q["name"] for q in old}
+                and not p["has_default"]
+                and p["kind"] not in ("VAR_POSITIONAL", "VAR_KEYWORD")):
+            problems.append(
+                f"{where}: new parameter {p['name']!r} has no default")
+
+
+def compare(spec, current):
+    problems = []
+    for mod, names in spec.items():
+        cur = current.get(mod)
+        if cur is None or "__import_error__" in (cur or {}):
+            problems.append(f"{mod}: module no longer imports")
+            continue
+        if "__import_error__" in names:
+            continue  # was broken when dumped; nothing to hold it to
+        for name, info in names.items():
+            if name not in cur:
+                problems.append(f"{mod}.{name}: removed")
+                continue
+            now = cur[name]
+            if info["type"] != now["type"]:
+                problems.append(
+                    f"{mod}.{name}: {info['type']} -> {now['type']}")
+                continue
+            if info["type"] == "class":
+                _params_compatible(info.get("init"), now.get("init"),
+                                   f"{mod}.{name}.__init__", problems)
+            elif info["type"] in ("function", "method"):
+                _params_compatible(info.get("sig"), now.get("sig"),
+                                   f"{mod}.{name}", problems)
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dump", action="store_true",
+                    help="write the current API to the spec file")
+    ap.add_argument("--spec", default=SPEC_PATH)
+    args = ap.parse_args(argv)
+
+    current = collect()
+    if args.dump:
+        with open(args.spec, "w") as f:
+            json.dump(current, f, indent=1, sort_keys=True)
+        n = sum(len(v) for v in current.values())
+        print(f"wrote {n} public APIs across {len(current)} modules to "
+              f"{args.spec}")
+        return 0
+
+    if not os.path.exists(args.spec):
+        print(f"no spec at {args.spec}; run with --dump first",
+              file=sys.stderr)
+        return 2
+    with open(args.spec) as f:
+        spec = json.load(f)
+    problems = compare(spec, current)
+    if problems:
+        print("API compatibility problems:")
+        for p in problems:
+            print("  -", p)
+        return 1
+    n = sum(len(v) for v in spec.values())
+    print(f"API compatible: {n} recorded public APIs intact")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

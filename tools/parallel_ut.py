@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Parallel unit-test runner.
+
+Reference: the CI tier built around ``tools/parallel_UT_rule.py`` +
+``paddle_build.sh`` — unit tests partitioned into parallel batches with
+per-batch timeouts and a serial retry for flaky failures.
+
+TPU-native notes: test shards are separate *processes* (each gets its
+own jax runtime; the suite's conftest pins a virtual 8-device CPU mesh
+per process, so shards don't fight over a chip), files are partitioned
+by a static weight table (the long-pole files the suite is known to
+have) + size heuristic, and failures rerun ONCE serially before being
+reported — the reference CI's retry_unittests flow.
+
+Measured honestly: the build sandbox exposes ONE core (nproc=1), so
+``-j4`` there matches the serial 9-minute wall time — the speedup only
+exists on multi-core CI machines (the default ``-j`` follows
+``os.cpu_count()``).  The serial flaky-retry pass is load-tested either
+way: timeslicing-induced failures rerun and pass.
+
+Usage:
+  python tools/parallel_ut.py [-j N] [--timeout S] [tests_dir] [-- <pytest args>]
+  python tools/parallel_ut.py --collect-only       # show the shards
+  python tools/parallel_ut.py tests -- -k smoke -x
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+# known long-pole files (seconds, rough): balance shards by these
+_WEIGHTS = {
+    "test_multihost.py": 60,
+    "test_dataloader_mp.py": 60,
+    "test_distributed.py": 120,
+    "test_pipeline_memory.py": 90,
+    "test_static.py": 45,
+    "test_highlevel.py": 60,
+    "test_text_e2e.py": 30,
+    "test_pallas.py": 40,
+    "test_optimizer.py": 30,
+}
+_DEFAULT_WEIGHT = 10
+
+
+def discover(tests_dir: str):
+    return sorted(f for f in os.listdir(tests_dir)
+                  if f.startswith("test_") and f.endswith(".py"))
+
+
+def partition(files, n_shards):
+    """Greedy longest-processing-time partition by weight.
+
+    Callers should over-partition (more shards than workers) and let the
+    worker pool drain shards as they finish — dynamic balancing beats
+    any static weight table; the weights only keep known long-pole files
+    in separate shards."""
+    weighted = sorted(files, key=lambda f: -_WEIGHTS.get(f, _DEFAULT_WEIGHT))
+    shards = [[] for _ in range(n_shards)]
+    loads = [0] * n_shards
+    for f in weighted:
+        i = loads.index(min(loads))
+        shards[i].append(f)
+        loads[i] += _WEIGHTS.get(f, _DEFAULT_WEIGHT)
+    return [s for s in shards if s], loads
+
+
+def run_shard(tests_dir, files, timeout, extra):
+    cmd = [sys.executable, "-m", "pytest", "-q", *extra,
+           *[os.path.join(tests_dir, f) for f in files]]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+        rc = proc.returncode
+        if rc == 5:  # pytest: no tests collected (e.g. -k deselected all)
+            rc = 0
+        return rc, proc.stdout + proc.stderr
+    except subprocess.TimeoutExpired as e:
+        return 124, (e.stdout or "") + f"\nSHARD TIMEOUT after {timeout}s"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("tests_dir", nargs="?",
+                    default=os.path.join(os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__))),
+                        "tests"))
+    ap.add_argument("-j", "--jobs", type=int,
+                    default=min(4, os.cpu_count() or 1))
+    ap.add_argument("--timeout", type=float, default=1200.0,
+                    help="per-shard timeout (seconds)")
+    ap.add_argument("--collect-only", action="store_true")
+    ap.add_argument("--no-retry", action="store_true",
+                    help="skip the serial flaky retry")
+    raw = list(sys.argv[1:] if argv is None else argv)
+    # everything after "--" passes to pytest verbatim (dash flags would
+    # otherwise be eaten by argparse)
+    pytest_args = []
+    if "--" in raw:
+        i = raw.index("--")
+        raw, pytest_args = raw[:i], raw[i + 1:]
+    args = ap.parse_args(raw)
+    args.pytest_args = pytest_args
+
+    files = discover(args.tests_dir)
+    if not files:
+        print(f"no test files under {args.tests_dir}", file=sys.stderr)
+        return 2
+    # over-partition ~3 shards per worker: the pool drains them as they
+    # finish, so a mis-weighted long file can't serialize the whole run
+    n_shards = max(args.jobs, min(len(files), args.jobs * 3))
+    shards, loads = partition(files, n_shards)
+    if args.collect_only:
+        for i, (s, w) in enumerate(zip(shards, loads)):
+            print(f"shard {i} (~{w}s): {' '.join(s)}")
+        return 0
+
+    t0 = time.time()
+    import concurrent.futures as cf
+    import re
+    failed_files = []
+    with cf.ThreadPoolExecutor(max_workers=args.jobs) as ex:
+        futs = {ex.submit(run_shard, args.tests_dir, s, args.timeout,
+                          args.pytest_args): s for s in shards}
+        for fut in cf.as_completed(futs):
+            shard = futs[fut]
+            rc, out = fut.result()
+            tail = "\n".join(out.strip().splitlines()[-3:])
+            print(f"[shard {' '.join(shard[:2])}"
+                  f"{'...' if len(shard) > 2 else ''}] rc={rc}\n{tail}\n")
+            if rc != 0:
+                # retry only the files pytest reports failing; fall back
+                # to the whole shard when nothing parses (timeout/crash)
+                bad = {os.path.basename(m) for m in re.findall(
+                    r"(?:FAILED|ERROR)\s+(\S+?\.py)", out)}
+                hit = [f for f in shard if f in bad]
+                failed_files.extend(hit if hit else shard)
+
+    if failed_files and not args.no_retry:
+        # serial retry isolates flaky parallel interactions (the
+        # reference CI's retry_unittests pass)
+        print(f"retrying {len(failed_files)} file(s) serially...")
+        still = []
+        for f in failed_files:
+            rc, out = run_shard(args.tests_dir, [f], args.timeout,
+                                args.pytest_args)
+            if rc != 0:
+                still.append(f)
+                print(f"FAIL {f}\n" + "\n".join(
+                    out.strip().splitlines()[-15:]))
+        failed_files = still
+
+    dt = time.time() - t0
+    if failed_files:
+        print(f"FAILED ({dt:.0f}s): {' '.join(sorted(set(failed_files)))}")
+        return 1
+    print(f"OK: {len(files)} files in {dt:.0f}s across "
+          f"{len(shards)} shards")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
