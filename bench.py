@@ -345,8 +345,7 @@ def bench_gpt(args, dev, on_tpu):
         w = paddle.transpose(model.tok.weight, [1, 0])
         bias = paddle.zeros([cfg["vocab"]], dtype=w.dtype)
         return F.linear_cross_entropy(
-            out.reshape([-1, cfg["hidden"]]), w, bias, labels.reshape([-1]),
-            chunk=1024)
+            out.reshape([-1, cfg["hidden"]]), w, bias, labels.reshape([-1]))
 
     step = TrainStep(model, loss_fn, opt, n_inputs=1, donate=True)
 

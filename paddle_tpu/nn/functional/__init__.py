@@ -862,7 +862,7 @@ def _linear_ce_fn(h, w, b, lab, *, chunk, ignore_index):
     return total / jnp.maximum(count, 1).astype(jnp.float32)
 
 
-def linear_cross_entropy(hidden, weight, bias, label, chunk: int = 4096,
+def linear_cross_entropy(hidden, weight, bias, label, chunk: int = 1024,
                          ignore_index: int = -100, name=None):
     """Fused ``cross_entropy(hidden @ weight + bias, label)`` with chunked
     logits (mean reduction).  The TPU-native extension of the reference's
