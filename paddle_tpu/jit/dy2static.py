@@ -358,8 +358,15 @@ class _LoopTransformer(ast.NodeTransformer):
                 return False
             if isinstance(s, ast.Expr) and not isinstance(
                     s.value, ast.Constant):
-                # a bare expression in a loop body is almost always a
-                # side-effecting call (list.append, dict update, print):
+                # converted print/assert statements are trace-safe
+                # (jax.debug.print / debug.callback work under lax.while)
+                if (isinstance(s.value, ast.Call)
+                        and isinstance(s.value.func, ast.Name)
+                        and s.value.func.id in ("_jst_print",
+                                                "_jst_assert")):
+                    continue
+                # any other bare expression is almost always a
+                # side-effecting call (list.append, dict update):
                 # running it inside a traced closure would leak tracers
                 # into Python state — leave such loops to plain Python
                 return False
@@ -678,6 +685,138 @@ class _LoopTransformer(ast.NodeTransformer):
         return node
 
 
+_CALLBACKS_OK = None
+
+
+def _callbacks_supported() -> bool:
+    """Host callbacks (jax.debug.print/callback) are UNIMPLEMENTED on
+    the axon tunnel backend (its PJRT reports platform 'tpu' but
+    platform_version names axon); real TPUs and CPU support them."""
+    global _CALLBACKS_OK
+    if _CALLBACKS_OK is None:
+        import jax
+        try:
+            ver = getattr(jax.devices()[0].client, "platform_version", "")
+        except Exception:  # pragma: no cover - uninitialised backend
+            ver = ""
+        _CALLBACKS_OK = "axon" not in ver
+    return _CALLBACKS_OK
+
+
+def _jst_print(*args, **kw):
+    """reference: print_transformer.py → Print op.  Traced tensors print
+    their RUNTIME value via jax.debug.print (a trace-time builtin print
+    would show tracer objects once); concrete values use builtin print.
+    ``sep`` is honored under trace; ``end``/``file`` (and backends
+    without host callbacks, e.g. the axon tunnel) fall back to the
+    trace-time builtin print."""
+    traced = any(_is_traced(a) for a in args)
+    if (traced and _callbacks_supported()
+            and not (set(kw) - {"sep"})):
+        import jax
+        sep = kw.get("sep", " ")
+        fmt = sep.join("{}" for _ in args)
+        jax.debug.print(fmt, *[_jst_bool(a) if _is_traced(a) else a
+                               for a in args])
+        return None
+    return print(*args, **kw)
+
+
+def _jst_cast(x, ty):
+    """reference: cast_transformer.py → convert_var_dtype.  Traced
+    tensors lower to astype (int→int64, float→float32, bool→bool);
+    concrete values keep exact Python builtin semantics."""
+    if _is_traced(x):
+        from ..core.tensor import Tensor
+        t = x if isinstance(x, Tensor) else Tensor(x)
+        return t.astype({"bool": "bool", "int": "int64",
+                         "float": "float32"}[ty])
+    v = _jst_bool(x)  # unwrap Tensor -> array for the builtin
+    return {"bool": bool, "int": int, "float": float}[ty](v)
+
+
+def _jst_assert(test, msg_fn=None):
+    """reference: assert_transformer.py → layers.Assert.  Concrete
+    predicates keep Python assert semantics (``msg_fn`` is a thunk,
+    evaluated ONLY on failure, like Python's lazy assert message);
+    traced predicates check at RUNTIME through jax.debug.callback.  On
+    backends without host callbacks (axon tunnel) the traced path falls
+    back to ``bool(test)`` — the loud guided trace error, exactly the
+    pre-conversion behavior."""
+    def _msg():
+        return (msg_fn() if callable(msg_fn) else msg_fn) \
+            if msg_fn is not None else "dy2static assert failed"
+
+    if not _is_traced(test):
+        if not _jst_bool(test):
+            raise AssertionError(_msg())
+        return None
+    if not _callbacks_supported():
+        if not bool(test):  # raises the guided tensor-bool error
+            raise AssertionError(_msg())  # pragma: no cover
+        return None
+    import jax
+
+    def _check(ok):
+        if not ok:
+            raise AssertionError(_msg())
+
+    jax.debug.callback(_check, _jst_bool(test))
+    return None
+
+
+class _BuiltinTransformer(ast.NodeTransformer):
+    """reference: print_transformer.py + cast_transformer.py +
+    assert_transformer.py — `print(...)`, `int/float/bool(x)`, and
+    `assert` route through runtime converters that preserve eager
+    semantics and lower tensors under trace.
+
+    Names the function SHADOWS (params, local assignments, or module
+    globals/closure bindings) are left untouched — rewriting them would
+    silently hijack user callables."""
+
+    _CASTS = {"int", "float", "bool"}
+
+    def __init__(self, shadowed=frozenset()):
+        self.converted = 0
+        self._shadowed = shadowed
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if not isinstance(node.func, ast.Name):
+            return node
+        name = node.func.id
+        if name in self._shadowed:
+            return node
+        if name == "print":
+            node.func = ast.Name(id="_jst_print", ctx=ast.Load())
+            self.converted += 1
+        elif (name in self._CASTS and len(node.args) == 1
+                and not node.keywords):
+            node = ast.Call(
+                func=ast.Name(id="_jst_cast", ctx=ast.Load()),
+                args=[node.args[0], ast.Constant(value=name)],
+                keywords=[])
+            self.converted += 1
+        return node
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        args = [node.test]
+        if node.msg is not None:
+            # lazy message thunk: Python evaluates the msg expression
+            # only when the assert FAILS
+            args.append(ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[],
+                                   kwonlyargs=[], kw_defaults=[],
+                                   defaults=[]),
+                body=node.msg))
+        self.converted += 1
+        return ast.Expr(value=ast.Call(
+            func=ast.Name(id="_jst_assert", ctx=ast.Load()),
+            args=args, keywords=[]))
+
+
 import weakref
 
 # weak keys: dynamically created helpers (per-step closures, factory
@@ -746,6 +885,27 @@ def convert_control_flow(fn: Callable) -> Callable:
     fdef.decorator_list = []  # run undecorated (to_static wraps us)
     tr = _IfElseTransformer()
     tr.visit(tree)
+    # print/cast/assert rewrite BEFORE loops so their statement forms
+    # (whitelisted in _body_ok) don't block loop conversion.  Shadowed
+    # builtin names (params, local stores, module/closure bindings)
+    # stay untouched.
+    shadowed = {a.arg for a in (fdef.args.args + fdef.args.posonlyargs
+                                + fdef.args.kwonlyargs)}
+    shadowed |= {n.id for n in ast.walk(fdef)
+                 if isinstance(n, ast.Name)
+                 and isinstance(n.ctx, ast.Store)}
+    env0 = dict(fn.__globals__)
+    if fn.__closure__:
+        try:
+            env0.update({k: c.cell_contents
+                         for k, c in zip(fn.__code__.co_freevars,
+                                         fn.__closure__)})
+        except ValueError:
+            pass
+    shadowed |= {n for n in ("print", "int", "float", "bool")
+                 if env0.get(n) is not None}
+    bt = _BuiltinTransformer(shadowed=frozenset(shadowed))
+    bt.visit(tree)
     lt = _LoopTransformer()
     lt.visit(tree)
     tr2 = _IfElseTransformer()
@@ -754,21 +914,19 @@ def convert_control_flow(fn: Callable) -> Callable:
         # early-return surfacing with the function's trailing return
         tr2.visit(tree)
 
-    # nested calls (resolved against decoration-time globals/closure)
-    env = dict(fn.__globals__)
-    if fn.__closure__:
-        try:
-            env.update({k: c.cell_contents
-                        for k, c in zip(fn.__code__.co_freevars,
-                                        fn.__closure__)})
-        except ValueError:
-            pass
+    # nested calls (resolved against the same decoration-time env the
+    # builtin-shadow scan used)
+    env = env0
     ct = _CallTransformer(
         lambda name: _convertible_user_fn(env.get(name)))
     ct.visit(tree)
 
+    # bt-only conversions recompile ONLY closure-free functions: the
+    # recompile snapshots closure cells, and freezing live closures
+    # just to route a print is a bad trade (review-confirmed regression)
+    bt_counts = bt.converted if not fn.__closure__ else 0
     if not (tr.converted or lt.converted or tr2.converted
-            or ct.converted):
+            or ct.converted or bt_counts):
         return fn
     ast.fix_missing_locations(tree)
     try:
@@ -779,7 +937,8 @@ def convert_control_flow(fn: Callable) -> Callable:
     glb.update(_jst_cond=_jst_cond, _jst_while=_jst_while,
                _jst_and=_jst_and,
                _jst_or=_jst_or, _jst_not=_jst_not, _jst_lt=_jst_lt,
-               _jst_call=_jst_call)
+               _jst_call=_jst_call, _jst_print=_jst_print,
+               _jst_cast=_jst_cast, _jst_assert=_jst_assert)
     # snapshot closure cells into globals (documented limitation: the
     # converted function sees decoration-time closure values)
     if fn.__closure__:
