@@ -104,6 +104,39 @@ def _jst_or(a, b):
     return bool(_jst_bool(a)) or bool(_jst_bool(b))
 
 
+def _jst_land(l_fn, r_fn):
+    """reference: convert_operators.convert_logical_and — thunked so the
+    right operand only evaluates when Python would evaluate it; traced
+    operands lower to jnp.logical_and, concrete ones keep Python's
+    `and` (including returning the operand, not a bool)."""
+    a = l_fn()
+    if _is_traced(a):
+        import jax.numpy as jnp
+        return jnp.logical_and(_jst_bool(a), _jst_bool(r_fn()))
+    if not _jst_bool(a):
+        return a
+    b = r_fn()
+    if _is_traced(b):
+        import jax.numpy as jnp
+        return jnp.logical_and(True, _jst_bool(b))
+    return b
+
+
+def _jst_lor(l_fn, r_fn):
+    """convert_logical_or analog (see _jst_land)."""
+    a = l_fn()
+    if _is_traced(a):
+        import jax.numpy as jnp
+        return jnp.logical_or(_jst_bool(a), _jst_bool(r_fn()))
+    if _jst_bool(a):
+        return a
+    b = r_fn()
+    if _is_traced(b):
+        import jax.numpy as jnp
+        return jnp.logical_or(False, _jst_bool(b))
+    return b
+
+
 def _jst_lt(a, b):
     av, bv = _jst_bool(a), _jst_bool(b)
     return av < bv
@@ -265,12 +298,18 @@ class _IfElseTransformer(ast.NodeTransformer):
     # -- pattern 1: both-branch assignments ---------------------------------
     def _convert_assign_if(self, node: ast.If,
                            bound: Set[str]) -> Optional[List[ast.stmt]]:
-        if not node.orelse:
-            return None
         ra = _assigned_names(node.body)
-        rb = _assigned_names(node.orelse)
-        if ra is None or rb is None:
+        if ra is None:
             return None
+        if node.orelse:
+            rb = _assigned_names(node.orelse)
+            if rb is None:
+                return None
+        else:
+            # single-arm if: synthesize an identity else — legal only
+            # when every assigned name is provably bound before the if
+            # (the else branch "assigns" each name to itself)
+            rb = (ra[0], set(ra[0]))
         (a, pre_a), (b, pre_b) = ra, rb
         if not a or a != b:
             return None
@@ -765,6 +804,42 @@ def _jst_assert(test, msg_fn=None):
     return None
 
 
+class _LogicalTransformer(ast.NodeTransformer):
+    """reference: logical_transformer.py — `a and b` / `a or b` / `not a`
+    on tensors would hit the loud bool() trace error; rewrite them to
+    thunked converters that keep exact Python short-circuit semantics
+    for concrete values and lower to jnp logical ops when traced."""
+
+    def __init__(self):
+        self.converted = 0
+
+    @staticmethod
+    def _thunk(expr):
+        return ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=expr)
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        name = "_jst_land" if isinstance(node.op, ast.And) else "_jst_lor"
+        out = node.values[0]
+        for rhs in node.values[1:]:
+            out = ast.Call(func=ast.Name(id=name, ctx=ast.Load()),
+                           args=[self._thunk(out), self._thunk(rhs)],
+                           keywords=[])
+        self.converted += 1
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            self.converted += 1
+            return ast.Call(func=ast.Name(id="_jst_not", ctx=ast.Load()),
+                            args=[node.operand], keywords=[])
+        return node
+
+
 class _BuiltinTransformer(ast.NodeTransformer):
     """reference: print_transformer.py + cast_transformer.py +
     assert_transformer.py — `print(...)`, `int/float/bool(x)`, and
@@ -906,6 +981,8 @@ def convert_control_flow(fn: Callable) -> Callable:
                  if env0.get(n) is not None}
     bt = _BuiltinTransformer(shadowed=frozenset(shadowed))
     bt.visit(tree)
+    lg = _LogicalTransformer()
+    lg.visit(tree)
     lt = _LoopTransformer()
     lt.visit(tree)
     tr2 = _IfElseTransformer()
@@ -921,12 +998,13 @@ def convert_control_flow(fn: Callable) -> Callable:
         lambda name: _convertible_user_fn(env.get(name)))
     ct.visit(tree)
 
-    # bt-only conversions recompile ONLY closure-free functions: the
+    # bt/lg-only conversions recompile ONLY closure-free functions: the
     # recompile snapshots closure cells, and freezing live closures
-    # just to route a print is a bad trade (review-confirmed regression)
-    bt_counts = bt.converted if not fn.__closure__ else 0
+    # just to route a print or an `and` is a bad trade
+    # (review-confirmed regression)
+    soft = (bt.converted + lg.converted) if not fn.__closure__ else 0
     if not (tr.converted or lt.converted or tr2.converted
-            or ct.converted or bt_counts):
+            or ct.converted or soft):
         return fn
     ast.fix_missing_locations(tree)
     try:
@@ -938,7 +1016,8 @@ def convert_control_flow(fn: Callable) -> Callable:
                _jst_and=_jst_and,
                _jst_or=_jst_or, _jst_not=_jst_not, _jst_lt=_jst_lt,
                _jst_call=_jst_call, _jst_print=_jst_print,
-               _jst_cast=_jst_cast, _jst_assert=_jst_assert)
+               _jst_cast=_jst_cast, _jst_assert=_jst_assert,
+               _jst_land=_jst_land, _jst_lor=_jst_lor)
     # snapshot closure cells into globals (documented limitation: the
     # converted function sees decoration-time closure values)
     if fn.__closure__:

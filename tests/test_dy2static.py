@@ -75,11 +75,28 @@ def test_static_if_on_python_value_untouched():
     assert float(f(paddle.to_tensor(np.array([2.0], np.float32)))) == 4.0
 
 
+def test_single_arm_if_converts():
+    """`if c: x = x * 2` with x pre-bound synthesizes an identity else
+    (round-5 extension; this used to bail)."""
+    @jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            x = x * 2
+        return x
+
+    assert float(np.asarray(f(paddle.to_tensor(
+        np.array([3.0], np.float32))).data)[0]) == 6.0
+    assert float(np.asarray(f(paddle.to_tensor(
+        np.array([-3.0], np.float32))).data)[0]) == -3.0
+
+
 def test_unconvertible_pattern_still_fails_loudly():
     @jit.to_static
     def f(x):
         if x.sum() > 0:
-            x = x * 2          # assigned in one branch only: no convert
+            y = x * 2      # branches assign DIFFERENT names: no convert
+        else:
+            z = x
         return x
 
     with pytest.raises(TypeError, match="paddle.cond"):
@@ -817,3 +834,59 @@ def test_print_assert_fallback_without_host_callbacks(monkeypatch):
 
     with pytest.raises(TypeError, match="paddle.cond"):
         g(paddle.to_tensor(np.array([1.0], np.float32)))
+
+
+# ---- logical transformer (reference: logical_transformer.py) -----------
+
+def test_logical_and_or_not_on_tensors():
+    @jit.to_static
+    def f(x):
+        if (x.sum() > 0) and (x.max() < 10):
+            y = x * 2
+        else:
+            y = x * 0
+        if not (x.sum() > 100) or (x.min() < -50):
+            y = y + 1
+        return y
+
+    out = f(paddle.to_tensor(np.array([2.0], np.float32)))
+    assert float(np.asarray(out.data)[0]) == 5.0  # 2*2 + 1
+    out2 = f(paddle.to_tensor(np.array([20.0], np.float32)))
+    assert float(np.asarray(out2.data)[0]) == 1.0  # else branch, +1
+
+
+def test_logical_short_circuit_preserved_eager():
+    from paddle_tpu.jit.dy2static import convert_control_flow
+    calls = []
+
+    def right():
+        calls.append(1)
+        return "rhs"
+
+    def f(flag):
+        a = flag and right()
+        b = flag or right()
+        return a, b
+
+    conv = convert_control_flow(f)
+    a, b = conv(False)
+    # `and` short-circuits (rhs NOT evaluated), returns the operand
+    assert a is False and len(calls) == 1  # only the `or` ran rhs
+    assert b == "rhs"
+    calls.clear()
+    a, b = conv(True)
+    assert a == "rhs" and b is True and len(calls) == 1
+
+
+def test_logical_in_while_test():
+    @jit.to_static
+    def f(x):
+        s = x * 0
+        i = 0
+        while (i < 10) and (s.sum() < 5):
+            s = s + x
+            i = i + 1
+        return s
+
+    out = f(paddle.to_tensor(np.array([2.0], np.float32)))
+    assert float(np.asarray(out.data)[0]) == 6.0  # 2,4,6 -> stop
