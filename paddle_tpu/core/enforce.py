@@ -62,6 +62,17 @@ class ExternalError(EnforceError):
     code = "EXTERNAL"
 
 
+class GraphVerificationError(PreconditionNotMetError):
+    """A static Program failed compile-time verification
+    (static/analysis).  Carries the structured, source-anchored
+    ``Diagnostic`` list on ``.diagnostics`` so tooling can render or
+    filter findings instead of re-parsing the message."""
+
+    def __init__(self, message="", diagnostics=()):
+        super().__init__(message)
+        self.diagnostics = list(diagnostics)
+
+
 def enforce(cond, msg="", exc=InvalidArgumentError):
     """PADDLE_ENFORCE parity: raise typed error when cond is false."""
     if not cond:

@@ -93,6 +93,12 @@ define_flag("profile_dir", "",
 define_flag("pallas_attention_min_seqlen", 1024,
             "Use the Pallas flash-attention kernel at/above this sequence "
             "length (below it XLA's fused attention is faster on-chip).")
+define_flag("static_verify", False,
+            "Run static.analysis verification (def-use, cross-program "
+            "leaks, shape/dtype drift, name collisions, dead code) on "
+            "each Program before its first compile, and record file:line "
+            "anchors for every op at build time.  Off by default: "
+            "verification adds one eval_shape re-trace per op.")
 define_flag("pallas_attention_dropout_min_seqlen", 512,
             "Flash threshold when attention dropout is active: the XLA "
             "path must materialize [B,H,L,L] dropout masks in HBM, so "

@@ -29,6 +29,7 @@ load is already disjoint across workers.
 from __future__ import annotations
 
 import glob as _glob
+import hashlib
 import json
 import os
 import pickle
@@ -36,6 +37,7 @@ import subprocess
 import time
 
 import numpy as np
+
 
 __all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset"]
 
@@ -75,9 +77,23 @@ def _wait_for(paths, timeout, what):
 
 
 class _DatasetBase:
-    """Shared config surface (reference: fluid/dataset.py DatasetBase)."""
+    """Shared config surface (reference: fluid/dataset.py DatasetBase).
 
-    def __init__(self, rank=None, world_size=None):
+    ``name`` namespaces any shared-filesystem state this dataset writes
+    (InMemoryDataset's shuffle spool); QueueDataset accepts and ignores
+    it (no shared state)."""
+
+    def __init__(self, rank=None, world_size=None, name=None):
+        if name is not None and (set(str(name)) & set("*?[]")
+                                 or os.sep in str(name)
+                                 or str(name).startswith(".")):
+            # the name becomes a spool directory prefix AND a glob
+            # pattern (reaping); separators would nest roots, glob
+            # metachars would break cleanup forever
+            raise ValueError(
+                f"dataset name {name!r} must not contain path "
+                f"separators, leading dots, or glob characters *?[]")
+        self._name = name
         self._filelist = []
         self._batch_size = 1
         self._thread_num = 1
@@ -133,12 +149,26 @@ class _DatasetBase:
 class InMemoryDataset(_DatasetBase):
     """reference: data_set.h InMemoryDataset (global/local shuffle)."""
 
-    def __init__(self, rank=None, world_size=None):
-        super().__init__(rank, world_size)
+    def __init__(self, rank=None, world_size=None, name=None):
+        super().__init__(rank, world_size, name=name)
         self._records = []
         self._loaded = False
         self._epoch = 0
         self._generation = 0  # per-instance global_shuffle call counter
+        self._prev_ns = None  # namespace the PREVIOUS generation used
+
+    def _spool_namespace(self) -> str:
+        """Deterministic, SPMD-agreeing namespace isolating this
+        dataset's spool files from other datasets sharing the same
+        spool_dir: the explicit ``name=`` when given, else a fingerprint
+        of the filelist (every rank sets the identical full filelist, so
+        the hash agrees without coordination).  Two datasets with the
+        SAME filelist sharing one spool_dir must be given distinct
+        names."""
+        if self._name:
+            return str(self._name)
+        h = hashlib.md5("\n".join(self._filelist).encode()).hexdigest()
+        return f"ds{h[:8]}"
 
     # -- reference API -------------------------------------------------
     def load_into_memory(self):
@@ -191,7 +221,9 @@ class InMemoryDataset(_DatasetBase):
         # Different jobs must still use distinct spool dirs.
         gen = self._generation
         self._generation += 1
-        root = os.path.join(spool_dir, f"gs_{gen}_{seed}")
+        ns = self._spool_namespace()
+        prev_ns, self._prev_ns = self._prev_ns, ns
+        root = os.path.join(spool_dir, f"{ns}_gs_{gen}_{seed}")
         os.makedirs(root, exist_ok=True)
 
         # phase 1: publish local counts; derive global offsets
@@ -255,15 +287,19 @@ class InMemoryDataset(_DatasetBase):
         # done sentinel: proves this worker finished READING, which is
         # what makes the deferred cleanup below safe
         open(os.path.join(root, f"done_{self._rank}"), "w").close()
-        self._reap_previous_generation(spool_dir, gen)
+        self._reap_previous_generation(spool_dir, gen, prev_ns)
 
-    def _reap_previous_generation(self, spool_dir, gen):
+    def _reap_previous_generation(self, spool_dir, gen, prev_ns):
         """Delete generation ``gen - 1``'s spool once every worker's done
         sentinel proves no one still reads it (rank 0 only, best effort:
-        a missing sentinel just defers cleanup)."""
-        if self._rank != 0 or gen == 0:
+        a missing sentinel just defers cleanup).  ``prev_ns`` is the
+        namespace that generation was WRITTEN under — set_filelist
+        between shuffles changes the fingerprint, and reaping under the
+        new one would orphan the old dirs."""
+        if self._rank != 0 or gen == 0 or prev_ns is None:
             return
-        prev = _glob.glob(os.path.join(spool_dir, f"gs_{gen - 1}_*"))
+        prev = _glob.glob(os.path.join(
+            spool_dir, f"{prev_ns}_gs_{gen - 1}_*"))
         for d in prev:
             if all(os.path.exists(os.path.join(d, f"done_{r}"))
                    for r in range(self._world)):
@@ -326,11 +362,12 @@ class DatasetFactory:
               "QueueDataset": QueueDataset}
 
     def create_dataset(self, datafeed_class="QueueDataset", rank=None,
-                       world_size=None):
+                       world_size=None, name=None):
         if datafeed_class not in self._KINDS:
             raise ValueError(
                 f"unknown dataset class {datafeed_class!r}; expected one "
                 f"of {sorted(self._KINDS)}")
-        return self._KINDS[datafeed_class](rank=rank, world_size=world_size)
+        return self._KINDS[datafeed_class](rank=rank, world_size=world_size,
+                                           name=name)
 
 

@@ -2,6 +2,7 @@
 (reference: python/paddle/fluid/dygraph/jit.py + dygraph_to_static/;
 SURVEY §7 step 2 'dual-mode dispatch')."""
 from .bind import bind, buffer_arrays, param_arrays, param_list  # noqa
+from .lint import LintDiagnostic, lint  # noqa: F401
 from .save_load import TranslatedLayer, load, save  # noqa: F401
 from .static_function import InputSpec, StaticFunction, to_static  # noqa
 from .train_step import TrainStep  # noqa: F401

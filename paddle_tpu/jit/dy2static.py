@@ -945,30 +945,23 @@ class _CallTransformer(ast.NodeTransformer):
         return node
 
 
-def convert_control_flow(fn: Callable) -> Callable:
-    """Return ``fn`` with convertible tensor-``if`` patterns rewritten to
-    paddle.cond dispatch; returns ``fn`` unchanged when no pattern
-    converts or the source is unavailable (lambdas, C funcs, REPL)."""
-    try:
-        src = textwrap.dedent(inspect.getsource(fn))
-        tree = ast.parse(src)
-    except (OSError, TypeError, SyntaxError):
-        return fn
-    fdef = tree.body[0]
-    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
-        return fn
-    fdef.decorator_list = []  # run undecorated (to_static wraps us)
-    tr = _IfElseTransformer()
-    tr.visit(tree)
-    # print/cast/assert rewrite BEFORE loops so their statement forms
-    # (whitelisted in _body_ok) don't block loop conversion.  Shadowed
-    # builtin names (params, local stores, module/closure bindings)
-    # stay untouched.
+def _shadowed_builtins(fdef, env0) -> Set[str]:
+    """Names the function shadows (params, local stores, module/closure
+    bindings of print/int/float/bool) — the builtin transformer must not
+    rewrite calls through them."""
     shadowed = {a.arg for a in (fdef.args.args + fdef.args.posonlyargs
                                 + fdef.args.kwonlyargs)}
     shadowed |= {n.id for n in ast.walk(fdef)
                  if isinstance(n, ast.Name)
                  and isinstance(n.ctx, ast.Store)}
+    shadowed |= {n for n in ("print", "int", "float", "bool")
+                 if env0.get(n) is not None}
+    return shadowed
+
+
+def _decoration_env(fn) -> dict:
+    """Globals + snapshot of closure cells — the name environment both
+    the builtin-shadow scan and the call transformer resolve against."""
     env0 = dict(fn.__globals__)
     if fn.__closure__:
         try:
@@ -977,9 +970,37 @@ def convert_control_flow(fn: Callable) -> Callable:
                                          fn.__closure__)})
         except ValueError:
             pass
-    shadowed |= {n for n in ("print", "int", "float", "bool")
-                 if env0.get(n) is not None}
-    bt = _BuiltinTransformer(shadowed=frozenset(shadowed))
+    return env0
+
+
+def _transform_tree(fn):
+    """Parse ``fn``'s source and run the full transformer pipeline
+    WITHOUT compiling or executing anything.
+
+    Returns ``(tree, fdef, counters)`` — the mutated module tree, its
+    FunctionDef, and per-transformer conversion counts — or ``None``
+    when the source is unavailable / not a plain function def.  Shared
+    by :func:`convert_control_flow` (which compiles the result) and
+    jit/lint.py (which diffs the tree against the original to find what
+    stayed unconverted)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []  # run undecorated (to_static wraps us)
+    tr = _IfElseTransformer()
+    tr.visit(tree)
+    # print/cast/assert rewrite BEFORE loops so their statement forms
+    # (whitelisted in _body_ok) don't block loop conversion.  Shadowed
+    # builtin names (params, local stores, module/closure bindings)
+    # stay untouched.
+    env0 = _decoration_env(fn)
+    bt = _BuiltinTransformer(
+        shadowed=frozenset(_shadowed_builtins(fdef, env0)))
     bt.visit(tree)
     lg = _LogicalTransformer()
     lg.visit(tree)
@@ -993,18 +1014,31 @@ def convert_control_flow(fn: Callable) -> Callable:
 
     # nested calls (resolved against the same decoration-time env the
     # builtin-shadow scan used)
-    env = env0
     ct = _CallTransformer(
-        lambda name: _convertible_user_fn(env.get(name)))
+        lambda name: _convertible_user_fn(env0.get(name)))
     ct.visit(tree)
+    counters = {"ifelse": tr.converted + tr2.converted,
+                "loops": lt.converted, "builtins": bt.converted,
+                "logical": lg.converted, "calls": ct.converted}
+    return tree, fdef, counters
 
-    # bt/lg-only conversions recompile ONLY closure-free functions: the
-    # recompile snapshots closure cells, and freezing live closures
-    # just to route a print or an `and` is a bad trade
+
+def convert_control_flow(fn: Callable) -> Callable:
+    """Return ``fn`` with convertible tensor-``if`` patterns rewritten to
+    paddle.cond dispatch; returns ``fn`` unchanged when no pattern
+    converts or the source is unavailable (lambdas, C funcs, REPL)."""
+    res = _transform_tree(fn)
+    if res is None:
+        return fn
+    tree, fdef, counters = res
+    # builtin/logical-only conversions recompile ONLY closure-free
+    # functions: the recompile snapshots closure cells, and freezing
+    # live closures just to route a print or an `and` is a bad trade
     # (review-confirmed regression)
-    soft = (bt.converted + lg.converted) if not fn.__closure__ else 0
-    if not (tr.converted or lt.converted or tr2.converted
-            or ct.converted or soft):
+    soft = ((counters["builtins"] + counters["logical"])
+            if not fn.__closure__ else 0)
+    if not (counters["ifelse"] or counters["loops"]
+            or counters["calls"] or soft):
         return fn
     ast.fix_missing_locations(tree)
     try:

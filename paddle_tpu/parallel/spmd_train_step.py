@@ -54,11 +54,10 @@ def _numel(shape):
 
 def _pvary(x, axis):
     """Mark ``x`` as device-varying over ``axis`` inside shard_map
-    (jax>=0.9 spells this lax.pcast(to='varying'))."""
-    pcast = getattr(jax.lax, "pcast", None)
-    if pcast is not None:
-        return pcast(x, axis, to="varying")
-    return jax.lax.pvary(x, axis)
+    (jax>=0.9 spells this lax.pcast(to='varying'); identity on jax
+    without varying types)."""
+    from ..core.jax_compat import pvary
+    return pvary(x, axis)
 
 
 def _shardable(shape, n):
@@ -294,8 +293,9 @@ class SpmdTrainStep(TrainStep):
                     lambda a: jax.lax.pmean(a, DP_AXIS), new_b)
                 return loss, new_b, grads
 
+            from ..core.jax_compat import shard_map
             P = PartitionSpec
-            return jax.shard_map(
+            return shard_map(
                 local, mesh=mesh,
                 in_specs=(P(DP_AXIS), P(DP_AXIS), P()),
                 out_specs=P())(mb_inputs, mb_labels, kidx)

@@ -19,6 +19,7 @@ Known deviations (documented, by design):
 """
 from __future__ import annotations
 
+from . import analysis  # noqa: F401
 from . import nn  # noqa: F401
 from .executor import Executor, global_scope  # noqa: F401
 from .io import load_inference_model, save_inference_model  # noqa: F401
@@ -32,7 +33,7 @@ __all__ = [
     "default_startup_program", "program_guard", "Executor",
     "global_scope", "save_inference_model", "load_inference_model",
     "InputSpec", "nn", "BuildStrategy", "CompiledProgram",
-    "reset_default_programs",
+    "reset_default_programs", "analysis",
 ]
 
 

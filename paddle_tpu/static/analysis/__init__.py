@@ -1,0 +1,35 @@
+"""Program IR analysis: def-use graph + verifier pass framework.
+
+TPU-native analog of the reference's ``ir::Graph`` + ``Pass`` layer
+(reference: paddle/fluid/framework/ir/graph.h, pass.h,
+graph_helper.cc HasCircle/TopologySortOperations): a compile-time
+analysis tier over the recorded ``_OpNode`` list that catches malformed
+programs BEFORE they reach ``jax.jit``, where the same defects surface
+as cryptic trace errors deep inside XLA lowering.
+
+Entry points:
+
+- :func:`check` — run verifier passes, return structured
+  :class:`Diagnostic` objects (never raises);
+- :func:`verify` — run :func:`check` and raise
+  :class:`~paddle_tpu.core.enforce.GraphVerificationError` on errors
+  (``Program.verify()`` delegates here);
+- ``FLAGS_static_verify`` (core/flags.py) — makes ``static.Executor``
+  verify each (program, version) once before its first compile.
+
+Every future graph-transform pass (fused computation-collective
+scheduling, mega-kernelization) builds on :class:`DefUseGraph`'s
+producer/consumer infrastructure.
+"""
+from .graph import DefUseGraph  # noqa: F401
+from .passes import (PASS_REGISTRY, AnalysisPass, CrossProgramLeakPass,  # noqa
+                     DeadCodePass, Diagnostic, NameCollisionPass,
+                     ShapeDtypeConsistencyPass, UseBeforeProducePass,
+                     check, default_passes, verify)
+
+__all__ = [
+    "DefUseGraph", "Diagnostic", "AnalysisPass", "UseBeforeProducePass",
+    "CrossProgramLeakPass", "DeadCodePass", "ShapeDtypeConsistencyPass",
+    "NameCollisionPass", "check", "verify", "default_passes",
+    "PASS_REGISTRY",
+]

@@ -1,0 +1,115 @@
+"""Def-use graph over a Program's recorded op list.
+
+Reference: paddle/fluid/framework/ir/graph.h builds Node(op)/Node(var)
+bipartite edges from each OpDesc's inputs/outputs; graph_helper.cc walks
+them for cycle checks and topological order.  Here the op list is already
+topologically ordered by construction (append-only recording), so the
+graph's job is the def-use indexing the verifier passes (and every future
+transform pass) need: who produces each Variable, who consumes it, and
+which ops are reachable backwards from a set of fetch roots.
+
+Variables are keyed by IDENTITY (``id(var)``), not name — name collisions
+are one of the defect classes the verifier must detect, so the graph
+cannot assume names are unique.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..program import Program, Variable
+
+
+class DefUseGraph:
+    """Producers/consumers index for one Program.
+
+    - ``producer_of[id(v)]`` — node index whose ``out_vars`` contains v;
+    - ``consumers_of[id(v)]`` — node indexes reading v, via ``in_specs``
+      ("v" entries) or ``extra_vars`` (control-flow replay closures);
+    - ``feeds`` — name → Variable roots declared by ``static.data``;
+    - ``params_of[i]`` — Parameters node i reads (in_specs "p" entries
+      plus ``extra_params``).
+    """
+
+    def __init__(self, program: Program):
+        self.program = program
+        self.nodes = list(program.nodes)
+        self.feeds: Dict[str, Variable] = dict(program.feed_vars)
+        self.producer_of: Dict[int, int] = {}
+        self.consumers_of: Dict[int, List[int]] = {}
+        self.params_of: Dict[int, list] = {}
+        # (var, first_producer, second_producer): a Variable re-emitted
+        # by a later node — a spliced/duplicated transform output
+        self.duplicate_producers: List[Tuple[Variable, int, int]] = []
+        # id -> Variable for every var that appears anywhere (outputs,
+        # inputs, extra replay refs, feeds); ids alone are not enough for
+        # diagnostics, which want names/shapes
+        self.vars: Dict[int, Variable] = {}
+
+        for v in self.feeds.values():
+            self.vars[id(v)] = v
+        for i, node in enumerate(self.nodes):
+            for v in node.out_vars:
+                self.vars[id(v)] = v
+                first = self.producer_of.setdefault(id(v), i)
+                if first != i:  # re-recorded output: a defect
+                    self.duplicate_producers.append((v, first, i))
+            params = []
+            for tag, x in node.in_specs:
+                if tag == "v":
+                    self.vars[id(x)] = x
+                    self.consumers_of.setdefault(id(x), []).append(i)
+                elif tag == "p":
+                    params.append(x)
+            for x in node.extra_vars:
+                self.vars[id(x)] = x
+                self.consumers_of.setdefault(id(x), []).append(i)
+            params.extend(node.extra_params)
+            self.params_of[i] = params
+
+    # -- queries ----------------------------------------------------------
+    def node_inputs(self, i: int) -> List[Tuple[Variable, str]]:
+        """Variables node ``i`` reads, as (var, kind) with kind "in" for
+        direct in_specs operands and "extra" for replay-closure refs."""
+        node = self.nodes[i]
+        out = [(x, "in") for tag, x in node.in_specs if tag == "v"]
+        out.extend((x, "extra") for x in node.extra_vars)
+        return out
+
+    def is_feed(self, v: Variable) -> bool:
+        return any(f is v for f in self.feeds.values())
+
+    def resolve_fetch(self, f) -> Optional[Variable]:
+        """Map a fetch_list entry (Variable or name string) to a Variable
+        known to this graph; None when the name resolves nowhere."""
+        if isinstance(f, Variable):
+            return f
+        if isinstance(f, str):
+            for v in self.vars.values():
+                if v.name == f:
+                    return v
+        return None
+
+    def live_nodes(self, fetch_vars: Sequence[Variable]) -> Set[int]:
+        """Node indexes reachable backwards from ``fetch_vars`` (the
+        reference's prune/backward-DFS over the ir::Graph)."""
+        live: Set[int] = set()
+        stack = [self.producer_of[id(v)] for v in fetch_vars
+                 if id(v) in self.producer_of]
+        while stack:
+            i = stack.pop()
+            if i in live:
+                continue
+            live.add(i)
+            for v, _kind in self.node_inputs(i):
+                p = self.producer_of.get(id(v))
+                if p is not None and p not in live:
+                    stack.append(p)
+        return live
+
+    def loc_of(self, i: int) -> Optional[str]:
+        """file:line anchor recorded for node ``i`` (present when
+        FLAGS_static_verify was on at record time)."""
+        loc = getattr(self.nodes[i], "loc", None)
+        if loc is None:
+            return None
+        return f"{loc[0]}:{loc[1]}"

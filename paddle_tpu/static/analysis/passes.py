@@ -1,0 +1,390 @@
+"""Verifier passes over the def-use graph.
+
+Reference: paddle/fluid/framework/ir/pass.h (Pass::Apply over ir::Graph)
++ the checking passes the reference runs before execution
+(graph_helper.cc HasCircle, lock_free_optimize_pass's def-use checks,
+framework.py Program._prune's backward reachability).  Each pass is a
+small object with a ``name`` and ``run(graph, fetch_list)`` returning
+structured :class:`Diagnostic` records; :func:`check` runs a pass
+pipeline, :func:`verify` raises ``GraphVerificationError`` when any
+error-severity diagnostic survives.
+
+Defect classes covered (ISSUE: the five the Executor cannot catch before
+``jax.jit`` explodes):
+
+- use-before-produce / never-produced operands (broken topological
+  order after a transform, or a Variable fabricated outside recording);
+- cross-program leaks (a Variable recorded in program A consumed by
+  ops of program B — the reference's
+  "TensorCopy between different workspaces" bug class);
+- dead ops / unused feeds relative to the fetch targets;
+- shape/dtype drift (recorded output avals no longer reproducible from
+  the inputs — e.g. a Parameter was re-assigned with a new shape after
+  recording);
+- variable-name collisions (the Executor's env is name-keyed; two
+  distinct Variables sharing a name silently alias).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ...core.enforce import GraphVerificationError
+from ..program import Program, Variable
+from .graph import DefUseGraph
+
+__all__ = [
+    "Diagnostic", "AnalysisPass", "UseBeforeProducePass",
+    "CrossProgramLeakPass", "DeadCodePass", "ShapeDtypeConsistencyPass",
+    "NameCollisionPass", "check", "verify", "default_passes",
+    "PASS_REGISTRY",
+]
+
+
+class Diagnostic:
+    """One structured finding (severity, pass, message, op/var anchors).
+
+    ``loc`` is a ``file:line`` string when the op recorded a source
+    anchor (FLAGS_static_verify on at build time), else None.
+    """
+
+    __slots__ = ("severity", "pass_name", "message", "op_index",
+                 "op_name", "var_name", "loc")
+
+    ERROR = "error"
+    WARNING = "warning"
+
+    def __init__(self, severity: str, pass_name: str, message: str,
+                 op_index: Optional[int] = None,
+                 op_name: Optional[str] = None,
+                 var_name: Optional[str] = None,
+                 loc: Optional[str] = None):
+        self.severity = severity
+        self.pass_name = pass_name
+        self.message = message
+        self.op_index = op_index
+        self.op_name = op_name
+        self.var_name = var_name
+        self.loc = loc
+
+    def __str__(self):
+        anchor = ""
+        if self.op_index is not None:
+            anchor = f" (op #{self.op_index}"
+            if self.op_name:
+                anchor += f" {self.op_name}"
+            if self.loc:
+                anchor += f" @ {self.loc}"
+            anchor += ")"
+        elif self.loc:
+            anchor = f" (@ {self.loc})"
+        return (f"[{self.pass_name}] {self.severity}: "
+                f"{self.message}{anchor}")
+
+    def __repr__(self):
+        return f"Diagnostic({self!s})"
+
+
+class AnalysisPass:
+    """Base pass protocol (reference: ir/pass.h Pass)."""
+
+    name = "analysis-pass"
+
+    def run(self, graph: DefUseGraph,
+            fetch_list: Optional[Sequence] = None) -> List[Diagnostic]:
+        raise NotImplementedError
+
+    # -- shared helpers ----------------------------------------------------
+    def _diag(self, graph, severity, message, op_index=None,
+              var_name=None):
+        op_name = (graph.nodes[op_index].op_name
+                   if op_index is not None else None)
+        loc = graph.loc_of(op_index) if op_index is not None else None
+        return Diagnostic(severity, self.name, message,
+                          op_index=op_index, op_name=op_name,
+                          var_name=var_name, loc=loc)
+
+
+class UseBeforeProducePass(AnalysisPass):
+    """Every operand must be a feed root or the output of an EARLIER op.
+
+    Append-only recording guarantees this by construction; graph
+    transforms (reordering, pruning, node splicing) are exactly where it
+    breaks — and an out-of-order op list makes the Executor's name-keyed
+    env raise a bare KeyError mid-jit."""
+
+    name = "use-before-produce"
+
+    def run(self, graph, fetch_list=None):
+        out: List[Diagnostic] = []
+        prog = graph.program
+        for v, first, dup in graph.duplicate_producers:
+            out.append(self._diag(
+                graph, Diagnostic.ERROR,
+                f"Variable '{v.name}' is produced twice (also by op "
+                f"#{first} '{graph.nodes[first].op_name}'); the later "
+                f"write silently shadows the earlier one",
+                op_index=dup, var_name=v.name))
+        for i in range(len(graph.nodes)):
+            for v, kind in graph.node_inputs(i):
+                if v.program is not prog:
+                    continue  # CrossProgramLeakPass owns this defect
+                if graph.is_feed(v):
+                    continue
+                p = graph.producer_of.get(id(v))
+                if p is None:
+                    out.append(self._diag(
+                        graph, Diagnostic.ERROR,
+                        f"Variable '{v.name}' is consumed but never "
+                        f"produced by any op and is not a feed",
+                        op_index=i, var_name=v.name))
+                elif p >= i:
+                    out.append(self._diag(
+                        graph, Diagnostic.ERROR,
+                        f"Variable '{v.name}' is used before it is "
+                        f"produced (producer is op #{p} "
+                        f"'{graph.nodes[p].op_name}')",
+                        op_index=i, var_name=v.name))
+        return out
+
+
+class CrossProgramLeakPass(AnalysisPass):
+    """No operand (or output) may belong to a different Program.
+
+    The defect arises from building two programs without resetting the
+    guard, or caching layer outputs across ``program_guard`` blocks; at
+    run time the foreign Variable's name is missing from the env and the
+    failure points at the wrong program."""
+
+    name = "cross-program-leak"
+
+    def run(self, graph, fetch_list=None):
+        out: List[Diagnostic] = []
+        prog = graph.program
+        for i, node in enumerate(graph.nodes):
+            for v, kind in graph.node_inputs(i):
+                if v.program is not prog:
+                    how = ("replay closure" if kind == "extra"
+                           else "operand")
+                    out.append(self._diag(
+                        graph, Diagnostic.ERROR,
+                        f"Variable '{v.name}' belongs to a different "
+                        f"Program (leaked across program boundaries as "
+                        f"an op {how})",
+                        op_index=i, var_name=v.name))
+            for v in node.out_vars:
+                if v.program is not prog:
+                    out.append(self._diag(
+                        graph, Diagnostic.ERROR,
+                        f"output Variable '{v.name}' belongs to a "
+                        f"different Program", op_index=i,
+                        var_name=v.name))
+        for name, v in graph.feeds.items():
+            if v.program is not prog:
+                out.append(Diagnostic(
+                    Diagnostic.ERROR, self.name,
+                    f"feed Variable '{name}' belongs to a different "
+                    f"Program", var_name=name))
+        return out
+
+
+class DeadCodePass(AnalysisPass):
+    """Ops unreachable backwards from the fetch targets, and feeds no
+    live op consumes (reference: Program._prune + the executor's
+    'skip_ops' pruning).  Needs fetch targets: without them liveness is
+    undefined, so the pass only checks that explicit fetch entries
+    resolve.  A Program with an attached optimizer treats the loss as an
+    implicit fetch root."""
+
+    name = "dead-code"
+
+    def run(self, graph, fetch_list=None):
+        out: List[Diagnostic] = []
+        roots: List[Variable] = []
+        for f in (fetch_list or []):
+            v = graph.resolve_fetch(f)
+            if v is None:
+                out.append(Diagnostic(
+                    Diagnostic.ERROR, self.name,
+                    f"fetch target {f!r} does not name any Variable in "
+                    f"the program",
+                    var_name=f if isinstance(f, str) else None))
+            elif v.program is not graph.program:
+                out.append(Diagnostic(
+                    Diagnostic.ERROR, self.name,
+                    f"fetch target '{v.name}' belongs to a different "
+                    f"Program", var_name=v.name))
+            else:
+                roots.append(v)
+        opt = graph.program._optimizer
+        if opt is not None and isinstance(opt[1], Variable):
+            roots.append(opt[1])  # the loss drives the update
+        if not roots:
+            return out
+        live = graph.live_nodes(roots)
+        for i in range(len(graph.nodes)):
+            if i not in live:
+                outs = ", ".join(v.name for v in graph.nodes[i].out_vars)
+                out.append(self._diag(
+                    graph, Diagnostic.WARNING,
+                    f"op is dead relative to the fetch targets "
+                    f"(outputs [{outs}] are never fetched nor consumed "
+                    f"by a live op)", op_index=i))
+        for name, v in graph.feeds.items():
+            used = any(i in live for i in graph.consumers_of.get(id(v), ())
+                       ) or any(v is r for r in roots)
+            if not used:
+                out.append(Diagnostic(
+                    Diagnostic.WARNING, self.name,
+                    f"feed '{name}' is never consumed by a live op "
+                    f"(unused relative to the fetch targets)",
+                    var_name=name))
+        return out
+
+
+class ShapeDtypeConsistencyPass(AnalysisPass):
+    """Re-derive every op's output avals with ``jax.eval_shape`` and
+    compare against what recording stored on its out_vars.
+
+    Recording already shape-checked each op once; what this catches is
+    DRIFT after recording — a Parameter re-assigned with a different
+    shape/dtype, a transform that rewired operands, or a mutated
+    ``node.kw`` — before the mismatch detonates inside the whole-program
+    jit with an error pointing at XLA internals."""
+
+    name = "shape-dtype"
+
+    def run(self, graph, fetch_list=None):
+        from ...core.tensor import Parameter
+        from ..program import replay_scope
+        import jax.numpy as jnp
+
+        out: List[Diagnostic] = []
+        prog = graph.program
+        for i, node in enumerate(graph.nodes):
+            args = []
+            for tag, x in node.in_specs:
+                if tag == "v":
+                    args.append(x.data)
+                elif tag == "p":
+                    args.append(jax.ShapeDtypeStruct(
+                        x.data.shape, np.dtype(x.data.dtype)))
+                elif tag == "c":
+                    args.append(jax.ShapeDtypeStruct(
+                        x.shape, np.dtype(x.dtype)))
+                else:
+                    args.append(x)
+
+            def _abstract_lookup(v):
+                if isinstance(v, Parameter):
+                    return v.data
+                return jnp.zeros(v.data.shape, v.data.dtype)
+
+            try:
+                with replay_scope(_abstract_lookup):
+                    avals = jax.eval_shape(
+                        lambda *a, _n=node: _n.fn(*a, **_n.kw), *args)
+            except Exception as e:  # noqa: BLE001 - any trace failure
+                out.append(self._diag(
+                    graph, Diagnostic.ERROR,
+                    f"op no longer traces against its recorded input "
+                    f"specs: {type(e).__name__}: {e}", op_index=i))
+                continue
+            avals = list(avals) if node.multi else [avals]
+            if len(avals) != len(node.out_vars):
+                out.append(self._diag(
+                    graph, Diagnostic.ERROR,
+                    f"op now produces {len(avals)} outputs; "
+                    f"{len(node.out_vars)} were recorded", op_index=i))
+                continue
+            for v, a in zip(node.out_vars, avals):
+                want = (tuple(v.data.shape), np.dtype(v.data.dtype))
+                got = (tuple(a.shape), np.dtype(a.dtype))
+                if want != got:
+                    out.append(self._diag(
+                        graph, Diagnostic.ERROR,
+                        f"Variable '{v.name}' was recorded as "
+                        f"shape={list(want[0])} dtype={want[1]} but now "
+                        f"traces to shape={list(got[0])} dtype={got[1]} "
+                        f"(inputs changed after recording?)",
+                        op_index=i, var_name=v.name))
+        return out
+
+
+class NameCollisionPass(AnalysisPass):
+    """Two distinct Variables sharing one name.
+
+    The Executor env and the feed/fetch protocol are name-keyed, so a
+    collision silently aliases the later write over the earlier one —
+    fetches and downstream ops read the wrong tensor."""
+
+    name = "name-collision"
+
+    def run(self, graph, fetch_list=None):
+        out: List[Diagnostic] = []
+        by_name: dict = {}
+        for v in graph.vars.values():
+            if v.program is graph.program:
+                by_name.setdefault(v.name, []).append(v)
+        for name, vs in sorted(by_name.items()):
+            if len(vs) > 1:
+                where = []
+                for v in vs:
+                    p = graph.producer_of.get(id(v))
+                    if p is not None:
+                        where.append(f"op #{p} {graph.nodes[p].op_name}")
+                    elif graph.is_feed(v):
+                        where.append("feed")
+                    else:
+                        where.append("unproduced")
+                out.append(Diagnostic(
+                    Diagnostic.ERROR, self.name,
+                    f"{len(vs)} distinct Variables share the name "
+                    f"{name!r} ({', '.join(where)}); the name-keyed "
+                    f"executor env would silently alias them",
+                    var_name=name))
+        return out
+
+
+def default_passes() -> List[AnalysisPass]:
+    return [UseBeforeProducePass(), CrossProgramLeakPass(),
+            NameCollisionPass(), ShapeDtypeConsistencyPass(),
+            DeadCodePass()]
+
+
+PASS_REGISTRY = {cls.name: cls for cls in (
+    UseBeforeProducePass, CrossProgramLeakPass, DeadCodePass,
+    ShapeDtypeConsistencyPass, NameCollisionPass)}
+
+
+def check(program: Program, fetch_list: Optional[Sequence] = None,
+          passes: Optional[Sequence[AnalysisPass]] = None
+          ) -> List[Diagnostic]:
+    """Run verifier passes; return ALL diagnostics (errors + warnings)
+    without raising.  ``fetch_list`` entries may be Variables or names;
+    liveness analysis is skipped when no fetch roots are known."""
+    graph = DefUseGraph(program)
+    out: List[Diagnostic] = []
+    for p in (passes if passes is not None else default_passes()):
+        out.extend(p.run(graph, fetch_list))
+    return out
+
+
+def verify(program: Program, fetch_list: Optional[Sequence] = None,
+           passes: Optional[Sequence[AnalysisPass]] = None,
+           raise_on_error: bool = True) -> List[Diagnostic]:
+    """:func:`check`, raising :class:`GraphVerificationError` when any
+    error-severity diagnostic is found.  Returns the diagnostics (the
+    warnings, when it does not raise)."""
+    diags = check(program, fetch_list, passes)
+    errors = [d for d in diags if d.severity == Diagnostic.ERROR]
+    if errors and raise_on_error:
+        serial = getattr(program, "_serial", None)
+        lines = [f"Program verification failed "
+                 f"(program #{serial}, {len(errors)} error(s), "
+                 f"{len(diags) - len(errors)} warning(s)):"]
+        lines += [f"  {d}" for d in diags]
+        raise GraphVerificationError("\n".join(lines), diagnostics=diags)
+    return diags

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.flags import get_flag
 from ..core.tensor import Tensor
 from .program import Program, Variable, default_main_program
 
@@ -82,11 +83,49 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[tuple, object] = {}
+        # keyed by Program._serial (monotonic, never recycled) — id()
+        # keys could be reused after GC, handing a new Program a dead
+        # program's run counter / optimizer slots.  Serials never
+        # repeat, so entries for dead programs must be evicted: stale
+        # VERSIONS are dropped on recompile (below); a per-program
+        # finalizer reaps counters/opt state once the Program is
+        # collectable (note the compiled cache itself pins the Program
+        # through the node closures, so a sweep creating many programs
+        # should call close() between trials).
         self._opt_states: Dict[int, list] = {}
         self._run_counts: Dict[int, int] = {}
+        self._verified: set = set()  # (serial, version) already checked
+        self._tracked: set = set()   # serials with a finalizer attached
+
+    def _track(self, program):
+        serial = program._serial
+        if serial in self._tracked:
+            return
+        self._tracked.add(serial)
+        # the closure references the containers, NOT self: the finalizer
+        # must not keep the Executor alive
+        import weakref
+        opt, runs, ver = (self._opt_states, self._run_counts,
+                          self._verified)
+
+        def _evict():
+            opt.pop(serial, None)
+            runs.pop(serial, None)
+            for k in [k for k in ver if k[0] == serial]:
+                ver.discard(k)
+
+        weakref.finalize(program, _evict)
 
     def close(self):
+        """Drop all compiled programs and per-program state (run
+        counters, optimizer slots).  Long-lived processes that build
+        many throwaway Programs on one Executor should call this
+        between trials — the compiled cache pins each Program's graph
+        until then."""
         self._cache.clear()
+        self._opt_states.clear()
+        self._run_counts.clear()
+        self._verified.clear()
 
     # -- main entry --------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed=None,
@@ -116,19 +155,33 @@ class Executor:
         feed_names = tuple(n for n, _ in feed_items)
         feed_arrays = [jnp.asarray(np.asarray(a)) for _, a in feed_items]
 
-        key = (id(program), program._version, feed_names,
+        self._track(program)
+        key = (program._serial, program._version, feed_names,
                tuple((a.shape, str(a.dtype)) for a in feed_arrays),
                tuple(fetch_names), program._optimizer is not None)
         compiled = self._cache.get(key)
         if compiled is None:
+            # recompile for a NEW version: executables for older
+            # versions of this program can never be requested again
+            # (the version only grows), so drop them — each one pins
+            # the node graph it closed over
+            stale = [k for k in self._cache
+                     if k[0] == program._serial and k[1] != key[1]]
+            for k in stale:
+                del self._cache[k]
+            if get_flag("static_verify"):
+                vkey = (program._serial, program._version)
+                if vkey not in self._verified:
+                    program.verify(fetch_list=fetch_list)
+                    self._verified.add(vkey)
             compiled = self._build(program, params, feed_names, fetch_names)
             self._cache[key] = compiled
 
         # per-run randomness (reference: static dropout reseeds per run):
         # random ops in the program fold this key via seed_scope; an
         # explicit ``seed`` reproduces a run, the default auto-increments
-        run_i = self._run_counts.get(id(program), 0) + 1
-        self._run_counts[id(program)] = run_i
+        run_i = self._run_counts.get(program._serial, 0) + 1
+        self._run_counts[program._serial] = run_i
         rng_key = jax.random.fold_in(
             jax.random.PRNGKey(program.random_seed),
             run_i if seed is None else int(seed))
@@ -136,7 +189,7 @@ class Executor:
         p_arrays = [p.data for p in params]
         if program._optimizer is not None:
             opt = program._optimizer[0]
-            state = self._opt_states.get(id(program))
+            state = self._opt_states.get(program._serial)
             if state is None:
                 state = opt.functional_init(
                     [p_arrays[i] for i in compiled._t_idx])
@@ -145,7 +198,7 @@ class Executor:
             step_i = jnp.asarray(opt._step_count, jnp.float32)
             fetches, new_p, new_state = compiled(
                 p_arrays, state, lr, step_i, rng_key, *feed_arrays)
-            self._opt_states[id(program)] = new_state
+            self._opt_states[program._serial] = new_state
             for p, arr in zip(params, new_p):
                 p.data = arr
         else:

@@ -21,6 +21,8 @@ shapes at run time, so only cosmetic metadata depends on it).
 from __future__ import annotations
 
 import itertools
+import os
+import sys
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -28,9 +30,31 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.dtype import convert_dtype
+from ..core.flags import get_flag
 from ..core.tensor import Parameter, Tensor
 
 _var_counter = itertools.count(0)
+# monotonic program identity: id(program) can be recycled by the
+# allocator after GC, silently handing a new Program an old program's
+# executor-side state (run counters, optimizer slots) — the serial never
+# repeats within a process and doubles as the verifier's program id
+_program_serial = itertools.count(0)
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) \
+    + os.sep  # trailing sep: .../paddle_tpu_ext must not match
+
+
+def _caller_loc():
+    """file:line of the first frame outside paddle_tpu — the user
+    statement that recorded the op (captured only under
+    FLAGS_static_verify; the verifier's source anchor)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_DIR):
+            return (fn, f.f_lineno)
+        f = f.f_back
+    return None
 
 # -- replay scope -----------------------------------------------------------
 # Composite control-flow ops (ops/control_flow.py) record ONE node whose fn
@@ -102,10 +126,10 @@ class _OpNode:
     """One recorded op (reference: framework.py Operator / OpDesc)."""
 
     __slots__ = ("fn", "kw", "op_name", "in_specs", "out_vars",
-                 "multi", "extra_params", "extra_vars")
+                 "multi", "extra_params", "extra_vars", "loc")
 
     def __init__(self, fn, kw, op_name, in_specs, out_vars, multi,
-                 extra_params=(), extra_vars=()):
+                 extra_params=(), extra_vars=(), loc=None):
         self.fn = fn
         self.kw = kw
         self.op_name = op_name
@@ -119,6 +143,7 @@ class _OpNode:
         # Program.parameters) see them
         self.extra_params = list(extra_params)
         self.extra_vars = list(extra_vars)
+        self.loc = loc  # (file, line) source anchor or None
 
 
 class Program:
@@ -133,6 +158,7 @@ class Program:
         self._optimizer = None       # (optimizer, loss Variable)
         self.random_seed = 0
         self._version = 0
+        self._serial = next(_program_serial)
 
     # -- recording (called from core.dispatch.apply) ----------------------
     def _aval_of(self, x):
@@ -173,13 +199,26 @@ class Program:
         multi = isinstance(out_avals, (tuple, list))
         avals = list(out_avals) if multi else [out_avals]
         out_vars = [Variable(a, self) for a in avals]
+        loc = _caller_loc() if get_flag("static_verify") else None
         self.nodes.append(_OpNode(fn, kw, op_name, in_specs, out_vars,
                                   multi, extra_params=seen_params,
-                                  extra_vars=seen_vars))
+                                  extra_vars=seen_vars, loc=loc))
         self._version += 1
         if multi:
             return tuple(out_vars)
         return out_vars[0]
+
+    # -- verification (static/analysis) ------------------------------------
+    def verify(self, fetch_list=None, raise_on_error=True):
+        """Run the compile-time verifier passes over this program
+        (static/analysis: def-use ordering, cross-program leaks, name
+        collisions, shape/dtype drift, and — when ``fetch_list`` roots
+        are given — dead-op/unused-feed liveness).  Raises
+        ``core.enforce.GraphVerificationError`` on errors unless
+        ``raise_on_error=False``; returns the Diagnostic list."""
+        from .analysis import verify as _verify
+        return _verify(self, fetch_list=fetch_list,
+                       raise_on_error=raise_on_error)
 
     # -- introspection -----------------------------------------------------
     def parameters(self) -> List[Parameter]:

@@ -109,7 +109,7 @@ class Imikolov(Dataset):
         self.data_file = _need_file(
             data_file, "Imikolov",
             "simple-examples tar with ./simple-examples/data/"
-            "ptb.{train,valid}.txt")
+            "ptb.{train,valid,test}.txt")
         self.word_idx = self._build_word_dict(min_word_freq)
         self._load_anno()
 
@@ -146,8 +146,9 @@ class Imikolov(Dataset):
     def _load_anno(self):
         self.data = []
         unk = self.word_idx[b"<unk>"]
-        fname = ("data/ptb.train.txt" if self.mode == "train"
-                 else "data/ptb.valid.txt")
+        # reference: imikolov.py maps mode directly onto the split file —
+        # test mode reads ptb.test.txt (valid is only for vocab building)
+        fname = f"data/ptb.{self.mode}.txt"
         with tarfile.open(self.data_file) as tf:
             for line in self._member(tf, fname):
                 if self.data_type == "NGRAM":

@@ -29,6 +29,8 @@ from types import SimpleNamespace
 from typing import Callable, Dict, Optional, Sequence, Union
 
 import jax
+
+from ..core.jax_compat import ffi as _ffi
 import numpy as np
 
 __all__ = ["load", "get_build_directory", "CppExtension"]
@@ -67,7 +69,7 @@ def _make_op(target: str, out: _OutSpec, vmap_method: Optional[str]):
         avals = [jax.ShapeDtypeStruct(np.shape(a), a.dtype)
                  for a in arrays]
         out_aval = _resolve_out(out, avals)
-        call = jax.ffi.ffi_call(target, out_aval, vmap_method=vmap_method)
+        call = _ffi.ffi_call(target, out_aval, vmap_method=vmap_method)
         return call(*arrays, **attrs)
 
     op.__name__ = target.rsplit(".", 1)[-1]
@@ -112,7 +114,7 @@ def load(name: str, sources: Sequence[str],
     import jaxlib
     h = hashlib.sha1()
     h.update(getattr(jaxlib, "__version__", "?").encode())
-    h.update(jax.ffi.include_dir().encode())
+    h.update(_ffi.include_dir().encode())
     for flag in (extra_cxx_cflags or []):
         h.update(flag.encode())
     for s in srcs:
@@ -126,7 +128,7 @@ def load(name: str, sources: Sequence[str],
         from .native_build import build_shared_lib
         build_shared_lib(
             ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
-             f"-I{jax.ffi.include_dir()}"] + list(extra_cxx_cflags or []),
+             f"-I{_ffi.include_dir()}"] + list(extra_cxx_cflags or []),
             srcs, so_path, verbose=verbose, what="cpp_extension.load")
 
     lib = ctypes.CDLL(so_path)
@@ -135,8 +137,8 @@ def load(name: str, sources: Sequence[str],
         symbol = spec.get("symbol", op_name)
         target = f"{name}.{op_name}"
         handler = getattr(lib, symbol)
-        jax.ffi.register_ffi_target(
-            target, jax.ffi.pycapsule(handler), platform="cpu")
+        _ffi.register_ffi_target(
+            target, _ffi.pycapsule(handler), platform="cpu")
         ns[op_name] = _make_op(target, spec["out"],
                                spec.get("vmap_method", "sequential"))
     module = SimpleNamespace(**ns)

@@ -151,8 +151,42 @@ def test_repeated_shuffle_same_spool_same_seed(tmp_path):
         rounds.append(results)
     assert rounds[0] == rounds[1] == rounds[2]  # same seed, same result
     # generations 0 and 1 were reaped after later rounds completed
+    # (roots are namespaced per dataset: <ns>_gs_<gen>_<seed>)
     left = sorted(os.listdir(spool))
-    assert left == ["gs_2_42"], left
+    assert len(left) == 1 and left[0].endswith("_gs_2_42"), left
+
+
+def test_reap_follows_namespace_across_filelist_change(tmp_path):
+    """set_filelist between shuffles changes the spool fingerprint; the
+    reaper must delete the previous generation under the namespace it
+    was WRITTEN with, not the current one."""
+    files_a, _ = _write_files(tmp_path, n_files=2, per_file=3)
+    d2 = tmp_path / "second"
+    d2.mkdir()
+    files_b, _ = _write_files(d2, n_files=2, per_file=3)
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    world = 2
+    dss = [InMemoryDataset(rank=r, world_size=world) for r in range(world)]
+
+    def shuffle_round(files):
+        def work(rank):
+            dss[rank].set_filelist(files)
+            dss[rank].load_into_memory()
+            dss[rank].global_shuffle(seed=9, spool_dir=str(spool))
+        ts = [threading.Thread(target=work, args=(r,))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+
+    shuffle_round(files_a)   # gen 0 under ns(files_a)
+    ns_a = dss[0]._spool_namespace()
+    shuffle_round(files_b)   # gen 1 under ns(files_b) reaps gen 0
+    left = sorted(os.listdir(spool))
+    assert not any(d.startswith(f"{ns_a}_gs_0_") for d in left), left
+    assert len(left) == 1 and left[0].endswith("_gs_1_9"), left
 
 
 def test_epoch_folded_seed(tmp_path):

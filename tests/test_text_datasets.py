@@ -68,23 +68,27 @@ def test_imdb_requires_local_file():
 
 
 # ------------------------------------------------------------ imikolov --
-def _make_imikolov(path, train_lines, valid_lines):
+def _make_imikolov(path, train_lines, valid_lines, test_lines=()):
     with tarfile.open(path, "w") as tf:
         _add_bytes(tf, "./simple-examples/data/ptb.train.txt",
                    "\n".join(train_lines).encode() + b"\n")
         _add_bytes(tf, "./simple-examples/data/ptb.valid.txt",
                    "\n".join(valid_lines).encode() + b"\n")
+        if test_lines:
+            _add_bytes(tf, "./simple-examples/data/ptb.test.txt",
+                       "\n".join(test_lines).encode() + b"\n")
 
 
 def test_imikolov_ngram_and_seq(tmp_path):
     p = str(tmp_path / "simple-examples.tar")
     # 'a' freq 4 (+valid 2 = 6), 'b' 3, <s>/<e> counted per line
-    _make_imikolov(p, ["a b a", "a b", "b"], ["a a"])
+    _make_imikolov(p, ["a b a", "a b", "b"], ["a a"], ["a a"])
 
     ds = Imikolov(data_file=p, data_type="NGRAM", window_size=2,
                   mode="train", min_word_freq=2)
-    # freqs: a=5, <s>=4, <e>=4, b=3 (train+valid, <s>/<e> once per
-    # line); freq>2 keeps all four, sorted by (-freq, word)
+    # freqs: a=5, <s>=4, <e>=4, b=3 (train+valid only — the vocab never
+    # sees test; <s>/<e> once per line); freq>2 keeps all four, sorted
+    # by (-freq, word)
     wi = ds.word_idx
     assert wi[b"a"] == 0 and wi[b"<e>"] == 1 and wi[b"<s>"] == 2
     assert wi[b"b"] == 3 and wi[b"<unk>"] == 4
@@ -94,7 +98,8 @@ def test_imikolov_ngram_and_seq(tmp_path):
 
     seq = Imikolov(data_file=p, data_type="SEQ", window_size=-1,
                    mode="test", min_word_freq=2)
-    src, trg = seq[0]   # valid line "a a"
+    src, trg = seq[0]   # ptb.test.txt line "a a" (reference: test mode
+    #                     reads the TEST split, not valid)
     assert src.tolist() == [wi[b"<s>"], wi[b"a"], wi[b"a"]]
     assert trg.tolist() == [wi[b"a"], wi[b"a"], wi[b"<e>"]]
 

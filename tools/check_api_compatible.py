@@ -13,6 +13,8 @@ Compatibility rules (reference semantics):
 - removing a public name is a BREAK;
 - removing a parameter, renaming one, or reordering existing
   positionals is a BREAK;
+- removing ``*args``/``**kwargs`` (VAR_POSITIONAL/VAR_KEYWORD) is a
+  BREAK — callers passing extra positionals/keywords stop working;
 - ADDING a trailing parameter with a default, or adding new public
   names, is allowed.
 """
@@ -74,6 +76,11 @@ def collect():
             obj = getattr(mod, name)
             if inspect.ismodule(obj):
                 continue
+            if getattr(obj, "__module__", "") == "typing":
+                # typing re-exports (Any, Optional, ...) leaked into a
+                # namespace: their introspection shape varies by Python
+                # version, producing spurious class<->function "breaks"
+                continue
             if inspect.isclass(obj):
                 entry[name] = {"type": "class",
                                "init": _sig_of(obj.__init__)}
@@ -100,6 +107,16 @@ def _params_compatible(old, new, where, problems):
     old_named = [p for p in old if p["kind"] in
                  ("POSITIONAL_ONLY", "POSITIONAL_OR_KEYWORD",
                   "KEYWORD_ONLY")]
+    # removing *args / **kwargs breaks every caller that passed extra
+    # positionals/keywords, even though no NAMED parameter disappeared
+    for var_kind, spelled in (("VAR_POSITIONAL", "*args"),
+                              ("VAR_KEYWORD", "**kwargs")):
+        if any(p["kind"] == var_kind for p in old) and not any(
+                p["kind"] == var_kind for p in new):
+            name = next(p["name"] for p in old if p["kind"] == var_kind)
+            problems.append(
+                f"{where}: variadic parameter {spelled} "
+                f"({name!r}) removed")
     new_by_name = {p["name"]: p for p in new}
     new_order = [p["name"] for p in new]
     for i, p in enumerate(old_named):

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Build-and-verify CLI: import a module, analyse its static Programs,
+lint its to_static functions.
+
+Reference: the spirit of tools/check_file_diff_approvals.sh +
+dygraph_to_static's error tier, as a standalone pre-flight: run this
+over a training script BEFORE burning a TPU slice on a compile that was
+always going to fail.
+
+Usage:
+  python tools/lint_program.py my_train_script.py
+  python tools/lint_program.py mypkg.model --fetch loss
+  python tools/lint_program.py script.py --lint-all --strict
+
+The module is imported under ``paddle.enable_static()`` with
+``FLAGS_static_verify`` on (so recorded ops carry file:line anchors); a
+reference-style script therefore builds its Programs at import time.
+Every ``static.Program`` found in the module namespace is run through
+``static.analysis.check``; every ``jit.to_static`` function (and, with
+``--lint-all``, every plain module-level function) is run through the
+dy2static lint.  Exit status: 1 when any error-severity finding exists
+(warnings too with ``--strict``), else 0.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _import_target(target: str) -> types.ModuleType:
+    if target.endswith(".py") or os.sep in target:
+        path = os.path.abspath(target)
+        name = os.path.splitext(os.path.basename(path))[0]
+        spec = importlib.util.spec_from_file_location(name, path)
+        if spec is None or spec.loader is None:
+            raise ImportError(f"cannot load {target!r}")
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        return mod
+    return importlib.import_module(target)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="verify static Programs + lint dy2static hazards")
+    ap.add_argument("module",
+                    help="dotted module name or path to a .py file")
+    ap.add_argument("--fetch", default="",
+                    help="comma-separated Variable names used as fetch "
+                         "roots for dead-code analysis on each Program")
+    ap.add_argument("--lint-all", action="store_true",
+                    help="lint every module-level function, not only "
+                         "to_static-wrapped ones")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too")
+    ap.add_argument("--no-verify-flag", action="store_true",
+                    help="do not force FLAGS_static_verify during "
+                         "import (ops then record no source anchors)")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.jit.lint import lint
+    from paddle_tpu.jit.static_function import StaticFunction
+    from paddle_tpu.static import Program, analysis
+    from paddle_tpu.static.analysis import Diagnostic
+
+    if not args.no_verify_flag:
+        set_flags({"FLAGS_static_verify": True})
+    paddle.enable_static()
+    try:
+        mod = _import_target(args.module)
+    except Exception as e:  # noqa: BLE001 - report, don't traceback
+        print(f"error: importing {args.module!r} failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    fetch = [n for n in args.fetch.split(",") if n]
+    resolved_somewhere = set()
+    n_err = n_warn = 0
+
+    # -- Programs ---------------------------------------------------------
+    programs = [(nm, v) for nm, v in sorted(vars(mod).items())
+                if isinstance(v, Program)]
+    default_main = paddle.static.default_main_program()
+    if default_main.nodes and not any(p is default_main
+                                      for _, p in programs):
+        programs.append(("<default_main_program>", default_main))
+    for nm, prog in programs:
+        # each program only sees the fetch names IT defines (one --fetch
+        # list serves all programs); names resolving in NO program are
+        # reported as errors after the loop
+        graph = analysis.DefUseGraph(prog)
+        roots = [f for f in fetch
+                 if graph.resolve_fetch(f) is not None]
+        resolved_somewhere.update(roots)
+        diags = analysis.check(prog, fetch_list=roots or None)
+        print(f"Program {nm!r} (#{prog._serial}, {len(prog.nodes)} ops):"
+              f" {len(diags)} finding(s)")
+        for d in diags:
+            print(f"  {d}")
+            if d.severity == Diagnostic.ERROR:
+                n_err += 1
+            else:
+                n_warn += 1
+
+    # -- functions --------------------------------------------------------
+    fns = []
+    for nm, v in sorted(vars(mod).items()):
+        if isinstance(v, StaticFunction):
+            fns.append((nm, v))
+        elif args.lint_all and isinstance(v, types.FunctionType) \
+                and v.__module__ == mod.__name__:
+            fns.append((nm, v))
+    for nm, fn in fns:
+        diags = lint(fn)
+        print(f"function {nm!r}: {len(diags)} finding(s)")
+        for d in diags:
+            print(f"  {d}")
+            if d.severity == "error":
+                n_err += 1
+            else:
+                n_warn += 1
+
+    for f in fetch:
+        if f not in resolved_somewhere:
+            print(f"error: --fetch {f!r} does not name a Variable in "
+                  f"any analysed Program (typo?); dead-code analysis "
+                  f"ran without it")
+            n_err += 1
+
+    if not programs and not fns:
+        print("nothing to analyse: module defines no static.Program and "
+              "no to_static function (try --lint-all)")
+
+    print(f"lint_program: {n_err} error(s), {n_warn} warning(s)")
+    return 1 if (n_err or (args.strict and n_warn)) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
