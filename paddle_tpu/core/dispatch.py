@@ -44,8 +44,29 @@ def _check_nan_inf(op_name, arrays):
                     f"NaN or Inf found in output of operator < {op_name} >")
 
 
-_VJP_CACHE: dict = {}
+from collections import OrderedDict
+
+_VJP_CACHE: "OrderedDict" = OrderedDict()
 _VJP_CACHE_CAP = 4096
+
+
+def _cache_lookup(key):
+    """LRU read: a hit moves the entry to the young end."""
+    entry = _VJP_CACHE.get(key)
+    if entry is not None:
+        _VJP_CACHE.move_to_end(key)
+    return entry
+
+
+def _cache_store(key, entry):
+    """Insert with oldest-half LRU eviction at the cap.  A full clear()
+    here would force every live op to retrace on its next call — at
+    steady state near the cap that is total retrace thrash (~3 ms/op);
+    evicting the least-recently-used half keeps the hot set compiled."""
+    if len(_VJP_CACHE) >= _VJP_CACHE_CAP:
+        for k in list(_VJP_CACHE)[:_VJP_CACHE_CAP // 2]:
+            del _VJP_CACHE[k]
+    _VJP_CACHE[key] = entry
 
 
 def _cached_fwd(fn, kw):
@@ -57,12 +78,10 @@ def _cached_fwd(fn, kw):
         hash(key)
     except TypeError:
         return None
-    jfn = _VJP_CACHE.get(key)
+    jfn = _cache_lookup(key)
     if jfn is None:
-        if len(_VJP_CACHE) >= _VJP_CACHE_CAP:
-            _VJP_CACHE.clear()
         jfn = jax.jit(lambda *a: fn(*a, **kw))
-        _VJP_CACHE[key] = jfn
+        _cache_store(key, jfn)
     return jfn
 
 
@@ -84,11 +103,8 @@ def _cached_rules(fn, kw, diff_idx, arrays):
         hash(key)
     except TypeError:
         return None
-    entry = _VJP_CACHE.get(key)
+    entry = _cache_lookup(key)
     if entry is None:
-        if len(_VJP_CACHE) >= _VJP_CACHE_CAP:
-            _VJP_CACHE.clear()  # simple flush; steady-state never hits this
-
         fwd = jax.jit(lambda *a: fn(*a, **kw))
 
         def bwd_impl(all_args, cts):
@@ -101,7 +117,7 @@ def _cached_rules(fn, kw, diff_idx, arrays):
             return pull(cts)
 
         entry = (fwd, jax.jit(bwd_impl))
-        _VJP_CACHE[key] = entry
+        _cache_store(key, entry)
     return entry
 
 
@@ -186,6 +202,16 @@ def apply(fn: Callable, *inputs, op_name: str | None = None,
         raise with_op_hint(e, name)
 
     if prof is not None:
+        # default: times the async host dispatch only (device work is
+        # still in flight).  sync mode (Profiler(sync_ops=True) /
+        # FLAGS_profiler_sync_ops) blocks on this op's outputs first, so
+        # the recorded span covers the device work — at the price of
+        # serializing the pipeline per op.
+        if getattr(prof, "_sync_ops", False):
+            for o in (outs if isinstance(outs, (tuple, list)) else (outs,)):
+                if isinstance(o, jax.Array) and not isinstance(
+                        o, jax.core.Tracer):
+                    o.block_until_ready()
         prof._record(name, time.perf_counter() - t_prof)
 
     multi = isinstance(outs, (tuple, list))

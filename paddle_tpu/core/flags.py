@@ -99,6 +99,19 @@ define_flag("static_verify", False,
             "each Program before its first compile, and record file:line "
             "anchors for every op at build time.  Off by default: "
             "verification adds one eval_shape re-trace per op.")
+define_flag("static_donate", True,
+            "Donate parameter/optimizer buffers of the static Executor's "
+            "compiled train step (jax.jit donate_argnums), updating "
+            "weights in place run-to-run.  Aliasing-safe: any array a "
+            "user obtains through Parameter.data is copied out of the "
+            "donated set before the next run.  Turn off to keep every "
+            "step's input buffers alive (debugging / buffer archaeology).")
+define_flag("profiler_sync_ops", False,
+            "Profiler op timing blocks on device completion per op "
+            "(block_until_ready) instead of timing only the async host "
+            "dispatch.  Accurate per-op device cost attribution at the "
+            "price of serializing the pipeline; default off.  Also "
+            "settable per-Profiler via Profiler(sync_ops=True).")
 define_flag("pallas_attention_dropout_min_seqlen", 512,
             "Flash threshold when attention dropout is active: the XLA "
             "path must materialize [B,H,L,L] dropout masks in HBM, so "

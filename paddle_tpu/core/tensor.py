@@ -237,16 +237,25 @@ class Tensor:
         return self
 
 
+# raw storage descriptor of Tensor.data — Parameter overrides ``data``
+# with a property that resolves through a static Executor's
+# device-resident state, but the bytes still live in this slot
+_TENSOR_DATA_SLOT = Tensor.data
+
+
 class Parameter(Tensor):
     """Trainable tensor (reference: python/paddle/fluid/framework.py Parameter)."""
     # _param_owner_step: weakref to a compiled step that holds the
     # authoritative value (ZeRO-3 padded shards / LocalSGD replicas);
     # Layer.state_dict syncs through it before reading p.data
     __slots__ = ("regularizer", "need_clip", "optimize_attr",
-                 "is_distributed", "_param_owner_step")
+                 "is_distributed", "_param_owner_step", "_exec_src")
 
     def __init__(self, data, name=None, trainable=True, regularizer=None,
                  need_clip=True):
+        # must precede super().__init__: the ``data`` property setter
+        # below reads it while Tensor.__init__ assigns self.data
+        self._exec_src = None
         super().__init__(data, stop_gradient=not trainable, name=name,
                          persistable=True)
         self.trainable = trainable
@@ -254,6 +263,52 @@ class Parameter(Tensor):
         self.need_clip = need_clip
         self.optimize_attr = {"learning_rate": 1.0}
         self.is_distributed = False
+
+    # -- executor-resident storage (static hot path) -----------------------
+    # While a static Executor trains this Parameter's Program, the
+    # authoritative value lives in the Executor's device-resident state
+    # (static/executor.py _ExecState) and is threaded run-to-run through
+    # one donated XLA program; ``_exec_src`` is (state, index) while
+    # bound.  Reads resolve through the live state — and mark the array
+    # as escaped, so the next donated run copies that slot instead of
+    # invalidating the user-held reference.  Direct writes unbind this
+    # Parameter and tell the state to reload from the slot on its next
+    # run.  Unbound Parameters (eager mode) pay one extra None-check.
+    @property
+    def data(self):
+        src = getattr(self, "_exec_src", None)
+        if src is not None:
+            return src[0].fetch_param(src[1])
+        return _TENSOR_DATA_SLOT.__get__(self)
+
+    @data.setter
+    def data(self, value):
+        src = getattr(self, "_exec_src", None)
+        if src is not None:
+            self._exec_src = None
+            src[0].param_written(src[1])
+        _TENSOR_DATA_SLOT.__set__(self, value)
+
+    def __getstate__(self):
+        # pickle/deepcopy: materialise the executor-resident value; the
+        # state binding is process-local and never serialised or copied
+        d = {}
+        for cls in type(self).__mro__:
+            for s in getattr(cls, "__slots__", ()):
+                if s in ("__weakref__", "_exec_src", "data"):
+                    continue
+                try:
+                    d[s] = getattr(self, s)
+                except AttributeError:
+                    pass
+        d["data"] = self.data
+        return (None, d)
+
+    def __setstate__(self, state):
+        d = state[1] if isinstance(state, tuple) else state
+        self._exec_src = None
+        for k, v in d.items():
+            setattr(self, k, v)
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()

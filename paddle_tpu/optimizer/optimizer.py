@@ -241,6 +241,19 @@ class Optimizer:
                 if s:
                     out["slots"][str(i)] = {k: np.asarray(v)
                                             for k, v in s.items()}
+        # static path: slots live in the Executor's device-resident
+        # state, not in self._slots — read them through the provider the
+        # Executor registered (keys are positions in
+        # program.parameters(); set_state_dict routes them back via
+        # _static_pending_slots).  Only when no eager slots exist: the
+        # two index spaces (parameter_list vs program.parameters())
+        # differ, and a mixed eager+static optimizer checkpoint would
+        # silently cross-wire moments — eager slots win, as before.
+        prov = getattr(self, "_static_state_provider", None)
+        if prov is not None and not out["slots"]:
+            st = prov()
+            if st is not None:
+                out["slots"].update(st.export_slots())
         if self._lr_scheduler is not None:
             out["lr_scheduler"] = self._lr_scheduler.state_dict()
         return out
@@ -261,6 +274,16 @@ class Optimizer:
                 if str(i) in slots:
                     self._slots[id(p)] = {
                         k: jnp.asarray(v) for k, v in slots[str(i)].items()}
+        elif slots:
+            # static path (no parameter list): slot keys are positions in
+            # program.parameters().  Stash them for the Executor to load
+            # into its device-resident state, and drop any live state's
+            # slots so the next run reinitialises from the checkpoint
+            self._static_pending_slots = dict(slots)
+            prov = getattr(self, "_static_state_provider", None)
+            st = prov() if prov is not None else None
+            if st is not None:
+                st.opt_state = None
         if self._lr_scheduler is not None and "lr_scheduler" in state:
             self._lr_scheduler.set_state_dict(state["lr_scheduler"])
 

@@ -87,11 +87,27 @@ class Profiler:
     iteration boundaries (a RecordEvent span per step).  When
     ``trace_dir`` is set (or ``on_trace_ready=export_chrome_tracing(d)``)
     a jax profiler trace is captured for the session — the device-side
-    timeline.  ``summary()`` prints host-side op/span tables."""
+    timeline.  ``summary()`` prints host-side op/span tables.
+
+    Timing semantics: jax dispatch is asynchronous, so by DEFAULT each
+    recorded op time covers only the host-side dispatch (Python + trace
+    + enqueue) — the device work is still in flight when the timer
+    stops.  That is the right view for finding host-bound eager loops,
+    but it under-reports device-heavy ops.  Pass ``sync_ops=True`` (or
+    set ``FLAGS_profiler_sync_ops``) to block on each op's outputs
+    before recording, making the span cover the device work too; this
+    serializes the host/device pipeline, so the *sum* becomes accurate
+    per-op attribution while the *total* no longer reflects pipelined
+    wall-clock.  For true device timelines use ``trace_dir`` (XLA's own
+    profiler owns device-side timing)."""
 
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
-                 timer_only: bool = False, trace_dir: Optional[str] = None):
+                 timer_only: bool = False, trace_dir: Optional[str] = None,
+                 sync_ops: Optional[bool] = None):
+        from ..core.flags import get_flag
         self.targets = targets
+        self._sync_ops = (get_flag("profiler_sync_ops") if sync_ops is None
+                          else bool(sync_ops))
         self._on_trace_ready = on_trace_ready
         self._trace_dir = trace_dir or getattr(on_trace_ready, "_dir", None)
         self._timer_only = timer_only

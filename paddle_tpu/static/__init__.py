@@ -8,8 +8,27 @@ style static training script — data → layers → loss → minimize →
 ``exe.run(feed, fetch_list)`` — compiles to a single donated XLA
 computation per feed signature.
 
-Random ops (dropout) reseed per ``exe.run`` — the Executor threads a
-per-run key through ``rng.seed_scope`` (reference static dropout
+Hot-path semantics (see executor.py for the full design):
+
+- **Device-resident state**: after first compile, parameters and
+  optimizer slots live inside the Executor as jax buffers threaded
+  run-to-run with ``donate_argnums`` (``FLAGS_static_donate``, on by
+  default) — weights update in place on device and no Python loop
+  touches parameters per step.  ``Parameter.data`` reads resolve
+  through the live state (and are aliasing-safe under donation); state
+  flushes back on ``exe.close()`` or when the Program is edited.
+- **Async dispatch**: ``run(..., return_numpy=False)`` returns device
+  Tensors without blocking — use it in train loops and sync once when
+  a value is actually needed; ``return_numpy=True`` (the default)
+  syncs per call.  Feeds that are already jax arrays / Tensors pass
+  through with no NumPy round-trip (a previous run's un-synced fetch
+  feeds straight back in).
+- **In-graph scalars**: lr / step / RNG counters ride in a donated aux
+  carry — zero per-step host→device uploads (lr re-uploads only when
+  a scheduler moves it).
+
+Random ops (dropout) reseed per ``exe.run`` — the per-run key is folded
+in-graph from the donated run counter (reference static dropout
 semantics); pass ``exe.run(seed=...)`` to reproduce a specific run.
 
 Known deviations (documented, by design):

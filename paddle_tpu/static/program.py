@@ -159,6 +159,7 @@ class Program:
         self.random_seed = 0
         self._version = 0
         self._serial = next(_program_serial)
+        self._params_cache = None    # (version, [Parameter]) — see parameters()
 
     # -- recording (called from core.dispatch.apply) ----------------------
     def _aval_of(self, x):
@@ -223,7 +224,12 @@ class Program:
     # -- introspection -----------------------------------------------------
     def parameters(self) -> List[Parameter]:
         """Parameters referenced by the program (including ones used only
-        inside control-flow branch closures), in first-use order."""
+        inside control-flow branch closures), in first-use order.  Cached
+        per version: the Executor calls this every run, and walking the
+        node list would put an O(ops) Python loop on the hot path."""
+        cached = self._params_cache
+        if cached is not None and cached[0] == self._version:
+            return cached[1]
         seen, out = set(), []
 
         def add(p):
@@ -237,6 +243,7 @@ class Program:
                     add(v)
             for p in node.extra_params:
                 add(p)
+        self._params_cache = (self._version, out)
         return out
 
     def global_block(self):

@@ -114,3 +114,26 @@ def test_trainstep_capture_produces_xla_trace_dir(tmp_path):
     assert any(n.endswith((".xplane.pb", ".trace.json.gz", ".json.gz",
                            ".pb")) for n in files), files
     assert sum(os.path.getsize(f) for f in files) > 0
+
+
+def test_profiler_sync_ops_mode():
+    """Opt-in sync mode: per-op spans block on device completion before
+    recording (accurate per-op attribution); default stays async."""
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = optimizer.SGD(learning_rate=0.01, parameters=model.parameters())
+
+    p_async = profiler.Profiler(timer_only=True)
+    assert p_async._sync_ops is False  # FLAGS_profiler_sync_ops default
+
+    with profiler.Profiler(timer_only=True, sync_ops=True) as p:
+        _steps(model, opt)
+    ops = dict((n, c) for n, c, _ in p.key_averages())
+    assert ops.get("linear", 0) >= 6  # stats still collected, no crash
+
+    # flag seeds the default
+    paddle.set_flags({"FLAGS_profiler_sync_ops": True})
+    try:
+        assert profiler.Profiler(timer_only=True)._sync_ops is True
+    finally:
+        paddle.set_flags({"FLAGS_profiler_sync_ops": False})

@@ -1,0 +1,380 @@
+"""Donated, device-resident, async-dispatch static Executor hot path
+(ISSUE 2): compile-count invariants, donation aliasing safety, async ==
+sync fetches, interleaved-program state, lazy Parameter.data resolution,
+the legacy-path oracle, and the riding satellites (VJP-cache LRU,
+profiler sync mode, bench smoke guard)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, optimizer
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+    paddle.static.reset_default_programs()
+
+
+def _mlp_program(seed=0, in_dim=8, hidden=16, lr=0.05, opt_cls=None):
+    paddle.seed(seed)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, in_dim], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        h = paddle.static.nn.fc(x, hidden, activation="relu")
+        pred = paddle.static.nn.fc(h, 1)
+        loss = F.mse_loss(pred, y)
+        (opt_cls or optimizer.SGD)(learning_rate=lr).minimize(loss)
+    return main, loss
+
+
+def _batch(seed=0, n=16, in_dim=8):
+    rng = np.random.RandomState(seed)
+    xs = rng.standard_normal((n, in_dim)).astype(np.float32)
+    ys = (xs @ rng.standard_normal((in_dim, 1))).astype(np.float32)
+    return xs, ys
+
+
+# -- (a) compile-count invariants -------------------------------------------
+
+def test_one_compile_across_n_steps_per_feed_signature():
+    main, loss = _mlp_program()
+    exe = paddle.static.Executor()
+    xs, ys = _batch()
+    feed = {"x": jnp.asarray(xs), "y": jnp.asarray(ys)}
+    for _ in range(12):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    assert exe.compile_count == 1
+
+
+def test_new_feed_signature_compiles_once_more():
+    main, loss = _mlp_program()
+    exe = paddle.static.Executor()
+    for bs in (16, 16, 4, 4, 16):
+        xs, ys = _batch(n=bs)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    assert exe.compile_count == 2  # one per batch-size signature
+
+
+def test_zero_host_feed_converts_on_device_feeds():
+    main, loss = _mlp_program()
+    exe = paddle.static.Executor()
+    xs, ys = _batch()
+    jf = {"x": jnp.asarray(xs), "y": jnp.asarray(ys)}
+    for _ in range(5):
+        exe.run(main, feed=jf, fetch_list=[loss], return_numpy=False)
+    assert exe.host_feed_converts == 0
+    exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    assert exe.host_feed_converts == 2  # numpy feeds are counted
+
+
+# -- (b) donation aliasing safety -------------------------------------------
+
+def test_donation_does_not_corrupt_user_held_references():
+    main, loss = _mlp_program()
+    w = main.parameters()[0]
+    exe = paddle.static.Executor()
+    xs, ys = _batch()
+    feed = {"x": jnp.asarray(xs), "y": jnp.asarray(ys)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+
+    held = w.data                      # escapes the donated set
+    snapshot = np.asarray(held).copy()
+    fetched = exe.run(main, feed=feed, fetch_list=[loss],
+                      return_numpy=False)[0]
+    fetched_np = np.asarray(fetched.data).copy()
+    for _ in range(5):                 # donated runs after the escape
+        exe.run(main, feed=feed, fetch_list=[loss])
+
+    np.testing.assert_array_equal(np.asarray(held), snapshot)
+    np.testing.assert_array_equal(np.asarray(fetched.data), fetched_np)
+    # and training really progressed under donation
+    assert not np.array_equal(np.asarray(w.data), snapshot)
+
+
+def test_feeding_a_previous_unsynced_fetch():
+    """A return_numpy=False fetch feeds straight back in (the jax-array
+    passthrough fix: no np.asarray bounce, no deleted-buffer use)."""
+    paddle.seed(3)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 4], "float32")
+        out = F.relu(x) * 2.0
+    exe = paddle.static.Executor()
+    a = np.array([[1.0, -1.0, 2.0, -2.0]], np.float32)
+    first = exe.run(main, feed={"x": a}, fetch_list=[out],
+                    return_numpy=False)[0]
+    second, = exe.run(main, feed={"x": first}, fetch_list=[out],
+                      return_numpy=True)
+    np.testing.assert_allclose(second, np.maximum(a, 0) * 4.0)
+
+
+# -- (c) async == sync ------------------------------------------------------
+
+def test_return_numpy_false_matches_sync_path():
+    main, loss = _mlp_program(seed=1)
+    main2, loss2 = _mlp_program(seed=1)
+    exe = paddle.static.Executor()
+    xs, ys = _batch(1)
+    for i in range(6):
+        a = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                    return_numpy=False)[0]
+        s, = exe.run(main2, feed={"x": xs, "y": ys}, fetch_list=[loss2],
+                     return_numpy=True)
+        np.testing.assert_allclose(np.asarray(a.data), s, rtol=1e-6)
+
+
+def test_fast_path_matches_legacy_oracle():
+    """The donated in-graph-counter hot path computes the same training
+    trajectory as the preserved pre-change executor (_run_legacy)."""
+    main, loss = _mlp_program(seed=2, opt_cls=optimizer.Adam, lr=1e-2)
+    main2, loss2 = _mlp_program(seed=2, opt_cls=optimizer.Adam, lr=1e-2)
+    exe = paddle.static.Executor()
+    exe2 = paddle.static.Executor()
+    xs, ys = _batch(2)
+    for _ in range(8):
+        fast, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        legacy, = exe2._run_legacy(main2, feed={"x": xs, "y": ys},
+                                   fetch_list=[loss2])
+        np.testing.assert_allclose(fast, legacy, rtol=1e-5, atol=1e-7)
+
+
+# -- (d) interleaved programs ----------------------------------------------
+
+def test_executor_state_survives_interleaved_programs():
+    """Two Programs alternating on ONE Executor (global_shuffle-style
+    interleaving) keep independent device-resident states and both
+    train to convergence."""
+    main_a, loss_a = _mlp_program(seed=4, lr=0.1)
+    main_b, loss_b = _mlp_program(seed=5, lr=0.1)
+    exe = paddle.static.Executor()
+    xa, ya = _batch(4)
+    xb, yb = _batch(5)
+    first_a = first_b = last_a = last_b = None
+    for _ in range(40):
+        la, = exe.run(main_a, feed={"x": xa, "y": ya}, fetch_list=[loss_a])
+        lb, = exe.run(main_b, feed={"x": xb, "y": yb}, fetch_list=[loss_b])
+        first_a = first_a if first_a is not None else float(la)
+        first_b = first_b if first_b is not None else float(lb)
+        last_a, last_b = float(la), float(lb)
+    assert last_a < first_a * 0.2, (first_a, last_a)
+    assert last_b < first_b * 0.2, (first_b, last_b)
+    assert exe.compile_count == 2  # one per program
+
+
+def test_shared_parameter_across_programs_stays_consistent():
+    """A Parameter used by two Programs: each executor state steals the
+    binding in turn; values must flow through, not fork."""
+    paddle.seed(6)
+    lin = nn.Linear(4, 1)
+    progs = []
+    for s in (0, 1):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            loss = F.mse_loss(lin(x), y)
+            optimizer.SGD(learning_rate=0.05).minimize(loss)
+        progs.append((main, loss))
+    exe = paddle.static.Executor()
+    xs, ys = _batch(6, in_dim=4)
+    l0 = None
+    for i in range(40):
+        main, loss = progs[i % 2]
+        lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        l0 = l0 if l0 is not None else float(lv)
+    assert float(lv) < l0 * 0.2, (l0, float(lv))
+
+
+# -- lazy Parameter.data ----------------------------------------------------
+
+def test_param_data_reads_see_training_progress_lazily():
+    main, loss = _mlp_program(seed=7)
+    w = main.parameters()[0]
+    exe = paddle.static.Executor()
+    xs, ys = _batch(7)
+    exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    v1 = np.asarray(w.data).copy()
+    exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    v2 = np.asarray(w.data).copy()
+    assert not np.array_equal(v1, v2)  # resolved through the live state
+
+
+def test_user_write_to_param_data_is_respected():
+    main, loss = _mlp_program(seed=8, lr=0.0)  # lr=0: params frozen
+    w, b = main.parameters()[:2]
+    exe = paddle.static.Executor()
+    xs, ys = _batch(8)
+    base, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    w.data = jnp.zeros_like(w.data)  # direct write while state is live
+    b.data = jnp.zeros_like(b.data)
+    changed, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    # zeroing the first layer changes the loss deterministically
+    assert not np.allclose(base, changed)
+    np.testing.assert_allclose(np.asarray(w.data), 0.0)
+
+
+def test_executor_close_flushes_state_into_parameters():
+    main, loss = _mlp_program(seed=9)
+    w = main.parameters()[0]
+    exe = paddle.static.Executor()
+    xs, ys = _batch(9)
+    for _ in range(3):
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    live = np.asarray(w.data).copy()
+    exe.close()
+    assert w._exec_src is None  # unbound: value now lives in the slot
+    np.testing.assert_array_equal(np.asarray(w.data), live)
+
+
+def test_static_optimizer_state_dict_exports_executor_slots():
+    main, loss = _mlp_program(seed=10, opt_cls=optimizer.Adam, lr=1e-3)
+    opt = main._optimizer[0]
+    exe = paddle.static.Executor()
+    xs, ys = _batch(10)
+    for _ in range(3):
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    sd = opt.state_dict()
+    assert sd["step"] == 3
+    assert sd["slots"], "executor-resident Adam slots should be exported"
+    some = next(iter(sd["slots"].values()))
+    assert len(some) >= 1 and all(
+        isinstance(v, np.ndarray) for v in some.values())
+
+
+def test_static_set_state_dict_restores_executor_slots():
+    """Checkpoint round-trip: a fresh process-equivalent (new Program +
+    optimizer, params copied, set_state_dict) continues training with
+    the SAME Adam moments — the post-restore update matches bit-for-bit
+    the update the original would have taken."""
+    main, loss = _mlp_program(seed=13, opt_cls=optimizer.Adam, lr=1e-2)
+    opt = main._optimizer[0]
+    exe = paddle.static.Executor()
+    xs, ys = _batch(13)
+    feed = {"x": xs, "y": ys}
+    for _ in range(5):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    ckpt = opt.state_dict()
+    snap = [np.asarray(p.data).copy() for p in main.parameters()]
+    exe.run(main, feed=feed, fetch_list=[loss])  # original's 6th step
+    want = [np.asarray(p.data) for p in main.parameters()]
+
+    main2, loss2 = _mlp_program(seed=99, opt_cls=optimizer.Adam, lr=1e-2)
+    opt2 = main2._optimizer[0]
+    for p2, arr in zip(main2.parameters(), snap):
+        p2.data = jnp.asarray(arr)
+    opt2.set_state_dict(ckpt)
+    exe2 = paddle.static.Executor()
+    exe2.run(main2, feed=feed, fetch_list=[loss2])  # restored 6th step
+    for p2, w in zip(main2.parameters(), want):
+        np.testing.assert_allclose(np.asarray(p2.data), w,
+                                   rtol=1e-6, atol=1e-8)
+
+
+# -- rng / donate-off -------------------------------------------------------
+
+def test_explicit_seed_reproduces_dropout_run():
+    paddle.seed(11)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        y = paddle.static.data("y", [None, 8], "float32")
+        h = F.dropout(paddle.static.nn.fc(x, 8), p=0.5, training=True)
+        loss = F.mse_loss(h, y)
+        optimizer.SGD(learning_rate=0.0).minimize(loss)
+    exe = paddle.static.Executor()
+    xs = np.ones((4, 8), np.float32)
+    ys = np.zeros((4, 8), np.float32)
+    a, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss], seed=7)
+    b, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss], seed=7)
+    c, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    np.testing.assert_allclose(a, b)
+    assert not np.allclose(a, c)  # auto-incrementing in-graph run counter
+    # negative seeds are honored too (flag-gated, not a -1 sentinel)
+    d, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss], seed=-3)
+    e, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss], seed=-3)
+    np.testing.assert_allclose(d, e)
+
+
+def test_donate_flag_off_still_trains():
+    paddle.set_flags({"FLAGS_static_donate": False})
+    try:
+        main, loss = _mlp_program(seed=12, lr=0.1)
+        exe = paddle.static.Executor()
+        xs, ys = _batch(12)
+        l0 = float(exe.run(main, feed={"x": xs, "y": ys},
+                           fetch_list=[loss])[0])
+        for _ in range(30):
+            lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        assert float(lv) < l0 * 0.2
+    finally:
+        paddle.set_flags({"FLAGS_static_donate": True})
+
+
+# -- satellite: VJP cache LRU eviction --------------------------------------
+
+def test_vjp_cache_evicts_oldest_half_not_everything(monkeypatch):
+    from paddle_tpu.core import dispatch
+
+    monkeypatch.setattr(dispatch, "_VJP_CACHE_CAP", 8)
+    monkeypatch.setattr(dispatch, "_VJP_CACHE", type(dispatch._VJP_CACHE)())
+    for i in range(8):
+        dispatch._cache_store(("k", i), i)
+    assert len(dispatch._VJP_CACHE) == 8
+    dispatch._cache_lookup(("k", 0))          # touch: now most-recent
+    dispatch._cache_store(("k", 8), 8)        # triggers eviction
+    cache = dispatch._VJP_CACHE
+    assert len(cache) == 5                     # half evicted, one added
+    assert ("k", 0) in cache                   # LRU-touched survivor
+    assert ("k", 8) in cache
+    assert ("k", 1) not in cache               # oldest half gone
+
+
+def test_eager_training_after_cache_pressure(monkeypatch):
+    """Eviction at the cap must not break live compiled rules."""
+    from paddle_tpu.core import dispatch
+    monkeypatch.setattr(dispatch, "_VJP_CACHE_CAP", 4)
+    paddle.disable_static()
+    try:
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        opt = optimizer.SGD(learning_rate=0.05,
+                            parameters=model.parameters())
+        x = paddle.randn([8, 4])
+        y = paddle.randn([8, 1])
+        losses = []
+        for _ in range(6):
+            loss = F.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+    finally:
+        paddle.enable_static()
+
+
+# -- satellite: bench smoke guard ------------------------------------------
+
+def test_bench_smoke_tool_passes_in_process():
+    import os
+    import sys
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    sys.path.insert(0, tools)
+    try:
+        import bench_smoke
+    finally:
+        sys.path.remove(tools)
+    paddle.disable_static()
+    try:
+        failures = bench_smoke.run_checks(steps=8, timing=False)
+        assert failures == [], failures
+    finally:
+        paddle.enable_static()

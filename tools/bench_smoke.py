@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""CI smoke guard for the static Executor hot path.
+
+Runs a tiny static train loop on CPU and exits non-zero when the donated
+hot path regresses:
+
+1. **recompiles** — more than one XLA compile per (feed signature,
+   fetch set): something put per-step-varying data into the compile key
+   (``Executor.compile_count``, the jit cache-miss counter equivalent).
+2. **host feeds** — an already-on-device feed took the NumPy
+   device→host→device round-trip (``Executor.host_feed_converts``).
+3. **per-step host sync** (optional, ``--timing``) — the async-dispatch
+   loop (``return_numpy=False``, sync once at the end) must be faster
+   than the per-step-synced loop (``return_numpy=True``).  If dispatch
+   itself started blocking on device work, both loops time the same and
+   the check fails.  Wall-clock checks are retried once to ride out CI
+   noise; ``--no-timing`` (default under pytest) skips them.
+
+Usage:  python tools/bench_smoke.py [--steps N] [--timing]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build(hidden=64, depth=3):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import optimizer
+
+    paddle.seed(0)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, hidden], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        h = x
+        for _ in range(depth):
+            h = paddle.static.nn.fc(h, hidden, activation="relu")
+        loss = F.mse_loss(paddle.static.nn.fc(h, 1), y)
+        optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return main, loss
+
+
+def run_checks(steps: int = 30, timing: bool = False) -> list:
+    """Returns a list of failure strings (empty = healthy)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+
+    failures = []
+    paddle.enable_static()
+    try:
+        main, loss = _build()
+        exe = paddle.static.Executor()
+        rng = np.random.RandomState(0)
+        feed = {"x": jnp.asarray(rng.standard_normal(
+                    (8, 64)).astype(np.float32)),
+                "y": jnp.asarray(rng.standard_normal(
+                    (8, 1)).astype(np.float32))}
+
+        for _ in range(steps):
+            last = exe.run(main, feed=feed, fetch_list=[loss],
+                           return_numpy=False)[0]
+        float(np.asarray(last.data))
+
+        if exe.compile_count != 1:
+            failures.append(
+                f"recompile regression: {exe.compile_count} compiles for "
+                f"ONE feed signature across {steps} steps (expected 1)")
+        if exe.host_feed_converts != 0:
+            failures.append(
+                f"host-feed regression: {exe.host_feed_converts} NumPy "
+                f"round-trips for already-on-device feeds (expected 0)")
+
+        if timing:
+            def loop(sync):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    out = exe.run(main, feed=feed, fetch_list=[loss],
+                                  return_numpy=sync)[0]
+                if not sync:
+                    float(np.asarray(out.data))
+                return time.perf_counter() - t0
+
+            for _ in range(2):  # one retry against CI noise
+                t_async, t_sync = loop(False), loop(True)
+                if t_async < t_sync:
+                    break
+            if t_async >= t_sync:
+                failures.append(
+                    f"host-sync regression: async-dispatch loop "
+                    f"({t_async * 1000:.1f} ms) is not faster than the "
+                    f"per-step-synced loop ({t_sync * 1000:.1f} ms) — "
+                    f"run() appears to block per step")
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--timing", dest="timing", action="store_true",
+                    default=True)
+    ap.add_argument("--no-timing", dest="timing", action="store_false")
+    args = ap.parse_args(argv)
+
+    failures = run_checks(steps=args.steps, timing=args.timing)
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("bench_smoke: static hot path healthy "
+          f"(1 compile, 0 host feeds{', async < synced' if args.timing else ''})")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())
