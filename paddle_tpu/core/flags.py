@@ -112,6 +112,35 @@ define_flag("profiler_sync_ops", False,
             "dispatch.  Accurate per-op device cost attribution at the "
             "price of serializing the pipeline; default off.  Also "
             "settable per-Profiler via Profiler(sync_ops=True).")
+define_flag("fault_spec", "",
+            "Deterministic fault-injection spec (paddle_tpu.testing.fault"
+            " grammar: 'point_glob:p=...,count=...,exc=...;...').  Armed "
+            "from the environment at import; after set_flags() call "
+            "testing.fault.arm_from_flags().  Empty = injector disarmed "
+            "(zero overhead).")
+define_flag("fault_seed", 0,
+            "Seed for the fault injector's RNG — a chaos run with the "
+            "same spec+seed replays the same fault sequence.")
+define_flag("fs_retry_times", 4,
+            "Max attempts (1 initial + retries) for a filesystem op that "
+            "fails with a transient error (ShellFS always; other "
+            "registered filesystems when wrapped in RetryingFS).")
+define_flag("fs_retry_backoff_s", 0.2,
+            "Base exponential-backoff delay between fs retries; attempt "
+            "n sleeps ~base*2^n plus up to 25% jitter, capped at 10s.")
+define_flag("fs_retry_deadline_s", 60.0,
+            "Wall-clock budget across all retry attempts of one fs op; "
+            "past it the op gives up even with attempts remaining.")
+define_flag("dataloader_timeout", 120,
+            "Seconds a DataLoader iterator waits on worker results "
+            "before declaring the pool stalled (DataLoader(timeout=) "
+            "overrides per loader).")
+define_flag("dataloader_batch_retries", 3,
+            "Times one batch may be re-enqueued after DataLoader worker "
+            "deaths before the epoch fails for good.")
+define_flag("checkpoint_keep_max", 2,
+            "Snapshots retained per checkpoint dir (keep_checkpoint_max; "
+            ">=2 keeps a fallback for corrupt-latest recovery).")
 define_flag("pallas_attention_dropout_min_seqlen", 512,
             "Flash threshold when attention dropout is active: the XLA "
             "path must materialize [B,H,L,L] dropout masks in HBM, so "

@@ -56,13 +56,9 @@ def _unflatten(spec, arrays, to_tensor_cls):
     return spec
 
 
-def save(obj: Any, path: str, protocol: int = 4, encryption_key=None,
-         **configs):
-    """paddle.save parity: state_dicts, nested dict/list of tensors,
-    scalars.  ``path`` may carry a registered filesystem scheme
-    (``hdfs://...`` — utils/fs.py, reference framework/io/fs.cc);
-    ``encryption_key`` encrypts the artifact at rest (AES-256-GCM,
-    reference framework/io/crypto)."""
+def dumps(obj: Any, protocol: int = 4, encryption_key=None) -> bytes:
+    """Serialise to the ``paddle_tpu.save`` wire format in memory —
+    checkpoint integrity digests hash exactly these bytes."""
     arrays: dict = {}
     skeleton = _flatten(obj, "r", arrays, None)
     buf = _io.BytesIO()
@@ -77,20 +73,15 @@ def save(obj: Any, path: str, protocol: int = 4, encryption_key=None,
     if encryption_key is not None:
         from .utils import crypto
         payload = crypto.encrypt(payload, encryption_key)
-    from .utils import fs as _fs
-    with _fs.open_write(path) as f:
-        f.write(payload)
+    return payload
 
 
-def load(path: str, encryption_key=None, **configs) -> Any:
-    from .utils import fs as _fs
-    with _fs.open_read(path) as f:
-        payload = f.read()
+def loads(payload: bytes, encryption_key=None, source: str = "<bytes>") -> Any:
     from .utils import crypto
     if crypto.is_encrypted(payload[:8]):
         if encryption_key is None:
             raise ValueError(
-                f"'{path}' is encrypted — pass encryption_key= to load")
+                f"'{source}' is encrypted — pass encryption_key= to load")
         payload = crypto.decrypt(payload, encryption_key)
     f = _io.BytesIO(payload)
     magic = f.read(8)
@@ -102,3 +93,24 @@ def load(path: str, encryption_key=None, **configs) -> Any:
     skeleton = pickle.loads(f.read(n))
     arrays = dict(np.load(_io.BytesIO(f.read()), allow_pickle=False))
     return _unflatten(skeleton, arrays, Tensor)
+
+
+def save(obj: Any, path: str, protocol: int = 4, encryption_key=None,
+         **configs):
+    """paddle.save parity: state_dicts, nested dict/list of tensors,
+    scalars.  ``path`` may carry a registered filesystem scheme
+    (``hdfs://...`` — utils/fs.py, reference framework/io/fs.cc);
+    ``encryption_key`` encrypts the artifact at rest (AES-256-GCM,
+    reference framework/io/crypto).  The artifact lands via tmp-file +
+    rename, so a crash mid-save never leaves a truncated ``.pdparams``
+    (atomic on LocalFS; best-effort delete+rename on ShellFS)."""
+    payload = dumps(obj, protocol=protocol, encryption_key=encryption_key)
+    from .utils import fs as _fs
+    _fs.write_atomic(path, payload)
+
+
+def load(path: str, encryption_key=None, **configs) -> Any:
+    from .utils import fs as _fs
+    with _fs.open_read(path) as f:
+        payload = f.read()
+    return loads(payload, encryption_key=encryption_key, source=path)

@@ -7,8 +7,8 @@ in one XLA executable) — the role the reference's static-graph adapter
 plays — while keeping the dygraph-style API."""
 from .model import Model  # noqa: F401
 from . import callbacks  # noqa: F401
-from .callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa
-                        ModelCheckpoint, ProgBarLogger,
+from .callbacks import (Callback, Checkpoint, EarlyStopping,  # noqa
+                        LRScheduler, ModelCheckpoint, ProgBarLogger,
                         ReduceLROnPlateau, VisualDL)
 
 

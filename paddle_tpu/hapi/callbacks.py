@@ -20,6 +20,9 @@ class Callback:
 
     def on_train_begin(self, logs=None): ...
     def on_train_end(self, logs=None): ...
+    # always invoked by Model.fit (finally:), even when training raises
+    # — release process-wide resources (signal handlers, files) here
+    def on_train_cleanup(self): ...
     def on_eval_begin(self, logs=None): ...
     def on_eval_end(self, logs=None): ...
     def on_predict_begin(self, logs=None): ...
@@ -97,6 +100,78 @@ class ModelCheckpoint(Callback):
     def on_train_end(self, logs=None):
         if self.save_dir:
             self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class Checkpoint(Callback):
+    """Fault-tolerant checkpointing for ``Model.fit`` — the
+    utils.checkpoint robustness knobs surfaced as a callback.
+
+    Every ``save_freq`` epochs the network (and optimizer, when
+    prepared) snapshot into ``save_dir`` with per-file sha256 digests,
+    rotated to the last ``keep_checkpoint_max`` snapshots.  On train
+    begin the newest snapshot that VERIFIES is restored (corrupt ones
+    fall back to the previous intact snapshot), so a preempted
+    ``fit()`` continues from published weights instead of from scratch.
+    While training, SIGTERM — the cloud-TPU preemption notice —
+    requests a snapshot at the next epoch boundary and then stops
+    training cleanly (``model.stop_training``); ``self.preempted``
+    records that this happened.  Note: ``fit`` restarts its epoch
+    counter — for exact epoch-resume loops use
+    ``utils.checkpoint.TrainEpochRange``."""
+
+    def __init__(self, save_dir, save_freq=1, keep_checkpoint_max=None,
+                 verify=True, restore=True, handle_preemption=True):
+        super().__init__()
+        self.save_dir = save_dir
+        self.save_freq = max(1, int(save_freq))
+        self.keep_checkpoint_max = keep_checkpoint_max
+        self.verify = verify
+        self.restore = restore
+        self.handle_preemption = handle_preemption
+        self.preempted = False
+        self.last_restored_epoch = None
+        self._store = None
+        self._restore_handler = None
+
+    def _objects(self):
+        objs = {"model": self.model.network}
+        if getattr(self.model, "_optimizer", None) is not None:
+            objs["optimizer"] = self.model._optimizer
+        return objs
+
+    def _on_preempt(self):
+        self.preempted = True
+
+    def on_train_begin(self, logs=None):
+        from ..utils.checkpoint import (SnapshotStore,
+                                        install_preemption_handler)
+        self._store = SnapshotStore(self.save_dir,
+                                    keep_max=self.keep_checkpoint_max,
+                                    verify=self.verify)
+        self.preempted = False
+        if self.restore:
+            # restore() returns 0 when no checkpoint is published yet
+            resumed = self._store.restore(self._objects())
+            self.last_restored_epoch = resumed - 1 if resumed else None
+        if self.handle_preemption:
+            self._restore_handler = \
+                install_preemption_handler(self._on_preempt)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.preempted or (epoch + 1) % self.save_freq == 0:
+            self._store.save(epoch, self._objects())
+        if self.preempted:
+            from ..utils import monitor
+            monitor.stat_add("checkpoint.preempt_saves")
+            self.model.stop_training = True
+
+    def on_train_cleanup(self):
+        if self._restore_handler is not None:
+            self._restore_handler()
+            self._restore_handler = None
+
+    def on_train_end(self, logs=None):
+        self.on_train_cleanup()
 
 
 class EarlyStopping(Callback):

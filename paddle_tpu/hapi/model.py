@@ -129,25 +129,30 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         history = {"loss": []}
-        for epoch in range(epochs):
-            self.network.train()
-            cbks.on_epoch_begin(epoch)
-            logs = {}
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                ins, labs = self._split_batch(batch)
-                loss = self.train_batch(list(ins), list(labs))
-                logs = {"loss": loss[0]}
-                if step % max(log_freq, 1) == 0:
-                    cbks.on_train_batch_end(step, logs)
-            history["loss"].append(logs.get("loss"))
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, verbose=0,
-                                          _callbacks=cbks)
-                cbks.on_eval_end(eval_logs)
-            if self.stop_training:
-                break
+        try:
+            for epoch in range(epochs):
+                self.network.train()
+                cbks.on_epoch_begin(epoch)
+                logs = {}
+                for step, batch in enumerate(loader):
+                    cbks.on_train_batch_begin(step)
+                    ins, labs = self._split_batch(batch)
+                    loss = self.train_batch(list(ins), list(labs))
+                    logs = {"loss": loss[0]}
+                    if step % max(log_freq, 1) == 0:
+                        cbks.on_train_batch_end(step, logs)
+                history["loss"].append(logs.get("loss"))
+                cbks.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_loader, verbose=0,
+                                              _callbacks=cbks)
+                    cbks.on_eval_end(eval_logs)
+                if self.stop_training:
+                    break
+        finally:
+            # even when training raises: callbacks holding process-wide
+            # resources (Checkpoint's SIGTERM handler) must release them
+            cbks.on_train_cleanup()
         cbks.on_train_end(logs)
         return history
 

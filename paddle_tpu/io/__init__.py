@@ -308,6 +308,9 @@ class _PrefetchIterator:
     def __next__(self):
         if self.next_emit >= self.total:
             raise StopIteration
+        from ..core import flags as _flags
+        timeout = (getattr(self.loader, "timeout", 0)
+                   or _flags.get_flag("dataloader_timeout"))
         # emit in order
         while True:
             with self.lock:
@@ -315,7 +318,14 @@ class _PrefetchIterator:
                     b = self.out.pop(self.next_emit)
                     self.next_emit += 1
                     return b
-            i, batch = self.q.get()
+            try:
+                i, batch = self.q.get(timeout=timeout)
+            except queue.Empty:
+                raise RuntimeError(
+                    f"DataLoader stalled: no batch for {timeout}s from "
+                    f"the thread pool — raise DataLoader(timeout=...) or "
+                    f"FLAGS_dataloader_timeout for slow datasets") \
+                    from None
             with self.lock:
                 self.out[i] = batch
 

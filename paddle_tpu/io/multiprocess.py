@@ -22,21 +22,33 @@ pickled at pool creation; per-epoch dataset mutation does not propagate).
 Workers run ``__getitem__`` + collation to NUMPY arrays only (no JAX in
 children).  The parent re-assembles views, converts to device arrays,
 and releases the block.  Worker death is detected on queue timeout (the
-reference's SIGCHLD handler analog).  In-flight work is bounded to
-``num_workers * prefetch_factor`` batches so /dev/shm never holds more
-than the prefetch window."""
+reference's SIGCHLD handler analog) and is SELF-HEALING: dead workers
+are respawned in place and their in-flight batches re-enqueued (bounded
+by ``FLAGS_dataloader_batch_retries`` per batch), so a single OOM-killed
+worker costs a recompute, not the epoch.  Restart counts and exit codes
+surface in ``monitor`` stats (``dataloader.worker_restarts``,
+``dataloader.batch_retries``) and in the death diagnostic.  The stall
+timeout honors ``DataLoader(timeout=...)`` end-to-end, defaulting to
+``FLAGS_dataloader_timeout``.  Each worker owns private index/result
+SimpleQueues (no cross-worker shared locks — a hard-killed worker can
+wedge only its own pipes, which respawn replaces), and in-flight work
+is bounded to ``prefetch_factor`` batches per worker so /dev/shm never
+holds more than the prefetch window."""
 from __future__ import annotations
 
 import atexit
 import multiprocessing as mp
 import os
-import queue as queue_mod
+import time
 from multiprocessing import shared_memory
-from typing import Any, List
+from typing import List
 
 import numpy as np
 
+from ..core import flags as _flags
 from ..core.tensor import Tensor
+from ..testing import fault
+from ..utils import monitor
 
 _live_shm: set = set()
 
@@ -52,6 +64,17 @@ def _cleanup_shm():
 
 
 atexit.register(_cleanup_shm)
+
+
+def _free_shm(name):
+    if not name:
+        return
+    try:
+        s = shared_memory.SharedMemory(name=name)
+        s.close()
+        s.unlink()
+    except Exception:
+        pass
 
 
 def _to_numpy(obj):
@@ -109,22 +132,50 @@ def _unflatten(spec, leaves, it=None):
 
 
 def _worker_loop(dataset, collate_fn, idx_q, result_q, worker_id,
-                 worker_init_fn, seed):
+                 worker_init_fn, seed, fault_spec=None):
+    """Consume (tag, i, idxs) from this worker's PRIVATE idx_q, publish
+    (tag, i, shm_name, spec, metas, err) on its PRIVATE result_q.
+
+    The queues are SimpleQueues: ``put`` writes the pipe synchronously
+    in this thread (no feeder), so once a result is put it SURVIVES any
+    subsequent death of this process — and since no other worker shares
+    these queues, dying mid-operation can wedge at most this worker's
+    own pipes, which the parent replaces on respawn."""
+    _dbg = None
+    if os.environ.get("PADDLE_TPU_MP_DEBUG"):
+        _dbg = open(f"/tmp/mpdbg.{worker_id}.{os.getpid()}", "a", 1)
+
+    def _trace(msg):
+        if _dbg:
+            _dbg.write(msg + "\n")
     np.random.seed((seed + worker_id) % (2 ** 31))
+    if fault_spec is not None:
+        fault.arm(fault_spec[0], seed=fault_spec[1])
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
+    _trace("loop-start")
     while True:
         item = idx_q.get()
         if item is None:
+            if _dbg:
+                _dbg.close()
             return
         tag, i, idxs = item
+        _trace(f"got {tag} {i}")
+        # chaos hook: a rule like 'mp.worker_batch:count=1,action=exit,
+        # match=batch=1' hard-kills one worker mid-epoch (the reference
+        # SIGCHLD scenario)
+        fault.point("mp.worker_batch", f"worker={worker_id}",
+                    f"batch={i}")
         try:
+            _trace(f"work {i}")
             samples = [dataset[j] for j in idxs]
             batch = (_to_numpy(collate_fn(samples)) if collate_fn
                      else _np_collate([_to_numpy(s) for s in samples]))
             leaves: List[np.ndarray] = []
             spec = _flatten(batch, leaves)
             total = sum(a.nbytes for a in leaves)
+            _trace(f"shm-create {i}")
             shm = shared_memory.SharedMemory(create=True,
                                              size=max(total, 1))
             # ownership passes to the parent (which unlinks after
@@ -145,7 +196,9 @@ def _worker_loop(dataset, collate_fn, idx_q, result_q, worker_id,
                 metas.append((shp, a.dtype.str, off))
                 off += a.nbytes
             shm.close()
+            _trace(f"put {i}")
             result_q.put((tag, i, shm.name, spec, metas, None))
+            _trace(f"put-done {i}")
         except Exception as e:  # surface the worker traceback in the parent
             import traceback
             result_q.put((tag, i, None, None, None,
@@ -154,28 +207,38 @@ def _worker_loop(dataset, collate_fn, idx_q, result_q, worker_id,
 
 
 class _WorkerPool:
-    """Persistent worker pool shared by successive epoch iterators."""
+    """Persistent worker pool shared by successive epoch iterators.
+
+    Dead workers are respawned in place (``restart_worker``) — the
+    reference tears the whole reader down from its SIGCHLD handler; a
+    preemptible pod can't afford that, so the pool self-heals and keeps
+    a ledger of restarts + exit codes for the diagnostics.
+
+    Each worker owns PRIVATE index/result SimpleQueues (torch's
+    _index_queues layout).  This is the crash-safety load-bearing wall:
+    a shared queue's cross-process locks die with whoever holds them
+    (a worker killed mid-``put`` on a shared result queue wedges every
+    other worker forever), while a private queue can only wedge its
+    owner — and ``restart_worker`` replaces the queues along with the
+    process."""
 
     def __init__(self, loader):
         method = os.environ.get("PADDLE_TPU_MP_START", "forkserver")
         if method not in mp.get_all_start_methods():
             method = "spawn"
-        ctx = mp.get_context(method)
-        self.idx_q = ctx.Queue()
-        self.result_q = ctx.Queue()
-        self.workers = []
+        self._ctx = mp.get_context(method)
+        self._method = method
+        self._loader = loader
+        self._seed = int.from_bytes(os.urandom(4), "little")
+        self.idx_qs: List = []
+        self.res_qs: List = []
+        self.workers: List = []
         self.epoch = 0
-        n = loader.num_workers
-        for w in range(n):
+        self.restarts = 0
+        self.exit_history: List[tuple] = []   # (worker_id, exit_code)
+        for w in range(loader.num_workers):
             try:
-                p = ctx.Process(
-                    target=_worker_loop,
-                    args=(loader.dataset, loader.collate_fn, self.idx_q,
-                          self.result_q, w,
-                          getattr(loader, "worker_init_fn", None),
-                          int.from_bytes(os.urandom(4), "little")),
-                    daemon=True)
-                p.start()
+                self._spawn(w, respawn=False, replace=False)
             except Exception as e:
                 self.close()
                 raise RuntimeError(
@@ -183,28 +246,80 @@ class _WorkerPool:
                     f"({type(e).__name__}: {e}); a non-picklable dataset/"
                     f"collate_fn needs PADDLE_TPU_MP_START=fork or "
                     f"use_shared_memory=False") from e
+
+    def _spawn(self, w, respawn, replace):
+        loader = self._loader
+        idx_q = self._ctx.SimpleQueue()
+        res_q = self._ctx.SimpleQueue()
+        p = self._ctx.Process(
+            target=_worker_loop,
+            args=(loader.dataset, loader.collate_fn, idx_q, res_q, w,
+                  getattr(loader, "worker_init_fn", None), self._seed,
+                  fault.spec_for_children(respawn=respawn)),
+            daemon=True)
+        p.start()
+        # drop the parent's (unused) write end of the result pipe: once
+        # the worker dies, reads hit EOF instead of blocking forever —
+        # without this, a worker SIGKILLed mid-write of a result larger
+        # than the pipe's atomic size would wedge drain_worker/close
+        try:
+            res_q._writer.close()
+        except (OSError, AttributeError):
+            pass
+        if replace:
+            self.idx_qs[w] = idx_q
+            self.res_qs[w] = res_q
+            self.workers[w] = p
+        else:
+            self.idx_qs.append(idx_q)
+            self.res_qs.append(res_q)
             self.workers.append(p)
 
+    def restart_worker(self, w) -> int:
+        """Replace a dead worker — process AND queues (its pipes/locks
+        may be wedged mid-operation); returns its exit code."""
+        dead = self.workers[w]
+        dead.join(timeout=5)
+        code = dead.exitcode
+        self.exit_history.append((w, code))
+        self._spawn(w, respawn=True, replace=True)
+        self.restarts += 1
+        monitor.stat_add("dataloader.worker_restarts")
+        return code
+
+    def drain_worker(self, w, handler):
+        """Feed every already-readable result of worker ``w`` (the pipe
+        contents survive the worker's death) to ``handler``; returns
+        the number of messages handled (0 at EOF — dead worker)."""
+        q = self.res_qs[w]
+        n = 0
+        while True:
+            try:
+                if not q._reader.poll():
+                    return n
+                msg = q.get()
+            except (OSError, ValueError, EOFError):
+                return n
+            handler(w, msg)
+            n += 1
+
     def close(self):
-        for p in self.workers:
+        for w, p in enumerate(self.workers):
             if p.is_alive():
                 p.terminate()
         for p in self.workers:
             p.join(timeout=5)
-        for q in (self.idx_q, self.result_q):
+        for q in self.res_qs:      # free shm of undelivered results
             while True:
                 try:
-                    item = q.get_nowait()
-                except (queue_mod.Empty, OSError, ValueError):
+                    if not q._reader.poll():
+                        break
+                    item = q.get()
+                except (OSError, ValueError, EOFError):
                     break
                 name = item[2] if len(item) >= 3 else None
                 if isinstance(name, str):
-                    try:
-                        s = shared_memory.SharedMemory(name=name)
-                        s.close()
-                        s.unlink()
-                    except Exception:
-                        pass
+                    _free_shm(name)
 
     def __del__(self):
         try:
@@ -224,9 +339,19 @@ def get_pool(loader) -> _WorkerPool:
 
 
 class MultiprocessIterator:
-    """Ordered batch producer over the loader's persistent pool."""
+    """Ordered batch producer over the loader's persistent pool.
+
+    The parent is the scheduler: it deals batches to each worker's
+    private index queue (at most ``prefetch_factor`` in flight per
+    worker) and tracks exactly what it dealt.  Worker results are
+    synchronous pipe writes, so on a worker death the undelivered
+    remainder of its deal — no more, no less — is re-dealt, and the
+    retry budget is charged only to the batch the worker was actually
+    chewing (the oldest undelivered one: workers run their queue in
+    order)."""
 
     def __init__(self, loader, sampler_iter):
+        from collections import deque
         self.loader = loader
         self.pool = get_pool(loader)
         self.pool.epoch += 1
@@ -235,21 +360,94 @@ class MultiprocessIterator:
         self.total = len(self.batches)
         self.pending = {}
         self.next_emit = 0
-        self.timeout = getattr(loader, "timeout", 0) or 120
-        # backpressure: at most num_workers * prefetch_factor batches in
-        # flight, so /dev/shm holds a bounded window, not the whole epoch
-        n = loader.num_workers
-        self._window = max(
-            n * max(int(getattr(loader, "prefetch_factor", 2)), 1), n)
-        self._fed = 0
-        while self._fed < min(self._window, self.total):
-            self._feed_one()
+        self.timeout = (getattr(loader, "timeout", 0)
+                        or _flags.get_flag("dataloader_timeout"))
+        self.retry_budget = int(
+            _flags.get_flag("dataloader_batch_retries"))
+        self.retries: dict = {}               # batch index -> re-deals
+        # backpressure: at most prefetch_factor batches in flight per
+        # worker, so /dev/shm holds a bounded window, not the whole epoch
+        self._per_worker = max(
+            int(getattr(loader, "prefetch_factor", 2)), 1)
+        self.todo = deque(range(self.total))
+        self.inflight = {w: deque()
+                         for w in range(len(self.pool.workers))}
+        self._fill()
 
-    def _feed_one(self):
-        if self._fed < self.total:
-            self.pool.idx_q.put(
-                (self.tag, self._fed, list(self.batches[self._fed])))
-            self._fed += 1
+    def _fill(self):
+        """Deal todo batches to workers with free credit."""
+        progress = True
+        while self.todo and progress:
+            progress = False
+            for w, fl in self.inflight.items():
+                if not self.todo:
+                    break
+                if len(fl) < self._per_worker:
+                    i = self.todo.popleft()
+                    self.pool.idx_qs[w].put(
+                        (self.tag, i, list(self.batches[i])))
+                    fl.append(i)
+                    progress = True
+
+    def _worker_status(self):
+        return ", ".join(
+            f"w{w}:{'alive' if p.is_alive() else p.exitcode}"
+            for w, p in enumerate(self.pool.workers))
+
+    def _ingest(self, w, msg):
+        """Fold one result message from worker ``w`` into pending."""
+        tag, i, name, spec, metas, err = msg
+        if tag != self.tag:
+            # stale result from an abandoned earlier epoch: free it
+            _free_shm(name)
+            return
+        try:
+            self.inflight[w].remove(i)
+        except ValueError:
+            pass
+        if i < self.next_emit or i in self.pending:
+            # duplicate of a re-dealt batch that survived after all:
+            # every batch is emitted exactly once — drop it (even a
+            # failed re-execution of an already-delivered batch)
+            _free_shm(name)
+            return
+        if err is not None:
+            self.pool.close()
+            self.loader._mp_pool = None
+            raise RuntimeError(f"DataLoader worker failed:\n{err}")
+        self.pending[i] = (name, spec, metas)
+
+    def _heal(self, dead):
+        """Respawn dead workers (fresh queues) and re-deal exactly the
+        batches they still owed.  Raises when a batch burns through its
+        retry budget — a batch that kills every worker that touches it
+        is a dataset bug, not a flaky node."""
+        for w in dead:
+            # the pipe outlives the process: collect results it
+            # delivered before dying, so they aren't re-dealt
+            self.pool.drain_worker(w, self._ingest)
+            lost = list(self.inflight[w])
+            self.inflight[w].clear()
+            self.pool.restart_worker(w)
+            if not lost:
+                continue
+            # workers run FIFO, so the oldest undelivered batch is the
+            # one that was being processed at death: it takes the blame
+            killer = lost[0]
+            self.retries[killer] = self.retries.get(killer, 0) + 1
+            if self.retries[killer] > self.retry_budget:
+                self.pool.close()
+                self.loader._mp_pool = None
+                raise RuntimeError(
+                    f"DataLoader batch(es) [{killer}] still failing "
+                    f"after {self.retry_budget} worker-death retries "
+                    f"(exit codes: {self.pool.exit_history}) — giving "
+                    f"up.  A batch that repeatedly kills its worker "
+                    f"points at the dataset/collate_fn (OOM, native "
+                    f"crash), not a transient fault.")
+            self.todo.extendleft(reversed(lost))
+            monitor.stat_add("dataloader.batch_retries", len(lost))
+        self._fill()
 
     def __iter__(self):
         return self
@@ -275,46 +473,58 @@ class MultiprocessIterator:
         return _unflatten(spec, leaves)
 
     def __next__(self):
+        from multiprocessing import connection as mp_conn
         if self.next_emit >= self.total:
             raise StopIteration
+        poll = min(self.timeout, 2.0)
         waited = 0.0
         while self.next_emit not in self.pending:
+            readers = {q._reader: w
+                       for w, q in enumerate(self.pool.res_qs)}
             try:
-                tag, i, name, spec, metas, err = self.pool.result_q.get(
-                    timeout=min(self.timeout, 15))
-            except queue_mod.Empty:
-                dead = [w for w, p in enumerate(self.pool.workers)
-                        if not p.is_alive()]
-                waited += min(self.timeout, 15)
-                if not dead and waited < self.timeout:
-                    continue          # alive but slow (loaded machine)
-                self.pool.close()
-                self.loader._mp_pool = None
-                raise RuntimeError(
-                    f"DataLoader worker(s) {dead or '?'} died or stalled "
-                    f"(timeout={self.timeout}s) — reference analog: "
-                    f"reader.py SIGCHLD handler.  If the dataset/collate "
-                    f"is defined in a script's __main__, forkserver "
-                    f"workers re-import the script (python spawn "
-                    f"semantics): guard it with `if __name__ == "
-                    f"'__main__'`, move the dataset to a module, or set "
-                    f"PADDLE_TPU_MP_START=fork.")
-            if tag != self.tag:
-                # stale result from an abandoned earlier epoch: free it
-                if name:
-                    try:
-                        s = shared_memory.SharedMemory(name=name)
-                        s.close()
-                        s.unlink()
-                    except Exception:
-                        pass
+                ready = mp_conn.wait(list(readers), timeout=poll)
+            except OSError:
+                ready = []
+            handled = 0
+            for r in ready:
+                handled += self.pool.drain_worker(readers[r],
+                                                  self._ingest)
+            if handled:
+                self._fill()
+                waited = 0.0
                 continue
-            if err is not None:
-                self.pool.close()
-                self.loader._mp_pool = None
-                raise RuntimeError(f"DataLoader worker failed:\n{err}")
-            self.pending[i] = (name, spec, metas)
+            # nothing arrived: timed out, or a ready reader was a dead
+            # worker's EOF'd pipe — check for deaths before looping so
+            # an EOF'd pipe can't spin us without ever healing
+            dead = [w for w, p in enumerate(self.pool.workers)
+                    if not p.is_alive()]
+            if dead:
+                # self-heal: respawn + re-deal, then keep waiting
+                self._heal(dead)
+                waited = 0.0
+                continue
+            if ready:
+                # momentary race (EOF visible, is_alive not yet False):
+                # yield briefly; the next pass will see the death
+                time.sleep(0.05)
+                continue
+            waited += poll
+            if waited < self.timeout:
+                continue              # alive but slow (loaded machine)
+            self.pool.close()
+            self.loader._mp_pool = None
+            raise RuntimeError(
+                f"DataLoader stalled: no batch for {self.timeout}s "
+                f"with all workers alive ({self._worker_status()}; "
+                f"restarts so far: {self.pool.exit_history or 'none'})"
+                f" — raise DataLoader(timeout=...) or "
+                f"FLAGS_dataloader_timeout for slow datasets.  If "
+                f"the dataset/collate is defined in a script's "
+                f"__main__, forkserver workers re-import the script "
+                f"(python spawn semantics): guard it with `if "
+                f"__name__ == '__main__'`, move the dataset to a "
+                f"module, or set PADDLE_TPU_MP_START=fork.")
         name, spec, metas = self.pending.pop(self.next_emit)
         self.next_emit += 1
-        self._feed_one()
+        self._fill()
         return self._tensorize(name, spec, metas)

@@ -337,6 +337,10 @@ class Executor:
             return program._run_loaded(feed, fetch_list, return_numpy)
         if program is None:
             program = default_main_program()
+        # chaos hook: lets fault specs crash a training step on demand
+        # (preemption drills around the checkpoint/restore path)
+        from ..testing import fault
+        fault.point("executor.run", program._serial)
         feed = feed or {}
         fetch_list = list(fetch_list or [])
         if not program.nodes:

@@ -1,0 +1,10 @@
+"""paddle_tpu.testing — deterministic chaos tooling.
+
+:mod:`fault` is the fault-injection framework: named injection points
+(``fault.point("fs.open_write", path)``) compiled into the fs /
+checkpoint / DataLoader / executor layers, armed by tests or by
+``FLAGS_fault_spec`` with per-point probability, fire counts, and
+exception classes.  Disarmed, a point is a single module-bool check —
+production code pays nothing for carrying it.
+"""
+from . import fault  # noqa: F401

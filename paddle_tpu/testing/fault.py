@@ -1,0 +1,279 @@
+"""Deterministic fault injection.
+
+The recovery paths this repo carries (fs retries, checkpoint fallback,
+DataLoader worker respawn — reference: framework/io/fs.cc retries,
+incubate/checkpoint/auto_checkpoint.py, fluid/reader.py SIGCHLD handler)
+are worthless untested, and none of their failure modes occur naturally
+on a developer box.  This module makes faults happen on demand:
+
+* Instrumented code calls :func:`point` at named sites::
+
+      fault.point("fs.open_write", path)
+
+  Disarmed (the default), ``point`` is one module-bool check and a
+  return — no rule matching, no RNG, no stat writes.
+
+* Tests arm rules programmatically (:func:`arm` / :func:`inject`) or
+  operators arm them process-wide through ``FLAGS_fault_spec``::
+
+      FLAGS_fault_spec="fs.shell_run:p=0.3,count=2,exc=TransientFSError;\
+mp.worker_batch:count=1,action=exit,code=43"
+
+  Rule grammar: ``point_glob[:key=val[,key=val]*]`` joined by ``;``.
+  Keys: ``p`` (fire probability, default 1), ``count`` (max fires,
+  default unlimited), ``after`` (skip the first N matching hits),
+  ``exc`` (exception class name, default :class:`FaultInjected`),
+  ``msg`` (message override), ``match`` (substring that must appear in
+  the point's detail args), ``action`` (``raise`` | ``exit``), ``code``
+  (exit status for ``action=exit``), ``respawn`` (1 = keep the rule
+  armed in *respawned* DataLoader workers; default 0 = kill-once).
+
+* The RNG driving ``p`` is seeded (``seed=`` / ``FLAGS_fault_seed``) so
+  a chaos run replays exactly.
+
+Every fire increments ``monitor`` stat ``fault.fired.<point>`` so tests
+can assert *which* recovery path ran.  Worker processes don't share the
+parent's arm state: the DataLoader pool ships :func:`spec_for_children`
+to each worker, which re-arms via :func:`arm`.
+"""
+from __future__ import annotations
+
+import builtins
+import fnmatch
+import os
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple, Union
+
+__all__ = ["FaultInjected", "Rule", "arm", "disarm", "inject", "is_armed",
+           "point", "fire_count", "spec_for_children", "arm_from_flags"]
+
+
+class FaultInjected(RuntimeError):
+    """Default exception raised by a fired injection point."""
+
+
+# Exception names resolvable in specs without creating import cycles
+# (fs imports this module, so this module must not import fs at top).
+_EXC_HOMES = {
+    "FaultInjected": (__name__, "FaultInjected"),
+    "TransientFSError": ("paddle_tpu.utils.fs", "TransientFSError"),
+    "PermanentFSError": ("paddle_tpu.utils.fs", "PermanentFSError"),
+    "CheckpointError": ("paddle_tpu.utils.checkpoint", "CheckpointError"),
+}
+
+
+def _resolve_exc(name: Union[str, type]) -> type:
+    if isinstance(name, type):
+        return name
+    if name in _EXC_HOMES:
+        mod, attr = _EXC_HOMES[name]
+        import importlib
+        return getattr(importlib.import_module(mod), attr)
+    exc = getattr(builtins, name, None)
+    if isinstance(exc, type) and issubclass(exc, BaseException):
+        return exc
+    raise ValueError(f"fault spec: unknown exception class '{name}'")
+
+
+@dataclass
+class Rule:
+    pattern: str
+    prob: float = 1.0
+    count: Optional[int] = None      # max fires; None = unlimited
+    after: int = 0                   # skip the first N matching hits
+    exc: Union[str, type] = "FaultInjected"
+    msg: str = ""
+    match: str = ""                  # substring required in detail args
+    action: str = "raise"            # raise | exit
+    code: int = 43                   # exit status for action=exit
+    respawn: bool = False            # survive into respawned workers
+    hits: int = field(default=0, compare=False)
+    fires: int = field(default=0, compare=False)
+
+    def to_spec(self) -> str:
+        kv = []
+        if self.prob != 1.0:
+            kv.append(f"p={self.prob}")
+        if self.count is not None:
+            kv.append(f"count={self.count}")
+        if self.after:
+            kv.append(f"after={self.after}")
+        exc_name = self.exc if isinstance(self.exc, str) else \
+            self.exc.__name__
+        if exc_name != "FaultInjected":
+            kv.append(f"exc={exc_name}")
+        if self.msg:
+            kv.append(f"msg={self.msg}")
+        if self.match:
+            kv.append(f"match={self.match}")
+        if self.action != "raise":
+            kv.append(f"action={self.action}")
+        if self.code != 43:
+            kv.append(f"code={self.code}")
+        if self.respawn:
+            kv.append("respawn=1")
+        return self.pattern + (":" + ",".join(kv) if kv else "")
+
+
+def parse_spec(spec: str) -> List[Rule]:
+    rules = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            pattern, _, kvs = part.partition(":")
+            kw = {}
+            for item in kvs.split(","):
+                if not item.strip():
+                    continue
+                k, _, v = item.partition("=")
+                k = k.strip()
+                v = v.strip()
+                if k == "p":
+                    kw["prob"] = float(v)
+                elif k in ("count", "after", "code"):
+                    kw[k] = int(v)
+                elif k == "respawn":
+                    kw["respawn"] = v not in ("0", "false", "")
+                elif k in ("exc", "msg", "match", "action"):
+                    kw[k] = v
+                else:
+                    raise ValueError(f"fault spec: unknown key '{k}' in "
+                                     f"'{part}'")
+            rules.append(Rule(pattern.strip(), **kw))
+        else:
+            rules.append(Rule(part))
+    return rules
+
+
+_lock = threading.Lock()
+_armed = False          # read without the lock on the hot path
+_rules: List[Rule] = []
+_seed = 0
+_rng = random.Random(0)
+
+
+def arm(rules: Union[str, Sequence[Rule]], seed: int = 0) -> None:
+    """Arm the injector with a spec string or a list of :class:`Rule`."""
+    global _armed, _rules, _rng, _seed
+    with _lock:
+        _rules = parse_spec(rules) if isinstance(rules, str) else \
+            list(rules)
+        _seed = int(seed)
+        _rng = random.Random(_seed)
+        _armed = bool(_rules)
+
+
+def disarm() -> None:
+    global _armed, _rules
+    with _lock:
+        _armed = False
+        _rules = []
+
+
+def is_armed() -> bool:
+    return _armed
+
+
+class inject:
+    """``with fault.inject("fs.open_write:count=1"):`` — scoped arming
+    that restores the previous arm state on exit (exception or not)."""
+
+    def __init__(self, rules: Union[str, Sequence[Rule]], seed: int = 0):
+        self._rules = rules
+        self._seed = seed
+
+    def __enter__(self):
+        self._prev = (_armed, list(_rules), _seed)
+        arm(self._rules, self._seed)
+        return self
+
+    def __exit__(self, *exc_info):
+        was_armed, rules, seed = self._prev
+        if was_armed:
+            arm(rules, seed)
+        else:
+            disarm()
+        return False
+
+
+def point(name: str, *detail) -> None:
+    """A named injection site.  No-op unless the injector is armed."""
+    if not _armed:
+        return
+    _hit(name, detail)
+
+
+def _hit(name: str, detail: Tuple) -> None:
+    with _lock:
+        rule = None
+        for r in _rules:
+            if not fnmatch.fnmatchcase(name, r.pattern):
+                continue
+            if r.match and not any(r.match in str(d) for d in detail):
+                continue
+            r.hits += 1
+            if r.hits <= r.after:
+                continue
+            if r.count is not None and r.fires >= r.count:
+                continue
+            if r.prob < 1.0 and _rng.random() >= r.prob:
+                continue
+            r.fires += 1
+            rule = r
+            break
+        if rule is None:
+            return
+    from ..utils import monitor
+    monitor.stat_add(f"fault.fired.{name}")
+    msg = rule.msg or (f"injected fault at '{name}'"
+                       + (f" ({', '.join(map(str, detail))})"
+                          if detail else ""))
+    if rule.action == "exit":
+        os._exit(rule.code)
+    raise _resolve_exc(rule.exc)(msg)
+
+
+def fire_count(name: Optional[str] = None) -> int:
+    """Total fires, or fires of rules whose pattern matches ``name``."""
+    with _lock:
+        if name is None:
+            return sum(r.fires for r in _rules)
+        return sum(r.fires for r in _rules
+                   if fnmatch.fnmatchcase(name, r.pattern))
+
+
+def spec_for_children(respawn: bool = False) -> Optional[Tuple[str, int]]:
+    """Serialized ``(spec, seed)`` to re-arm a worker process, or None.
+
+    ``respawn=True`` keeps only rules marked ``respawn=1`` — by default a
+    worker-kill rule fires in the first generation of workers and the
+    respawned replacements run clean (kill-once chaos semantics).
+    """
+    with _lock:
+        if not _armed:
+            return None
+        rules = [r for r in _rules if r.respawn] if respawn else _rules
+        if not rules:
+            return None
+        return ";".join(r.to_spec() for r in rules), _seed
+
+
+def arm_from_flags() -> bool:
+    """Arm from ``FLAGS_fault_spec`` / ``FLAGS_fault_seed`` (set via
+    ``paddle_tpu.set_flags`` or the environment).  Returns armed state."""
+    from ..core import flags
+    spec = flags.get_flag("fault_spec")
+    if spec:
+        arm(spec, seed=flags.get_flag("fault_seed"))
+    return _armed
+
+
+# Environment-armed chaos (FLAGS_fault_spec=... python train.py) must work
+# before anyone imports core.flags — read the env directly at import.
+_env_spec = os.environ.get("FLAGS_fault_spec")
+if _env_spec:
+    arm(_env_spec, seed=int(os.environ.get("FLAGS_fault_seed", "0")))

@@ -11,18 +11,210 @@ atomically (tmp + rename) so a preemption mid-save can't corrupt the
 latest checkpoint.  ``checkpoint_dir`` may carry a registered filesystem
 scheme (``hdfs://...`` — utils/fs.py, reference framework/io/fs.cc), so
 fleet preemption recovery can land on a remote store.
+
+Integrity tier: every snapshot file's sha256 lands in the published
+meta and is re-verified on restore — a corrupt or missing file NEVER
+part-loads; restore falls back to the previous intact snapshot (the
+meta keeps the last ``keep_checkpoint_max``) or raises
+:class:`CheckpointError` loudly.  A SIGTERM (the TPU-pod preemption
+notice) requests a save at the next epoch boundary, publishes it, and
+exits cleanly — ``tools/chaos_smoke.py`` proves the round trip.
+Recovery events surface in ``monitor`` stats (``checkpoint.saves``,
+``checkpoint.fallbacks``, ``checkpoint.preempt_saves``).
 """
 from __future__ import annotations
 
+import hashlib
 import json
-import os
-from typing import Dict, Iterator, Optional
+import signal
+import threading
+import warnings
+from typing import Dict, Iterator, List, Optional
 
 from . import fs as _fsmod
-from ..framework_io import load as _load
-from ..framework_io import save as _save
+from . import monitor
+from ..core import flags as _flags
+from ..framework_io import dumps as _dumps
+from ..framework_io import loads as _loads
+from ..testing import fault
 
-__all__ = ["TrainEpochRange", "train_epoch_range"]
+__all__ = ["CheckpointError", "SnapshotStore", "TrainEpochRange",
+           "install_preemption_handler", "train_epoch_range"]
+
+
+class CheckpointError(RuntimeError):
+    """No intact snapshot could be restored (corrupt/missing state)."""
+
+
+def install_preemption_handler(on_term):
+    """Install a SIGTERM handler that calls ``on_term()`` then chains to
+    the previous Python handler.  Returns a ``restore()`` callable, or
+    None when installation isn't possible (non-main thread, or the
+    previous handler was installed by non-Python code — ``getsignal``
+    returns None — which we could neither chain nor restore)."""
+    if threading.current_thread() is not threading.main_thread():
+        return None
+    prev = signal.getsignal(signal.SIGTERM)
+    if prev is None:
+        return None
+
+    def _handler(signum, frame):
+        on_term()
+        # chain: give outer handlers (fleet agents) their notice too
+        if callable(prev) and prev not in (signal.SIG_IGN,
+                                           signal.SIG_DFL):
+            prev(signum, frame)
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+    except ValueError:      # non-main interpreter thread raced us
+        return None
+
+    def restore():
+        try:
+            signal.signal(signal.SIGTERM, prev)
+        except (ValueError, TypeError):
+            pass
+
+    return restore
+
+
+class SnapshotStore:
+    """Versioned, digest-verified snapshot directory.
+
+    Layout: ``<dir>/epoch_<n>/<name>.pdparams`` per registered object,
+    published atomically through ``<dir>/range_meta.json`` whose
+    ``snapshots`` list carries per-file sha256 digests.  Keeps the last
+    ``keep_max`` snapshots so a corrupt latest can fall back."""
+
+    META = "range_meta.json"
+
+    def __init__(self, directory: str, keep_max: Optional[int] = None,
+                 verify: bool = True):
+        self.dir = directory
+        self.keep_max = max(1, int(
+            keep_max if keep_max is not None
+            else _flags.get_flag("checkpoint_keep_max")))
+        self.verify = verify
+        self._fs = _fsmod.get_fs(directory)
+        self._fs.mkdir(directory)
+
+    def _join(self, *parts) -> str:
+        return "/".join([self.dir.rstrip("/")] + list(parts))
+
+    def _meta_path(self) -> str:
+        return self._join(self.META)
+
+    def load_meta(self) -> Optional[dict]:
+        try:
+            with self._fs.open_read(self._meta_path()) as f:
+                meta = json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError, RuntimeError):
+            return None
+        # v1 metas (pre-digest) carried only the latest snapshot
+        if "snapshots" not in meta:
+            meta["snapshots"] = [{"epoch": int(meta.get(
+                "finished_epoch", -1)), "dir": meta.get("snapshot", ""),
+                "digests": None}]
+        return meta
+
+    # -- save --------------------------------------------------------------
+    def save(self, epoch: int, objects: Dict[str, object]) -> None:
+        fault.point("ckpt.save", self.dir, epoch)
+        snap = f"epoch_{epoch}"
+        sdir = self._join(snap)
+        self._fs.mkdir(sdir)
+        digests = {}
+        for name, obj in objects.items():
+            payload = _dumps(obj.state_dict())
+            digests[f"{name}.pdparams"] = hashlib.sha256(
+                payload).hexdigest()
+            _fsmod.write_atomic(f"{sdir}/{name}.pdparams", payload)
+        meta = self.load_meta() or {"snapshots": []}
+        snaps = [s for s in meta["snapshots"] if s.get("dir") != snap]
+        snaps.append({"epoch": int(epoch), "dir": snap,
+                      "digests": digests})
+        snaps = snaps[-self.keep_max:]
+        meta = {"finished_epoch": int(epoch), "snapshot": snap,
+                "objects": sorted(objects), "snapshots": snaps}
+        fault.point("ckpt.publish", self.dir, epoch)
+        _fsmod.write_atomic(self._meta_path(),
+                            json.dumps(meta).encode("utf-8"))
+        monitor.stat_add("checkpoint.saves")
+        keep = {s["dir"] for s in snaps}
+        for d in self._fs.list(self.dir):
+            if d.startswith("epoch_") and d not in keep:
+                try:
+                    self._fs.remove(self._join(d))
+                except (RuntimeError, OSError):
+                    pass  # prune is best-effort (shared dirs, perms)
+
+    # -- restore -----------------------------------------------------------
+    def _read_verified(self, snap: dict,
+                       objects: Dict[str, object]) -> Optional[dict]:
+        """All payloads of one snapshot, digest-checked — or None with a
+        warning naming what failed (missing file, bad hash)."""
+        digests = snap.get("digests")
+        payloads = {}
+        for name in objects:
+            fname = f"{name}.pdparams"
+            path = self._join(snap["dir"], fname)
+            if digests is not None and fname not in digests:
+                warnings.warn(
+                    f"checkpoint {snap['dir']}: registered object "
+                    f"'{name}' was never saved in this snapshot")
+                return None
+            try:
+                with self._fs.open_read(path) as f:
+                    payload = f.read()
+            except (OSError, RuntimeError) as e:
+                warnings.warn(f"checkpoint {snap['dir']}: cannot read "
+                              f"'{fname}': {e}")
+                return None
+            if self.verify and digests is not None:
+                got = hashlib.sha256(payload).hexdigest()
+                if got != digests[fname]:
+                    warnings.warn(
+                        f"checkpoint {snap['dir']}: sha256 mismatch for "
+                        f"'{fname}' (stored {digests[fname][:12]}…, "
+                        f"recomputed {got[:12]}…)")
+                    return None
+            payloads[name] = payload
+        return payloads
+
+    def restore(self, objects: Dict[str, object]) -> int:
+        """Load the newest intact snapshot into ``objects`` and return
+        the next epoch to run.  Falls back across the retained history;
+        raises :class:`CheckpointError` when a checkpoint exists but no
+        snapshot verifies — never resumes half-initialized."""
+        meta = self.load_meta()
+        if meta is None:
+            return 0
+        attempts = []
+        for snap in reversed(meta["snapshots"]):
+            fault.point("ckpt.restore", self.dir, snap.get("dir"))
+            payloads = self._read_verified(snap, objects)
+            if payloads is None:
+                attempts.append(str(snap.get("dir")))
+                monitor.stat_add("checkpoint.fallbacks")
+                continue
+            # decode everything BEFORE applying anything: a corrupt
+            # payload that slipped past hashing still can't part-load
+            states = {name: _loads(p, source=f"{snap['dir']}/{name}")
+                      for name, p in payloads.items()}
+            for name, obj in objects.items():
+                obj.set_state_dict(states[name])
+            if attempts:
+                warnings.warn(
+                    f"checkpoint: snapshot(s) {attempts} failed "
+                    f"verification; resumed from older intact "
+                    f"'{snap['dir']}' (epoch {snap['epoch']})")
+            monitor.stat_add("checkpoint.restores")
+            return int(snap["epoch"]) + 1
+        raise CheckpointError(
+            f"checkpoint dir '{self.dir}' has a published meta but no "
+            f"intact snapshot (tried {attempts}); refusing to resume "
+            f"half-initialized — delete the dir to restart from scratch")
 
 
 class TrainEpochRange:
@@ -33,16 +225,29 @@ class TrainEpochRange:
         r = TrainEpochRange(10, "ckpt/run1", model=model, opt=opt)
         for epoch in r:          # resumes after the last finished epoch
             train_one_epoch(...)
-    """
+
+    ``keep_checkpoint_max`` snapshots are retained (default
+    ``FLAGS_checkpoint_keep_max``); restore verifies sha256 digests and
+    falls back across them.  While iterating (main thread), SIGTERM —
+    the cloud-TPU preemption notice — requests a snapshot at the next
+    epoch boundary, publishes it, then exits via ``SystemExit(0)``
+    (disable with ``handle_preemption=False``)."""
 
     def __init__(self, max_epoch_num: int, checkpoint_dir: str,
-                 save_checkpoint_inter: int = 1, **objects):
+                 save_checkpoint_inter: int = 1,
+                 keep_checkpoint_max: Optional[int] = None,
+                 verify: bool = True, handle_preemption: bool = True,
+                 **objects):
         self.max_epoch = int(max_epoch_num)
         self.dir = checkpoint_dir
         self.interval = max(1, int(save_checkpoint_inter))
+        self.handle_preemption = handle_preemption
         self._objects: Dict[str, object] = dict(objects)
-        self._fs = _fsmod.get_fs(checkpoint_dir)
-        self._fs.mkdir(self.dir)
+        self._store = SnapshotStore(checkpoint_dir,
+                                    keep_max=keep_checkpoint_max,
+                                    verify=verify)
+        self._fs = self._store._fs
+        self._preempted = threading.Event()
 
     def register(self, name: str, obj):
         """Add a state_dict-bearing object to the snapshot set."""
@@ -50,62 +255,44 @@ class TrainEpochRange:
         return self
 
     # -- persistence -------------------------------------------------------
-    def _join(self, *parts):
-        return "/".join([self.dir.rstrip("/")] + list(parts))
-
-    def _meta_path(self):
-        return self._join("range_meta.json")
-
-    def _load_meta(self) -> Optional[dict]:
-        try:
-            with self._fs.open_read(self._meta_path()) as f:
-                return json.loads(f.read().decode("utf-8"))
-        except (OSError, ValueError, RuntimeError):
-            return None
-
     def _save(self, epoch: int):
-        # stage the WHOLE snapshot in an epoch directory, then publish it
-        # atomically through the meta: a preemption at any point leaves
-        # either the previous complete snapshot or the new complete one —
-        # never a mixed-epoch state
-        snap = f"epoch_{epoch}"
-        sdir = self._join(snap)
-        self._fs.mkdir(sdir)
-        for name, obj in self._objects.items():
-            _save(obj.state_dict(), f"{sdir}/{name}.pdparams")
-        tmp = self._meta_path() + ".tmp"
-        with self._fs.open_write(tmp) as f:
-            f.write(json.dumps(
-                {"finished_epoch": epoch, "snapshot": snap,
-                 "objects": sorted(self._objects)}).encode("utf-8"))
-        self._fs.mv(tmp, self._meta_path())  # atomic publish
-        # prune superseded snapshots
-        for d in self._fs.list(self.dir):
-            if d.startswith("epoch_") and d != snap:
-                try:
-                    self._fs.remove(self._join(d))
-                except (RuntimeError, OSError):
-                    pass  # prune is best-effort (shared dirs, perms)
+        self._store.save(epoch, self._objects)
 
     def _restore(self) -> int:
-        meta = self._load_meta()
-        if meta is None:
-            return 0
-        sdir = self._join(meta.get("snapshot", ""))
-        for name, obj in self._objects.items():
-            path = f"{sdir}/{name}.pdparams"
-            if self._fs.exists(path):
-                obj.set_state_dict(_load(path))
-        return int(meta.get("finished_epoch", -1)) + 1
+        return self._store.restore(self._objects)
+
+    def _load_meta(self) -> Optional[dict]:
+        return self._store.load_meta()
+
+    # -- preemption --------------------------------------------------------
+    @property
+    def preempted(self) -> bool:
+        """True once a SIGTERM asked for a boundary save + clean exit."""
+        return self._preempted.is_set()
+
+    def _on_preempt(self):
+        self._preempted.set()
+        monitor.stat_add("checkpoint.preempt_requests")
 
     # -- iteration ---------------------------------------------------------
     def __iter__(self) -> Iterator[int]:
         start = self._restore()
-        for epoch in range(start, self.max_epoch):
-            yield epoch
-            # body finished without raising: snapshot this epoch
-            if (epoch + 1) % self.interval == 0 or epoch == self.max_epoch - 1:
-                self._save(epoch)
+        restore_handler = (install_preemption_handler(self._on_preempt)
+                           if self.handle_preemption else None)
+        try:
+            for epoch in range(start, self.max_epoch):
+                yield epoch
+                # body finished without raising: snapshot this epoch
+                if (self._preempted.is_set()
+                        or (epoch + 1) % self.interval == 0
+                        or epoch == self.max_epoch - 1):
+                    self._save(epoch)
+                if self._preempted.is_set():
+                    monitor.stat_add("checkpoint.preempt_saves")
+                    raise SystemExit(0)
+        finally:
+            if restore_handler is not None:
+                restore_handler()
 
     @property
     def next_epoch(self) -> int:

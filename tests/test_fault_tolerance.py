@@ -1,0 +1,632 @@
+"""Fault-injected robustness: retrying fs, verified checkpoints,
+self-healing DataLoader, preemption-safe training.
+
+Reference analogs: framework/io/fs.cc (hdfs retries),
+fluid/incubate/checkpoint/auto_checkpoint.py (resume),
+fluid/reader.py:91-149 (SIGCHLD worker death handling).  Every recovery
+path here is driven by paddle_tpu.testing.fault — deterministic chaos,
+not hope."""
+import json
+import os
+import signal
+import stat as stat_mod
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.testing import fault
+from paddle_tpu.utils import fs, monitor
+from paddle_tpu.utils.checkpoint import (CheckpointError, SnapshotStore,
+                                         TrainEpochRange)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    """Every test starts disarmed with zeroed stats and fast backoff."""
+    fault.disarm()
+    monitor.stat_reset()
+    old = paddle.get_flags(["fs_retry_backoff_s", "fs_retry_times",
+                            "fs_retry_deadline_s",
+                            "dataloader_batch_retries"])
+    paddle.set_flags({"fs_retry_backoff_s": 0.001})
+    yield
+    fault.disarm()
+    paddle.set_flags(old)
+
+
+# -- fault framework ---------------------------------------------------------
+
+def test_disarmed_point_is_noop_and_adds_no_stats():
+    fault.point("fs.open_write", "/nowhere")
+    fault.point("anything.at.all")
+    assert not fault.is_armed()
+    assert not any(k.startswith("fault.") for k in monitor.all_stats())
+
+
+def test_spec_parse_count_match_exc_and_fire_stats():
+    with fault.inject("fs.mv:count=2,exc=OSError,match=special"):
+        f = fs.LocalFS()
+        # detail doesn't contain 'special': no fire
+        tmp = "/tmp/_ft_a"
+        open(tmp, "wb").close()
+        f.mv(tmp, "/tmp/_ft_b")
+        with pytest.raises(OSError, match="injected fault"):
+            open("/tmp/_ft_special", "wb").close()
+            f.mv("/tmp/_ft_special", "/tmp/_ft_special2")
+        assert fault.fire_count("fs.mv") == 1
+    assert monitor.get_stat("fault.fired.fs.mv") == 1
+    assert not fault.is_armed()          # inject() restored disarmed
+
+
+def test_probability_is_seed_deterministic():
+    def run(seed):
+        fired = []
+        with fault.inject("p.x:p=0.5", seed=seed):
+            for _ in range(32):
+                try:
+                    fault.point("p.x")
+                    fired.append(0)
+                except fault.FaultInjected:
+                    fired.append(1)
+        return fired
+    a, b, c = run(11), run(11), run(12)
+    assert a == b                        # same seed -> same chaos
+    assert a != c                        # different seed -> different
+    assert 0 < sum(a) < 32               # actually probabilistic
+
+
+def test_arm_from_flags_roundtrip():
+    paddle.set_flags({"fault_spec": "flag.pt:count=1", "fault_seed": 3})
+    try:
+        assert fault.arm_from_flags()
+        with pytest.raises(fault.FaultInjected):
+            fault.point("flag.pt")
+        fault.point("flag.pt")           # count exhausted
+    finally:
+        paddle.set_flags({"fault_spec": ""})
+        fault.disarm()
+
+
+# -- fs retry/backoff --------------------------------------------------------
+
+def test_fs_flake_is_retried_then_succeeds(tmp_path):
+    rfs = fs.RetryingFS(fs.LocalFS())
+    p = str(tmp_path / "x.bin")
+    with fault.inject("fs.open_write:count=2,exc=TransientFSError"):
+        with rfs.open_write(p) as f:
+            f.write(b"payload")
+    assert open(p, "rb").read() == b"payload"
+    assert monitor.get_stat("fs.retries") == 2
+    assert monitor.get_stat("fs.gave_up") == 0
+
+
+def test_exhausted_retries_surface_classified_error(tmp_path):
+    paddle.set_flags({"fs_retry_times": 3})
+    rfs = fs.RetryingFS(fs.LocalFS())
+    with fault.inject("fs.open_write:exc=TransientFSError"):
+        with pytest.raises(fs.TransientFSError):
+            rfs.open_write(str(tmp_path / "y.bin"))
+    assert monitor.get_stat("fs.retries") == 2   # attempts 1+2 retried
+    assert monitor.get_stat("fs.gave_up") == 1
+
+
+def test_permanent_error_is_not_retried(tmp_path):
+    rfs = fs.RetryingFS(fs.LocalFS())
+    with fault.inject("fs.open_read:exc=PermanentFSError"):
+        with pytest.raises(fs.PermanentFSError):
+            rfs.open_read(str(tmp_path / "absent.bin"))
+    assert monitor.get_stat("fs.retries") == 0
+
+
+def test_retry_deadline_bounds_wall_clock(tmp_path):
+    paddle.set_flags({"fs_retry_times": 1000, "fs_retry_deadline_s": 0.2,
+                      "fs_retry_backoff_s": 0.05})
+    rfs = fs.RetryingFS(fs.LocalFS())
+    t0 = time.monotonic()
+    with fault.inject("fs.open_write:exc=TransientFSError"):
+        with pytest.raises(fs.TransientFSError):
+            rfs.open_write(str(tmp_path / "z.bin"))
+    assert time.monotonic() - t0 < 5.0
+    assert monitor.get_stat("fs.gave_up") == 1
+
+
+def test_retrying_decorator():
+    calls = []
+
+    @fs.retrying("flaky_op")
+    def flaky(x):
+        calls.append(x)
+        if len(calls) < 3:
+            raise fs.TransientFSError("blip")
+        return x * 2
+
+    assert flaky(21) == 42
+    assert len(calls) == 3
+    assert monitor.get_stat("fs.retries") == 2
+
+
+def test_error_classification():
+    assert fs.is_transient(fs.TransientFSError("x"))
+    assert not fs.is_transient(fs.PermanentFSError("x"))
+    assert not fs.is_transient(FileNotFoundError("x"))
+    assert not fs.is_transient(PermissionError("x"))
+    assert fs.is_transient(ConnectionResetError("x"))
+    assert fs.is_transient(TimeoutError("x"))
+    assert not fs.is_transient(ValueError("x"))
+
+
+# -- ShellFS against a fake hadoop CLI --------------------------------------
+
+_FAKE_HADOOP = r"""#!/usr/bin/env bash
+# fake 'hadoop fs' CLI backed by $FAKE_HDFS_ROOT; transient-failure
+# injection: while .flake_count > 0 every call fails like a net blip
+set -u
+root="${FAKE_HDFS_ROOT:?}"
+flake="$root/.flake_count"
+if [ -f "$flake" ]; then
+  n=$(cat "$flake")
+  if [ "$n" -gt 0 ]; then
+    echo $((n-1)) > "$flake"
+    echo "java.net.ConnectException: Connection refused" >&2
+    exit 255
+  fi
+fi
+shift                       # 'fs'
+verb="$1"; shift
+map() { local p="${1#*://}"; echo "$root/$p"; }
+case "$verb" in
+  -cat)   cat "$(map "$1")" 2>/dev/null || {
+            echo "cat: No such file or directory: $1" >&2; exit 1; };;
+  -put)   shift; shift       # -f -
+          dst="$(map "$1")"; mkdir -p "$(dirname "$dst")"; cat > "$dst";;
+  -test)  [ -e "$(map "$2")" ];;
+  -mkdir) mkdir -p "$(map "$2")";;
+  -rm)    for last; do :; done; rm -rf "$(map "$last")";;
+  -ls)    p="$(map "$1")"
+          for f in "$p"/*; do
+            [ -e "$f" ] || continue
+            echo "-rw-r--r-- 1 u g 0 2024-01-01 00:00 hdfs://f/$(basename "$f")"
+          done;;
+  -mv)    mv "$(map "$1")" "$(map "$2")" || exit 1
+          # chaos knob: rename COMMITS, then the client sees a timeout
+          if [ -f "$root/.mv_commit_fail" ]; then
+            rm -f "$root/.mv_commit_fail"
+            echo "java.net.SocketTimeoutException: timed out" >&2
+            exit 255
+          fi;;
+  *)      echo "unknown verb $verb" >&2; exit 2;;
+esac
+"""
+
+
+@pytest.fixture()
+def fake_hadoop(tmp_path, monkeypatch):
+    root = tmp_path / "hdfs_root"
+    root.mkdir()
+    cli = tmp_path / "hadoop"
+    cli.write_text(_FAKE_HADOOP)
+    cli.chmod(cli.stat().st_mode | stat_mod.S_IEXEC)
+    monkeypatch.setenv("FAKE_HDFS_ROOT", str(root))
+    return fs.ShellFS(str(cli)), root
+
+
+def test_shellfs_write_read_exists_list_mv(fake_hadoop):
+    sfs, root = fake_hadoop
+    with sfs.open_write("hdfs://job/a.bin") as f:
+        f.write(b"hello hdfs")
+    assert (root / "job" / "a.bin").read_bytes() == b"hello hdfs"
+    assert sfs.exists("hdfs://job/a.bin")
+    assert not sfs.exists("hdfs://job/missing.bin")
+    with sfs.open_read("hdfs://job/a.bin") as f:
+        assert f.read() == b"hello hdfs"
+    sfs.mkdir("hdfs://job/sub")
+    assert sfs.list("hdfs://job") == ["a.bin", "sub"]
+    sfs.mv("hdfs://job/a.bin", "hdfs://job/b.bin")
+    assert sfs.list("hdfs://job") == ["b.bin", "sub"]
+    sfs.remove("hdfs://job/b.bin")
+    assert not sfs.exists("hdfs://job/b.bin")
+
+
+def test_shellfs_transient_cli_failure_is_retried(fake_hadoop):
+    sfs, root = fake_hadoop
+    with sfs.open_write("hdfs://r/x.bin") as f:
+        f.write(b"v1")
+    (root / ".flake_count").write_text("2")   # next 2 calls: net blip
+    with sfs.open_read("hdfs://r/x.bin") as f:
+        assert f.read() == b"v1"
+    assert monitor.get_stat("fs.retries") == 2
+
+
+def test_shellfs_gives_up_after_budget(fake_hadoop):
+    sfs, root = fake_hadoop
+    paddle.set_flags({"fs_retry_times": 2})
+    (root / ".flake_count").write_text("99")
+    with pytest.raises(fs.TransientFSError, match="Connection refused"):
+        sfs.open_read("hdfs://r/x.bin")
+    assert monitor.get_stat("fs.gave_up") == 1
+
+
+def test_shellfs_missing_file_is_permanent_not_retried(fake_hadoop):
+    sfs, _ = fake_hadoop
+    with pytest.raises(fs.PermanentFSError, match="No such file"):
+        sfs.open_read("hdfs://r/never_written.bin")
+    assert monitor.get_stat("fs.retries") == 0
+
+
+def test_shellfs_mv_commit_then_timeout_is_success(fake_hadoop):
+    """Rename is not idempotent: when the CLI times out AFTER the
+    server-side rename committed, the retry sees 'no such file' — mv
+    must verify the outcome instead of reporting a failed publish."""
+    sfs, root = fake_hadoop
+    with sfs.open_write("hdfs://j/meta.tmp") as f:
+        f.write(b"meta")
+    (root / ".mv_commit_fail").write_text("")
+    sfs.mv("hdfs://j/meta.tmp", "hdfs://j/meta.json")
+    assert sfs.exists("hdfs://j/meta.json")
+    assert not sfs.exists("hdfs://j/meta.tmp")
+
+
+def test_save_load_roundtrip_through_fake_hdfs(fake_hadoop, monkeypatch):
+    sfs, _ = fake_hadoop
+    fs.register_fs("fakehdfs", sfs)
+    try:
+        sd = {"w": paddle.ones([3, 2])}
+        paddle.save(sd, "fakehdfs://m/model.pdparams")
+        out = paddle.load("fakehdfs://m/model.pdparams")
+        np.testing.assert_allclose(np.asarray(out["w"].data), 1.0)
+    finally:
+        fs._REGISTRY.pop("fakehdfs", None)
+
+
+# -- atomic paddle.save ------------------------------------------------------
+
+def test_save_is_atomic_crash_leaves_no_truncated_file(tmp_path):
+    p = str(tmp_path / "m.pdparams")
+    paddle.save({"a": paddle.ones([2])}, p)
+    v1 = open(p, "rb").read()
+    # crash at the publish rename: the old artifact must survive intact
+    with fault.inject("fs.mv:count=1"):
+        with pytest.raises(fault.FaultInjected):
+            paddle.save({"a": paddle.zeros([64, 64])}, p)
+    assert open(p, "rb").read() == v1
+    leftovers = [f for f in os.listdir(tmp_path) if ".tmp" in f]
+    assert leftovers == []               # staging file cleaned up
+    out = paddle.load(p)
+    np.testing.assert_allclose(np.asarray(out["a"].data), 1.0)
+
+
+def test_save_crash_before_write_leaves_nothing(tmp_path):
+    p = str(tmp_path / "fresh.pdparams")
+    with fault.inject("fs.open_write:count=1"):
+        with pytest.raises(fault.FaultInjected):
+            paddle.save({"a": paddle.ones([2])}, p)
+    assert not os.path.exists(p)
+
+
+# -- checkpoint integrity ----------------------------------------------------
+
+def _mk(seed):
+    paddle.seed(seed)
+    net = nn.Linear(2, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    return net, opt
+
+
+def _run_epochs(d, n_stop, total=6, seed=0, **range_kw):
+    """Train; a break DURING epoch ``n_stop`` simulates preemption, so
+    the last published snapshot is epoch ``n_stop - 1``."""
+    net, opt = _mk(seed)
+    r = TrainEpochRange(total, d, model=net, opt=opt, **range_kw)
+    seen = []
+    for e in r:
+        seen.append(e)
+        net.weight.data = net.weight.data + 1.0
+        if e == n_stop:
+            break
+    return net, seen
+
+
+def test_meta_publishes_digests_and_keeps_k_snapshots(tmp_path):
+    d = str(tmp_path / "acp")
+    _run_epochs(d, 3, keep_checkpoint_max=2)
+    meta = json.load(open(os.path.join(d, "range_meta.json")))
+    snaps = meta["snapshots"]
+    assert [s["epoch"] for s in snaps] == [1, 2]
+    for s in snaps:
+        assert set(s["digests"]) == {"model.pdparams", "opt.pdparams"}
+        for h in s["digests"].values():
+            assert len(h) == 64          # sha256 hex
+    # pruned dirs are gone, retained dirs exist
+    dirs = sorted(x for x in os.listdir(d) if x.startswith("epoch_"))
+    assert dirs == ["epoch_1", "epoch_2"]
+
+
+def test_corrupt_latest_falls_back_to_previous_intact(tmp_path):
+    d = str(tmp_path / "acp")
+    _run_epochs(d, 2, keep_checkpoint_max=3)      # published: 0 and 1
+    with open(os.path.join(d, "epoch_1", "model.pdparams"), "r+b") as f:
+        f.write(b"GARBAGE!")
+    net2, opt2 = _mk(99)
+    with pytest.warns(UserWarning, match="sha256 mismatch"):
+        r = TrainEpochRange(6, d, model=net2, opt=opt2)
+        resumed = next(iter(r))
+    assert resumed == 1                  # epoch_0 intact -> resume at 1
+    assert monitor.get_stat("checkpoint.fallbacks") == 1
+    assert monitor.get_stat("checkpoint.restores") == 1
+
+
+def test_missing_snapshot_file_never_part_loads(tmp_path):
+    """Regression: _restore used to silently skip missing state files
+    and resume half-initialized (mixed-epoch state)."""
+    d = str(tmp_path / "acp")
+    _run_epochs(d, 1, keep_checkpoint_max=1)      # published: epoch_0
+    os.remove(os.path.join(d, "epoch_0", "opt.pdparams"))
+    net2, opt2 = _mk(99)
+    w_before = net2.weight.numpy().copy()
+    with pytest.raises(CheckpointError, match="no intact snapshot"):
+        with pytest.warns(UserWarning):
+            list(TrainEpochRange(6, d, model=net2, opt=opt2))
+    # nothing was applied to the registered objects
+    np.testing.assert_array_equal(net2.weight.numpy(), w_before)
+
+
+def test_object_registered_but_never_saved_is_loud(tmp_path):
+    d = str(tmp_path / "acp")
+    _run_epochs(d, 1, keep_checkpoint_max=1)
+    net2, opt2 = _mk(99)
+    extra = nn.Linear(2, 2)
+    r = TrainEpochRange(6, d, model=net2, opt=opt2)
+    r.register("ema", extra)             # snapshot never contained 'ema'
+    with pytest.raises(CheckpointError):
+        with pytest.warns(UserWarning, match="never saved"):
+            list(r)
+
+
+def test_v1_meta_without_digests_still_restores(tmp_path):
+    d = str(tmp_path / "acp")
+    # run epochs 0..1 to completion: epoch_1 is the published snapshot
+    net, seen = _run_epochs(d, 99, total=2, keep_checkpoint_max=1)
+    assert seen == [0, 1]
+    w = net.weight.numpy().copy()
+    meta_p = os.path.join(d, "range_meta.json")
+    # rewrite as a pre-digest v1 meta
+    json.dump({"finished_epoch": 1, "snapshot": "epoch_1",
+               "objects": ["model", "opt"]}, open(meta_p, "w"))
+    net2, opt2 = _mk(99)
+    r = TrainEpochRange(6, d, model=net2, opt=opt2)
+    assert next(iter(r)) == 2
+    np.testing.assert_array_equal(net2.weight.numpy(), w)
+
+
+def test_sigterm_saves_at_boundary_and_fresh_range_resumes(tmp_path):
+    d = str(tmp_path / "acp")
+    net, opt = _mk(0)
+    r = TrainEpochRange(6, d, save_checkpoint_inter=10, model=net,
+                        opt=opt)
+    done = []
+    with pytest.raises(SystemExit) as ei:
+        for e in r:
+            done.append(e)
+            net.weight.data = net.weight.data + 1.0
+            if e == 1:
+                os.kill(os.getpid(), signal.SIGTERM)
+            # body continues: the save happens at the epoch BOUNDARY
+    assert ei.value.code == 0
+    assert done == [0, 1] and r.preempted
+    assert monitor.get_stat("checkpoint.preempt_saves") == 1
+    w_saved = net.weight.numpy().copy()
+
+    # a fresh range restores exactly the preemption snapshot...
+    net2, opt2 = _mk(99)
+    r2 = TrainEpochRange(6, d, model=net2, opt=opt2)
+    it = iter(r2)
+    assert next(it) == 2                 # ...and resumes at epoch 2
+    np.testing.assert_array_equal(net2.weight.numpy(), w_saved)
+    it.close()
+
+
+def test_sigterm_handler_restored_after_iteration(tmp_path):
+    prev = signal.getsignal(signal.SIGTERM)
+    net, opt = _mk(0)
+    list(TrainEpochRange(2, str(tmp_path / "acp"), model=net, opt=opt))
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+# -- self-healing DataLoader -------------------------------------------------
+
+class _ArangeDs(Dataset):
+    def __init__(self, n=64, d=4):
+        self.x = np.arange(n * d, dtype=np.float32).reshape(n, d)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], np.int64(i)
+
+
+class _SleepyDs(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        time.sleep(120)
+        return np.zeros(2, np.float32)
+
+
+def test_killed_worker_respawned_every_batch_once_in_order():
+    ds = _ArangeDs()
+    # whichever worker picks up batch 3 hard-exits (matching on the
+    # batch, not the worker id, is start-order independent)
+    fault.arm("mp.worker_batch:count=1,action=exit,code=43,"
+              "match=batch=3", seed=0)
+    try:
+        loader = DataLoader(ds, batch_size=8, num_workers=2,
+                            use_shared_memory=True)
+        out = [np.asarray(i.data) for _, i in loader]
+    finally:
+        fault.disarm()
+    ids = np.concatenate(out)
+    assert list(ids) == list(range(64))  # exactly once, in order
+    assert monitor.get_stat("dataloader.worker_restarts") >= 1
+    assert monitor.get_stat("dataloader.batch_retries") >= 1
+    assert any(code == 43 for _, code in loader._mp_pool.exit_history)
+    # healed pool serves the next epoch clean
+    assert len(list(loader)) == 8
+    loader._mp_pool.close()
+
+
+def test_batch_that_keeps_killing_workers_exhausts_budget():
+    ds = _ArangeDs(n=16)
+    paddle.set_flags({"dataloader_batch_retries": 1})
+    # respawn=1: replacement workers keep the kill rule -> batch 0 can
+    # never survive -> budget exhausted -> loud failure w/ exit codes
+    fault.arm("mp.worker_batch:action=exit,code=9,respawn=1", seed=0)
+    try:
+        loader = DataLoader(ds, batch_size=8, num_workers=1,
+                            use_shared_memory=True)
+        with pytest.raises(RuntimeError,
+                           match="worker-death retries.*exit codes"):
+            list(loader)
+    finally:
+        fault.disarm()
+    assert monitor.get_stat("dataloader.worker_restarts") >= 1
+
+
+def test_dataloader_timeout_configurable_and_diagnostic():
+    ds = _SleepyDs()
+    loader = DataLoader(ds, batch_size=2, num_workers=1,
+                        use_shared_memory=True, timeout=2)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="stalled.*alive"):
+        list(loader)
+    assert time.monotonic() - t0 < 60
+
+
+def test_dataloader_timeout_flag_thread_path():
+    ds = _SleepyDs()
+    paddle.set_flags({"dataloader_timeout": 1})
+    try:
+        loader = DataLoader(ds, batch_size=2, num_workers=1,
+                            use_shared_memory=False)
+        with pytest.raises(RuntimeError, match="stalled"):
+            list(loader)
+    finally:
+        paddle.set_flags({"dataloader_timeout": 120})
+
+
+# -- Checkpoint callback (Model.fit) ----------------------------------------
+
+def test_checkpoint_callback_releases_sigterm_handler_on_crash(tmp_path):
+    """A fit() that raises mid-training must not leave the preemption
+    handler installed (it would swallow SIGTERM forever)."""
+    from paddle_tpu.hapi import Checkpoint, Model
+    prev = signal.getsignal(signal.SIGTERM)
+    net = nn.Linear(4, 1)
+    m = Model(net)
+
+    def exploding_loss(out, label):
+        raise ZeroDivisionError("boom")
+
+    m.prepare(optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters()),
+              loss=exploding_loss, jit_compile=False)
+    x = np.zeros((4, 4), np.float32)
+    y = np.zeros((4, 1), np.float32)
+    with pytest.raises(ZeroDivisionError):
+        m.fit([(x, y)], epochs=1, verbose=0,
+              callbacks=[Checkpoint(str(tmp_path / "crash_ckpt"))])
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+def test_checkpoint_callback_saves_restores_and_rotates(tmp_path):
+    from paddle_tpu.hapi import Checkpoint, Model
+    import paddle_tpu.nn.functional as F
+    d = str(tmp_path / "fit_ckpt")
+    paddle.seed(5)
+    x = np.random.randn(16, 4).astype(np.float32)
+    y = np.random.randn(16, 1).astype(np.float32)
+
+    def make_model():
+        paddle.seed(6)
+        net = nn.Linear(4, 1)
+        m = Model(net)
+        m.prepare(optimizer.SGD(learning_rate=0.05,
+                                parameters=net.parameters()),
+                  loss=F.mse_loss, jit_compile=False)
+        return m
+
+    m1 = make_model()
+    cb = Checkpoint(d, keep_checkpoint_max=2)
+    m1.fit(list(zip(x.reshape(4, 4, 4), y.reshape(4, 4, 1))), epochs=3,
+           verbose=0, callbacks=[cb])
+    w_trained = m1.network.weight.numpy().copy()
+    meta = json.load(open(os.path.join(d, "range_meta.json")))
+    assert [s["epoch"] for s in meta["snapshots"]] == [1, 2]
+
+    # a fresh Model auto-restores the published weights on fit begin
+    m2 = make_model()
+    cb2 = Checkpoint(d)
+    cb2.set_model(m2)
+    cb2.on_train_begin()
+    np.testing.assert_array_equal(m2.network.weight.numpy(), w_trained)
+    assert cb2.last_restored_epoch == 2
+    cb2.on_train_end()
+
+
+# -- executor injection point ------------------------------------------------
+
+def test_executor_run_fault_point():
+    """A fault spec can crash a training step on demand — the drill for
+    'preemption mid-step' around the checkpoint/restore path."""
+    paddle.enable_static()
+    try:
+        with paddle.static.program_guard(paddle.static.Program()) as main:
+            x = paddle.static.data("x", [None, 2], "float32")
+            out = x.sum(axis=1)
+        exe = paddle.static.Executor()
+        arr = np.ones((2, 2), np.float32)
+        with fault.inject("executor.run:count=1"):
+            with pytest.raises(fault.FaultInjected):
+                exe.run(main, feed={"x": arr}, fetch_list=[out])
+            # next step (count exhausted) runs fine
+            res, = exe.run(main, feed={"x": arr}, fetch_list=[out])
+        np.testing.assert_allclose(res, [2.0, 2.0])
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+# -- recovery events + chaos smoke ------------------------------------------
+
+def test_recovery_events_visible_in_monitor(tmp_path):
+    rfs = fs.RetryingFS(fs.LocalFS())
+    with fault.inject("fs.open_write:count=1,exc=TransientFSError"):
+        with rfs.open_write(str(tmp_path / "a")) as f:
+            f.write(b"x")
+    _run_epochs(str(tmp_path / "acp"), 1)
+    stats = monitor.all_stats()
+    assert stats["fs.retries"] == 1
+    assert stats["checkpoint.saves"] >= 1
+    assert stats["fault.fired.fs.open_write"] == 1
+
+
+def test_clean_run_has_no_fault_or_recovery_noise(tmp_path):
+    _run_epochs(str(tmp_path / "acp"), 1)          # disarmed, healthy
+    net2, opt2 = _mk(1)
+    list(TrainEpochRange(3, str(tmp_path / "acp"), model=net2, opt=opt2))
+    stats = monitor.all_stats()
+    assert not any(k.startswith("fault.") for k in stats)
+    assert stats.get("fs.retries", 0) == 0
+    assert stats.get("checkpoint.fallbacks", 0) == 0
+    assert stats.get("dataloader.worker_restarts", 0) == 0
+
+
+def test_chaos_smoke_in_process(tmp_path):
+    from paddle_tpu.testing import chaos
+    assert chaos.main(epochs=3, workdir=str(tmp_path / "smoke")) == 0

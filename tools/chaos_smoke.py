@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Chaos smoke test: preemption-safe training under injected faults.
+
+Runs :func:`paddle_tpu.testing.chaos.main` — a tiny train loop twice
+(fault-free vs under the canned chaos spec: checkpoint-fs write flakes,
+one DataLoader worker hard-killed mid-epoch, SIGTERM mid-training) —
+and exits non-zero unless the faulted run resumes to completion with
+bitwise-identical final parameters.
+
+Usage::
+
+    python tools/chaos_smoke.py [--epochs 4] [--verbose]
+
+CI treats a non-zero exit as a robustness regression.  The same flow
+runs in-process from tests/test_fault_tolerance.py.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    from paddle_tpu.testing import chaos
+    return chaos.main(epochs=args.epochs, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
