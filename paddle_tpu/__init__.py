@@ -64,6 +64,7 @@ from . import distributed  # noqa: E402
 from . import parallel  # noqa: E402
 from . import static  # noqa: E402
 from . import inference  # noqa: E402
+from . import serving  # noqa: E402
 from . import profiler  # noqa: E402
 from . import utils  # noqa: E402
 from . import quantization  # noqa: E402

@@ -141,6 +141,16 @@ define_flag("dataloader_batch_retries", 3,
 define_flag("checkpoint_keep_max", 2,
             "Snapshots retained per checkpoint dir (keep_checkpoint_max; "
             ">=2 keeps a fallback for corrupt-latest recovery).")
+define_flag("inference_pad_policy", "bucket",
+            "Predictor.run on a batch size with no compiled variant: "
+            "'bucket' pads the leading dim to the smallest compiled/"
+            "declared bucket (next power of two when none fits) and "
+            "slices outputs back — zero recompiles after warmup; 'none' "
+            "compiles a fresh variant per batch size (legacy).")
+define_flag("serving_dispatch_retries", 2,
+            "InferenceEngine: batch dispatch attempts after a failure "
+            "before the batch's requests are failed (inference is pure, "
+            "so a flaked dispatch is safely retried).")
 define_flag("pallas_attention_dropout_min_seqlen", 512,
             "Flash threshold when attention dropout is active: the XLA "
             "path must materialize [B,H,L,L] dropout masks in HBM, so "

@@ -19,9 +19,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import flags
 from ..jit.save_load import SUFFIX_MODEL, SUFFIX_PARAMS
+from ..utils import monitor
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor"]
+
+_PAD_POLICIES = ("bucket", "none")
 
 
 class Config:
@@ -40,6 +44,7 @@ class Config:
         self.params_file = params_file
         self._shape_buckets: List[Tuple[Tuple[int, ...], ...]] = []
         self._aot_on_load = True
+        self._pad_policy: Optional[str] = None  # None -> FLAGS default
         # parity no-ops (XLA owns these decisions on TPU)
         self._flags: Dict[str, object] = {}
 
@@ -57,6 +62,28 @@ class Config:
 
     def disable_aot_compile(self):
         self._aot_on_load = False
+
+    def set_batch_pad_policy(self, policy: str):
+        """What ``Predictor.run`` does with a batch size that has no
+        compiled variant:
+
+        - ``"bucket"`` (default, ``FLAGS_inference_pad_policy``): pad the
+          leading dim up to the smallest compiled/declared bucket that
+          fits — or the next power of two when none fits — run the padded
+          batch, and slice the outputs back.  After warmup the hot path
+          never recompiles; padded runs count ``inference.pad_hits``.
+          Assumes row-independent models (standard for inference nets;
+          a cross-batch reduction would see the zero pad rows).
+        - ``"none"``: the legacy behavior — compile a fresh variant per
+          distinct batch size.
+        """
+        if policy not in _PAD_POLICIES:
+            raise ValueError(f"batch pad policy must be one of "
+                             f"{_PAD_POLICIES}, got {policy!r}")
+        self._pad_policy = policy
+
+    def batch_pad_policy(self) -> str:
+        return self._pad_policy or flags.get_flag("inference_pad_policy")
 
     # -- accepted-for-parity switches -------------------------------------
     def enable_use_gpu(self, *a, **k):
@@ -79,7 +106,9 @@ class Config:
 
     def summary(self) -> str:
         return (f"Config(prog_file={self.prog_file}, "
-                f"buckets={len(self._shape_buckets)}, flags={self._flags})")
+                f"buckets={len(self._shape_buckets)}, "
+                f"pad_policy={self.batch_pad_policy()}, "
+                f"flags={self._flags})")
 
 
 class Tensor:
@@ -154,15 +183,69 @@ class Predictor:
         self._outputs: Dict[str, jnp.ndarray] = {}
         self._compiled: Dict[tuple, object] = {}
         self._compile_count = 0
+        # batch buckets per rest-signature (shapes minus the leading dim):
+        # every compiled/declared variant whose inputs share a leading dim
+        # registers its batch size here, and the pad policy targets them
+        self._batch_buckets: Dict[tuple, set] = {}
+        self._batched_out_mask: object = False    # False = not computed
         if config._aot_on_load:
             self._aot_compile()
 
     # -- compile management ------------------------------------------------
-    def _lowered(self, shapes_dtypes, no_donate=frozenset()):
+    @staticmethod
+    def _split_batch(shapes_dtypes):
+        """(rest_key, batch) when every input shares a leading dim, else
+        (None, None) — scalars or ragged leading dims can't be padded."""
+        batches = {s[0] for s, _ in shapes_dtypes if len(s) >= 1}
+        if len(batches) != 1 or any(len(s) < 1 for s, _ in shapes_dtypes):
+            return None, None
+        rest = tuple((s[1:], str(d)) for s, d in shapes_dtypes)
+        return rest, batches.pop()
+
+    def _register_bucket(self, shapes_dtypes):
+        rest, batch = self._split_batch(shapes_dtypes)
+        if rest is not None:
+            self._batch_buckets.setdefault(rest, set()).add(batch)
+
+    def batched_output_mask(self) -> Optional[List[bool]]:
+        """Which outputs carry the batch dim, from the artifact itself:
+        a shape-polymorphic export names the batch dim symbolically in
+        ``out_avals``, so outputs whose leading dim is that symbol are
+        exactly the ones to slice after a padded run.  None when the
+        artifact is fully static (no symbol to track) — callers fall
+        back to a shape heuristic."""
+        if self._batched_out_mask is False:
+            mask = None
+            try:
+                in_sym = any(not isinstance(d, (int, np.integer))
+                             for a in self._exported.in_avals
+                             for d in a.shape)
+                if in_sym:
+                    mask = [len(a.shape) >= 1
+                            and not isinstance(a.shape[0],
+                                               (int, np.integer))
+                            for a in self._exported.out_avals]
+            except Exception:   # exported object without aval metadata
+                mask = None
+            self._batched_out_mask = mask
+        return self._batched_out_mask
+
+    def _pick_bucket(self, rest, batch) -> int:
+        """Smallest known bucket that fits, else the next power of two."""
+        fitting = [b for b in self._batch_buckets.get(rest, ())
+                   if b >= batch]
+        if fitting:
+            return min(fitting)
+        return 1 << (batch - 1).bit_length()
+
+    def _lowered(self, shapes_dtypes, no_donate=frozenset(),
+                 from_run=False):
         key = (tuple(shapes_dtypes), frozenset(no_donate))
         fn = self._compiled.get(key)
         if fn is None:
             self._compile_count += 1
+            if from_run:
+                monitor.stat_add("inference.compile_misses")
             call = self._exported.call
             # donate predictor-staged inputs on TPU (single-use per call);
             # share_external_data buffers stay caller-owned (CPU backend
@@ -174,13 +257,17 @@ class Predictor:
             avals = [jax.ShapeDtypeStruct(s, d) for s, d in shapes_dtypes]
             fn = fn.lower(*avals).compile()  # AOT: no trace on serve path
             self._compiled[key] = fn
+            self._register_bucket(shapes_dtypes)
         return fn
 
     def _aot_compile(self):
         """Compile at load for declared buckets, plus the saved example
-        shapes when they are fully static."""
+        shapes when they are fully static.  Dtypes are canonicalized
+        exactly as run() does (i64->i32 / f64->f32 under x64-disabled
+        jax), so serve-time lookups hit these variants."""
+        canon = jax.dtypes.canonicalize_dtype
         for bucket in self.config._shape_buckets:
-            sd = [(tuple(s), np.dtype(d)) for s, d in
+            sd = [(tuple(s), canon(np.dtype(d))) for s, d in
                   zip(bucket, self._meta["in_dtypes"])]
             self._lowered(sd)
         try:
@@ -188,7 +275,7 @@ class Predictor:
                       for s in self._meta["in_shapes"]]
         except ValueError:
             return  # symbolic dims: compile per served shape
-        sd = [(s, np.dtype(d))
+        sd = [(s, canon(np.dtype(d)))
               for s, d in zip(shapes, self._meta["in_dtypes"])]
         self._lowered(sd)
 
@@ -211,21 +298,58 @@ class Predictor:
     def run(self, inputs: Optional[Sequence] = None):
         """Serve one batch.  ``run([arr, ...])`` or stage via input
         handles first.  Returns the output list (also readable through
-        output handles)."""
+        output handles).
+
+        A batch size with no compiled variant is padded up to a bucket
+        (and the outputs sliced back) under the default ``"bucket"``
+        policy — see :meth:`Config.set_batch_pad_policy`.
+        """
         if inputs is not None:
             for n, a in zip(self._input_names, inputs):
                 self._inputs[n] = np.asarray(a)
-        args = []
+        raw = []
         for n in self._input_names:
             if n not in self._inputs:
                 raise ValueError(f"input '{n}' not staged; call "
                                  f"get_input_handle('{n}').copy_from_cpu()")
-            args.append(jnp.asarray(self._inputs[n]))
-        sd = tuple((tuple(a.shape), np.dtype(a.dtype)) for a in args)
-        fn = self._lowered(sd, no_donate=self._external)
+            a = self._inputs[n]
+            if not hasattr(a, "dtype"):     # share_external_data may
+                a = np.asarray(a)           # stage a bare list/tuple
+            raw.append(a)
+        # the signature must match what jnp.asarray will produce below
+        # (x64-disabled jax canonicalizes f64->f32, i64->i32)
+        canon = jax.dtypes.canonicalize_dtype
+        sd = tuple((tuple(np.shape(a)), canon(np.dtype(a.dtype)))
+                   for a in raw)
+        key = (sd, frozenset(self._external))
+        n_real = None
+        if key not in self._compiled \
+                and self.config.batch_pad_policy() == "bucket":
+            rest, batch = self._split_batch(sd)
+            if rest is not None:
+                target = self._pick_bucket(rest, batch)
+                if target != batch:
+                    raw = [np.concatenate(
+                        [a, np.zeros((target - batch,) + tuple(
+                            np.shape(a)[1:]), dtype=a.dtype)])
+                        for a in raw]
+                    sd = tuple((tuple(a.shape), canon(np.dtype(a.dtype)))
+                               for a in raw)
+                    n_real, n_padded = batch, target
+                    if (sd, frozenset(self._external)) in self._compiled:
+                        monitor.stat_add("inference.pad_hits")
+        args = [jnp.asarray(a) for a in raw]
+        fn = self._lowered(sd, no_donate=self._external, from_run=True)
         outs = fn(*args)
         if not isinstance(outs, (list, tuple)):
             outs = [outs]
+        if n_real is not None:
+            mask = self.batched_output_mask()
+            outs = [o[:n_real]
+                    if (getattr(o, "ndim", 0) >= 1
+                        and (mask[i] if mask is not None and i < len(mask)
+                             else o.shape[0] == n_padded)) else o
+                    for i, o in enumerate(outs)]
         names = (self._output_names
                  or [f"out{i}" for i in range(len(outs))])
         self._outputs = dict(zip(names, outs))

@@ -1,0 +1,24 @@
+"""paddle_tpu.serving — a concurrent dynamic-batching inference engine.
+
+The reference ships a standalone inference stack
+(paddle/fluid/inference/api/analysis_predictor.h) that serves one caller
+per Predictor.  This subsystem turns the AOT
+:class:`~paddle_tpu.inference.Predictor` into a production-shaped
+engine (ROADMAP north star: "serve heavy traffic from millions of
+users"):
+
+- :class:`InferenceEngine` — bounded request queue, a dispatcher thread
+  that coalesces waiting requests into micro-batches padded to a small
+  set of precompiled bucket sizes (zero recompiles after warmup),
+  futures-based API, queue-full load shedding, per-request in-queue
+  deadlines, graceful ``drain()``/``close()``.
+- :mod:`paddle_tpu.serving.http` — stdlib ``ThreadingHTTPServer``
+  front-end (``/predict``, ``/healthz``, ``/metrics``) plus a tiny
+  client helper; ``tools/serve.py`` is the CLI entry point.
+"""
+from .engine import (DeadlineExceeded, EngineClosed,  # noqa: F401
+                     InferenceEngine, QueueFull, ServingError)
+from .http import Client, ServingServer  # noqa: F401
+
+__all__ = ["InferenceEngine", "ServingError", "QueueFull",
+           "DeadlineExceeded", "EngineClosed", "ServingServer", "Client"]

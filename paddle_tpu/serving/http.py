@@ -1,0 +1,286 @@
+"""Stdlib HTTP front-end for :class:`~paddle_tpu.serving.InferenceEngine`.
+
+Endpoints (reference analog: the C++ inference demo's HTTP wrappers;
+no external web framework — ``http.server.ThreadingHTTPServer`` gives
+one thread per connection, which pairs naturally with the engine's
+futures: N concurrent connections become N waiting requests that the
+dispatcher coalesces into micro-batches):
+
+- ``POST /predict`` — JSON body ``{"inputs": [...], "deadline_ms": N}``
+  (inputs: one array, a list of per-input arrays, or a name->array
+  dict), or a raw ``.npy`` body (``Content-Type: application/x-npy``,
+  single-input models; deadline via the ``X-Deadline-Ms`` header).
+  JSON responses carry ``outputs``/``names``/``dtypes``; npy requests
+  get the first output back as npy bytes.
+- ``GET /healthz`` — 200 while serving, 503 when draining/closed.
+- ``GET /metrics`` — the engine's stats JSON: queue depth, batch
+  occupancy, padding waste, request/shed/deadline counters, latency
+  p50/p95/p99.
+
+Error mapping: shed -> 503 (+Retry-After), deadline -> 504, malformed
+-> 400, engine closed -> 503.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+import numpy as np
+
+from .engine import (DeadlineExceeded, EngineClosed, InferenceEngine,
+                     QueueFull, ServingError)
+
+__all__ = ["ServingServer", "Client", "serve"]
+
+_NPY = "application/x-npy"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def engine(self) -> InferenceEngine:
+        return self.server.engine
+
+    def log_message(self, fmt, *args):      # quiet by default
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    def _reply(self, code: int, body: bytes, ctype: str = "application/json",
+               extra_headers=()):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, code: int, obj, extra_headers=()):
+        self._reply(code, json.dumps(obj).encode(),
+                    extra_headers=extra_headers)
+
+    def _reply_error(self, exc: BaseException):
+        kind = type(exc).__name__
+        payload = {"error": kind, "message": str(exc)}
+        if isinstance(exc, QueueFull):
+            self._reply_json(503, payload, [("Retry-After", "0")])
+        elif isinstance(exc, (DeadlineExceeded, TimeoutError,
+                              concurrent.futures.TimeoutError)):
+            # concurrent.futures.TimeoutError is NOT a builtin
+            # TimeoutError subclass before Python 3.11
+            self._reply_json(504, payload)
+        elif isinstance(exc, EngineClosed):
+            self._reply_json(503, payload)
+        elif isinstance(exc, (ValueError, KeyError, json.JSONDecodeError)):
+            self._reply_json(400, payload)
+        else:
+            self._reply_json(500, payload)
+
+    # -- routes ------------------------------------------------------------
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            st = self.engine.stats()["state"]
+            self._reply_json(200 if st in ("running", "paused") else 503,
+                             {"status": st})
+        elif path == "/metrics":
+            self._reply_json(200, self.engine.stats())
+        else:
+            self._reply_json(404, {"error": "NotFound", "message": self.path})
+
+    def do_POST(self):
+        path = self.path.split("?", 1)[0]
+        if path != "/predict":
+            self._reply_json(404, {"error": "NotFound",
+                                   "message": self.path})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(n)
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+            if ctype == _NPY:
+                arr = np.load(io.BytesIO(body), allow_pickle=False)
+                inputs = [arr]
+                deadline_ms = self.headers.get("X-Deadline-Ms")
+                deadline_ms = float(deadline_ms) if deadline_ms else None
+            else:
+                payload = json.loads(body or b"{}")
+                if "inputs" not in payload:
+                    raise ValueError('body must carry "inputs"')
+                inputs = payload["inputs"]
+                deadline_ms = payload.get("deadline_ms")
+            timeout = self.server.request_timeout
+            outs = self.engine.infer_sync(inputs, deadline_ms=deadline_ms,
+                                          timeout=timeout)
+        except Exception as e:              # noqa: BLE001 - mapped to HTTP
+            self._reply_error(e)
+            return
+        if ctype == _NPY:
+            buf = io.BytesIO()
+            np.save(buf, outs[0], allow_pickle=False)
+            self._reply(200, buf.getvalue(), ctype=_NPY)
+        else:
+            self._reply_json(200, {
+                "outputs": [o.tolist() for o in outs],
+                "names": self.engine._pred.get_output_names(),
+                "dtypes": [str(o.dtype) for o in outs],
+            })
+
+
+class ServingServer:
+    """Threaded HTTP server bound to one engine.
+
+    ``port=0`` picks a free port (read it back via ``.port``).  The
+    server owns only the HTTP layer: ``close()`` stops accepting
+    connections but leaves the engine to its owner (``tools/serve.py``
+    closes both)."""
+
+    def __init__(self, engine: InferenceEngine, host: str = "127.0.0.1",
+                 port: int = 8000, request_timeout: float = 60.0,
+                 verbose: bool = False):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.engine = engine
+        self._httpd.request_timeout = request_timeout
+        self._httpd.verbose = verbose
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ServingServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="serving-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc_info):
+        self.close()
+        return False
+
+
+def serve(engine: InferenceEngine, host: str = "127.0.0.1",
+          port: int = 8000, verbose: bool = True) -> None:
+    """Blocking convenience: serve until KeyboardInterrupt, then drain."""
+    srv = ServingServer(engine, host, port, verbose=verbose)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+        engine.close()
+
+
+class Client:
+    """Tiny stdlib client for the HTTP front-end.
+
+    503/504 responses are raised as the matching engine exceptions
+    (:class:`QueueFull` / :class:`DeadlineExceeded` / ...), so a caller
+    can back off on shed exactly as an in-process caller would."""
+
+    def __init__(self, base_url: str, timeout: float = 60.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _raise_for(self, e: urlerror.HTTPError):
+        try:
+            payload = json.loads(e.read().decode() or "{}")
+        except Exception:
+            payload = {}
+        kind = payload.get("error", "")
+        msg = payload.get("message", str(e))
+        for cls in (QueueFull, DeadlineExceeded, EngineClosed):
+            if kind == cls.__name__:
+                raise cls(msg) from None
+        raise ServingError(f"HTTP {e.code}: {kind or ''} {msg}") from None
+
+    def _post(self, path: str, body: bytes, headers: dict) -> bytes:
+        req = urlrequest.Request(self.base_url + path, data=body,
+                                 headers=headers, method="POST")
+        try:
+            with urlrequest.urlopen(req, timeout=self.timeout) as r:
+                return r.read()
+        except urlerror.HTTPError as e:
+            self._raise_for(e)
+
+    def _get_json(self, path: str):
+        try:
+            with urlrequest.urlopen(self.base_url + path,
+                                    timeout=self.timeout) as r:
+                return json.loads(r.read().decode())
+        except urlerror.HTTPError as e:
+            if path == "/healthz":      # 503 healthz still carries status
+                try:
+                    return json.loads(e.read().decode())
+                except Exception:
+                    pass
+            self._raise_for(e)
+
+    def predict(self, inputs, deadline_ms: Optional[float] = None
+                ) -> List[np.ndarray]:
+        """JSON round trip; returns host arrays with the server dtypes.
+
+        Wire format (unambiguous by construction): ``inputs`` is ALWAYS
+        a list of per-input arrays or a name->array dict.  A bare
+        ndarray argument is wrapped as the single input; a bare
+        list/tuple argument is interpreted as the per-input list."""
+        if isinstance(inputs, dict):
+            payload = {k: np.asarray(v).tolist() for k, v in inputs.items()}
+        else:
+            if isinstance(inputs, np.ndarray) or not isinstance(
+                    inputs, (list, tuple)):
+                inputs = [inputs]
+            payload = [np.asarray(a).tolist() for a in inputs]
+        body = {"inputs": payload}
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        raw = self._post("/predict", json.dumps(body).encode(),
+                         {"Content-Type": "application/json"})
+        res = json.loads(raw.decode())
+        return [np.asarray(o, dtype=np.dtype(dt))
+                for o, dt in zip(res["outputs"], res["dtypes"])]
+
+    def predict_npy(self, arr: np.ndarray,
+                    deadline_ms: Optional[float] = None) -> np.ndarray:
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(arr), allow_pickle=False)
+        headers = {"Content-Type": _NPY}
+        if deadline_ms is not None:
+            headers["X-Deadline-Ms"] = str(deadline_ms)
+        raw = self._post("/predict", buf.getvalue(), headers)
+        return np.load(io.BytesIO(raw), allow_pickle=False)
+
+    def healthz(self) -> dict:
+        return self._get_json("/healthz")
+
+    def metrics(self) -> dict:
+        return self._get_json("/metrics")

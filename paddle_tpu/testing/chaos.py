@@ -1,4 +1,4 @@
-"""Chaos smoke flow: preemption-safe training under injected faults.
+"""Chaos smoke flows: training and serving under injected faults.
 
 Trains a tiny model twice — once fault-free, once under a canned chaos
 spec (checkpoint-fs write flakes, one DataLoader worker hard-killed
@@ -15,6 +15,14 @@ Lives inside the package (not tools/) so forkserver DataLoader workers
 can unpickle :class:`SmokeDataset` regardless of how the driver was
 launched; ``tools/chaos_smoke.py`` is the CLI entry point and
 ``tests/test_fault_tolerance.py`` runs :func:`main` in-process.
+
+:func:`serving_main` is the serving-engine counterpart (ISSUE 4): under
+injected dispatcher faults, queue-full shedding, and in-queue deadline
+expiry, every *accepted* request must still get a bitwise-correct
+response or a clean shed/deadline error — never a hang or a wrong
+answer.  Bitwise is provable here because :func:`make_dyadic_model`
+keeps every weight and input a small dyadic rational, so float
+accumulation is exact in any batching/padding order.
 """
 from __future__ import annotations
 
@@ -174,3 +182,166 @@ def main(epochs=4, verbose=False, workdir=None):
         fs._REGISTRY.pop(scheme, None)
         if own_tmp:
             shutil.rmtree(workdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Serving chaos (ISSUE 4): dispatcher flakes + shedding + deadlines
+# ---------------------------------------------------------------------------
+
+# Dispatcher flakes: 3 random fires across the run, seeded for replay.
+# The engine retries a flaked batch (inference is pure), and with
+# dispatch_retries=3 a rule capped at count=3 can NEVER exhaust a
+# batch's 4 attempts — so every accepted request must come back correct.
+SERVING_CHAOS_SPEC = "serving.dispatch:p=0.3,count=3"
+
+
+def make_dyadic_model(in_dim=8, hidden=16, out_dim=4):
+    """A tiny MLP whose weights are small dyadic rationals (k/8).
+
+    With inputs that are also dyadic (k/4), every product and partial
+    sum is exactly representable in float32, so outputs are bitwise
+    identical regardless of batch coalescing, padding, or reduction
+    order — the property the serving chaos/smoke gates assert."""
+    import numpy as np
+
+    from paddle_tpu import nn
+
+    model = nn.Sequential(nn.Linear(in_dim, hidden), nn.ReLU(),
+                          nn.Linear(hidden, out_dim))
+    for p in model.parameters():
+        p.set_value(np.round(p.numpy() * 8.0) / 8.0)
+    return model
+
+
+def serving_main(requests=40, clients=4, verbose=False):
+    """Serving chaos gate; returns 0 on success, 1 on failure."""
+    import tempfile
+    import threading
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, jit, serving
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.testing import fault
+    from paddle_tpu.utils import monitor
+
+    paddle.seed(5)
+    model = make_dyadic_model()
+    prefix = os.path.join(tempfile.mkdtemp(prefix="serve_chaos_"), "m")
+    jit.save(model, prefix, input_spec=[InputSpec([None, 8], "float32")])
+    pred = inference.create_predictor(inference.Config(prefix))
+
+    rng = np.random.RandomState(17)
+    reqs = [(rng.randint(-8, 9, (rng.randint(1, 5), 8)) / 4.0)
+            .astype(np.float32) for _ in range(requests)]
+    refs = [np.asarray(pred.run([x])[0]) for x in reqs]
+
+    max_queue = 8
+    engine = serving.InferenceEngine(pred, max_batch_size=8,
+                                     batch_timeout_ms=5.0,
+                                     max_queue=max_queue,
+                                     dispatch_retries=3)
+    engine.warmup()
+
+    problems = []
+    monitor.stat_reset()
+    fault.arm(SERVING_CHAOS_SPEC, seed=1)
+    try:
+        # -- concurrent traffic under dispatcher flakes ------------------
+        outcomes = [None] * requests
+
+        def client(idx):
+            for i in range(idx, requests, clients):
+                try:
+                    outcomes[i] = engine.infer_sync(
+                        [reqs[i]], timeout=30)
+                except Exception as e:  # noqa: BLE001 - gated below
+                    outcomes[i] = e
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        for i, (out, ref) in enumerate(zip(outcomes, refs)):
+            if isinstance(out, Exception):
+                problems.append(
+                    f"accepted request {i} failed under chaos: "
+                    f"{type(out).__name__}: {out}")
+            elif out is None:
+                problems.append(f"request {i} hung (no outcome)")
+            elif not np.array_equal(out[0], ref):
+                problems.append(
+                    f"request {i}: WRONG ANSWER under chaos (max "
+                    f"|d|={np.abs(out[0] - ref).max():.3e})")
+
+        # -- deterministic queue-full shedding ---------------------------
+        engine.pause()
+        burst = []
+        for i in range(max_queue + 4):
+            try:
+                burst.append(engine.infer([reqs[i % requests]]))
+            except serving.QueueFull:
+                burst.append("shed")
+        n_shed = sum(1 for b in burst if b == "shed")
+        if n_shed != 4:
+            problems.append(f"expected exactly 4 sheds from a "
+                            f"{max_queue + 4}-burst into a paused "
+                            f"{max_queue}-queue, got {n_shed}")
+
+        engine.resume()
+        accepted = [b for b in burst if b != "shed"]
+        for i, f in enumerate(accepted):
+            try:
+                f.result(timeout=30)
+            except Exception as e:  # noqa: BLE001
+                problems.append(f"post-pause request {i} failed: "
+                                f"{type(e).__name__}: {e}")
+
+        # -- in-queue deadline expiry (never occupies a batch slot) ------
+        engine.pause()          # idle queue now: the probe is admitted
+        doomed = engine.infer([reqs[0]], deadline_ms=1.0)
+        time.sleep(0.02)
+        engine.resume()
+        try:
+            doomed.result(timeout=30)
+            problems.append("1 ms deadline request was served instead "
+                            "of expiring in-queue")
+        except serving.DeadlineExceeded:
+            pass
+        except Exception as e:  # noqa: BLE001
+            problems.append(f"deadline request died oddly: "
+                            f"{type(e).__name__}: {e}")
+    finally:
+        fault.disarm()
+    engine.drain(timeout=30)
+    stats = engine.stats()
+    engine.close()
+
+    fired = monitor.get_stat("fault.fired.serving.dispatch")
+    if fired < 1:
+        problems.append("chaos spec never fired a dispatcher fault "
+                        "(nothing was actually tested)")
+    if stats["counters"]["dispatch_retries"] < fired:
+        problems.append(
+            f"dispatcher fired {fired} faults but only "
+            f"{stats['counters']['dispatch_retries']} retries ran")
+    if stats["recompiles_after_warmup"] != 0:
+        problems.append(f"hot path recompiled "
+                        f"{stats['recompiles_after_warmup']}x under chaos")
+    if verbose:
+        print(f"serving chaos stats: faults={fired} "
+              f"retries={stats['counters']['dispatch_retries']} "
+              f"shed={stats['counters']['shed']} "
+              f"expired={stats['counters']['deadline_expired']} "
+              f"batches={stats['counters']['batches']}")
+    if problems:
+        for p in problems:
+            print(f"FAIL: {p}", file=sys.stderr)
+        return 1
+    print("serving chaos OK: dispatcher flakes retried, queue-full "
+          "shed cleanly, deadlines expired in-queue, every served "
+          "response bitwise-correct")
+    return 0

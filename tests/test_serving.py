@@ -1,0 +1,606 @@
+"""paddle_tpu.serving tests (ISSUE 4): dynamic-batching engine semantics
+(bitwise batched-vs-unbatched equivalence, zero recompiles after warmup,
+deadlines, shedding, drain), the HTTP front-end under concurrent
+clients, the Predictor pad-to-bucket satellite, and monitor histograms.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, jit, nn, serving
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.testing import fault
+from paddle_tpu.testing.chaos import make_dyadic_model
+from paddle_tpu.utils import monitor
+
+
+def _dyadic_requests(rng, n, in_dim=8, max_rows=4):
+    """Inputs that are small dyadic rationals: float accumulation is
+    exact, so batched/padded results are bitwise-equal to unbatched."""
+    return [(rng.randint(-8, 9, (rng.randint(1, max_rows + 1), in_dim))
+             / 4.0).astype(np.float32) for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    paddle.seed(7)
+    model = make_dyadic_model(in_dim=8, hidden=16, out_dim=4)
+    prefix = os.path.join(str(tmp_path_factory.mktemp("serving")), "m")
+    jit.save(model, prefix, input_spec=[InputSpec([None, 8], "float32")])
+    return prefix
+
+
+def _engine(prefix, **kw):
+    pred = inference.create_predictor(inference.Config(prefix))
+    kw.setdefault("max_batch_size", 8)
+    kw.setdefault("batch_timeout_ms", 5.0)
+    eng = serving.InferenceEngine(pred, **kw)
+    eng.warmup()
+    return eng, pred
+
+
+# ------------------------------------------------------------- engine --
+def test_batched_equals_unbatched_bitwise(artifact):
+    eng, pred = _engine(artifact)
+    try:
+        rng = np.random.RandomState(0)
+        reqs = _dyadic_requests(rng, 24)
+        refs = [np.asarray(pred.run([x])[0]) for x in reqs]
+        futs = [eng.infer([x]) for x in reqs]   # burst: forces coalescing
+        for f, ref, x in zip(futs, refs, reqs):
+            out = f.result(timeout=30)
+            assert out[0].shape == (x.shape[0], 4)
+            np.testing.assert_array_equal(out[0], ref)
+    finally:
+        eng.close()
+
+
+def test_zero_recompiles_after_warmup(artifact):
+    eng, pred = _engine(artifact)
+    try:
+        base = pred.num_compiled_variants()
+        rng = np.random.RandomState(1)
+        futs = [eng.infer([x]) for x in _dyadic_requests(rng, 32)]
+        for f in futs:
+            f.result(timeout=30)
+        assert pred.num_compiled_variants() == base
+        st = eng.stats()
+        assert st["recompiles_after_warmup"] == 0
+        assert st["counters"]["batches"] < 32  # coalescing happened
+    finally:
+        eng.close()
+
+
+def test_input_validation(artifact):
+    eng, _ = _engine(artifact)
+    try:
+        with pytest.raises(ValueError, match="leading batch dim"):
+            eng.infer([np.float32(1.0)])        # scalar input
+        with pytest.raises(ValueError, match="max_batch_size"):
+            eng.infer([np.zeros((64, 8), np.float32)])
+        with pytest.raises(ValueError, match="expected 1 inputs"):
+            eng.infer([np.zeros((2, 8), np.float32)] * 2)
+        with pytest.raises(ValueError, match="empty request"):
+            eng.infer([np.zeros((0, 8), np.float32)])
+    finally:
+        eng.close()
+
+
+def test_mismatched_rest_dims_rejected_at_admission(artifact):
+    """A mis-shaped request must be rejected at infer(), never reach a
+    coalesced batch (where np.concatenate would kill the dispatcher)."""
+    eng, _ = _engine(artifact)
+    try:
+        with pytest.raises(ValueError, match="per-row shape"):
+            eng.infer([np.ones((2, 9), np.float32)])    # model wants 8
+        # dispatcher unharmed: a good request still serves
+        assert eng.infer_sync([np.ones((2, 8), np.float32)],
+                              timeout=30)[0].shape == (2, 4)
+    finally:
+        eng.close()
+
+
+def test_dispatcher_survives_execute_crash(artifact):
+    """Defense in depth: even an exception outside the retry loop fails
+    only that batch's futures — the dispatcher thread lives on."""
+    eng, _ = _engine(artifact)
+    try:
+        orig = eng._bucket_for        # called in _execute BEFORE the
+        eng._bucket_for = lambda rows: (_ for _ in ()).throw(
+            RuntimeError("boom outside retry"))  # dispatch-retry loop
+        f = eng.infer([np.ones((1, 8), np.float32)])
+        with pytest.raises(RuntimeError, match="boom"):
+            f.result(timeout=30)
+        eng._bucket_for = orig
+        assert eng.infer_sync([np.ones((1, 8), np.float32)],
+                              timeout=30)[0].shape == (1, 4)
+    finally:
+        eng.close()
+
+
+def test_dict_and_bare_array_inputs(artifact):
+    eng, pred = _engine(artifact)
+    try:
+        x = (np.arange(16).reshape(2, 8) / 4.0).astype(np.float32)
+        name = pred.get_input_names()[0]
+        a = eng.infer_sync({name: x}, timeout=30)
+        b = eng.infer_sync(x, timeout=30)       # bare array = only input
+        np.testing.assert_array_equal(a[0], b[0])
+    finally:
+        eng.close()
+
+
+def test_deadline_expires_in_queue(artifact):
+    eng, _ = _engine(artifact)
+    try:
+        eng.pause()
+        x = np.ones((1, 8), np.float32)
+        doomed = eng.infer([x], deadline_ms=1.0)
+        ok = eng.infer([x])                     # no deadline: survives
+        time.sleep(0.02)
+        eng.resume()
+        with pytest.raises(serving.DeadlineExceeded):
+            doomed.result(timeout=30)
+        assert ok.result(timeout=30)[0].shape == (1, 4)
+        assert eng.stats()["counters"]["deadline_expired"] == 1
+    finally:
+        eng.close()
+
+
+def test_default_deadline(artifact):
+    eng, _ = _engine(artifact, default_deadline_ms=1.0)
+    try:
+        eng.pause()
+        f = eng.infer([np.ones((1, 8), np.float32)])
+        time.sleep(0.02)
+        eng.resume()
+        with pytest.raises(serving.DeadlineExceeded):
+            f.result(timeout=30)
+    finally:
+        eng.close()
+
+
+def test_queue_full_sheds(artifact):
+    eng, _ = _engine(artifact, max_queue=4)
+    try:
+        eng.pause()
+        x = np.ones((1, 8), np.float32)
+        futs = [eng.infer([x]) for _ in range(4)]
+        for _ in range(3):
+            with pytest.raises(serving.QueueFull):
+                eng.infer([x])
+        assert eng.stats()["counters"]["shed"] == 3
+        eng.resume()
+        for f in futs:                          # accepted ones all serve
+            assert f.result(timeout=30)[0].shape == (1, 4)
+    finally:
+        eng.close()
+
+
+def test_invalid_buckets_rejected(artifact):
+    pred = inference.create_predictor(inference.Config(artifact))
+    with pytest.raises(ValueError, match="exceeds max_batch_size"):
+        serving.InferenceEngine(pred, max_batch_size=8, buckets=[48])
+    with pytest.raises(ValueError, match="positive"):
+        serving.InferenceEngine(pred, max_batch_size=8, buckets=[0, 4])
+
+
+def test_drain_unpauses(artifact):
+    eng, _ = _engine(artifact)
+    eng.pause()
+    f = eng.infer([np.ones((1, 8), np.float32)])
+    assert eng.drain(timeout=30)            # must not livelock
+    assert f.result(timeout=0)[0].shape == (1, 4)
+    eng.close()
+
+
+def test_expired_slots_do_not_shed_live_traffic(artifact):
+    """Deadline-lapsed requests stuck behind a long in-flight batch must
+    be swept at admission instead of causing spurious QueueFull."""
+    eng, _ = _engine(artifact, max_queue=2)
+    try:
+        gate = threading.Event()
+        orig = eng._pred.run
+        def slow_run(feeds):
+            gate.wait(10)
+            return orig(feeds)
+        eng._pred.run = slow_run
+        x = np.ones((1, 8), np.float32)
+        f1 = eng.infer([x])                 # occupies the dispatcher
+        time.sleep(0.1)                     # now blocked inside run()
+        dead = [eng.infer([x], deadline_ms=1.0) for _ in range(2)]
+        time.sleep(0.02)                    # both queued slots expired
+        f4 = eng.infer([x])                 # swept at admission: admitted
+        eng._pred.run = orig
+        gate.set()
+        assert f1.result(timeout=30)[0].shape == (1, 4)
+        assert f4.result(timeout=30)[0].shape == (1, 4)
+        for d in dead:
+            with pytest.raises(serving.DeadlineExceeded):
+                d.result(timeout=30)
+    finally:
+        gate.set()
+        eng.close()
+
+
+def test_graceful_drain_and_close(artifact):
+    eng, _ = _engine(artifact)
+    rng = np.random.RandomState(2)
+    futs = [eng.infer([x]) for x in _dyadic_requests(rng, 16)]
+    assert eng.drain(timeout=30)
+    assert all(f.done() for f in futs)
+    with pytest.raises(serving.EngineClosed):
+        eng.infer([np.ones((1, 8), np.float32)])    # draining: no admission
+    eng.close()
+    assert eng.stats()["state"] == "closed"
+    eng.close()                                     # idempotent
+
+
+def test_close_never_strands_futures(artifact):
+    eng, _ = _engine(artifact)
+    eng.pause()
+    x = np.ones((2, 8), np.float32)
+    futs = [eng.infer([x]) for _ in range(6)]
+    eng.close()         # close unpauses, flushes, then stops
+    for f in futs:
+        assert f.done()
+        f.result(timeout=0)     # flushed batches resolved with results
+
+
+def test_dispatch_fault_is_retried(artifact):
+    eng, _ = _engine(artifact, dispatch_retries=2)
+    try:
+        with fault.inject("serving.dispatch:count=2"):
+            out = eng.infer_sync([np.ones((1, 8), np.float32)],
+                                 timeout=30)
+        assert out[0].shape == (1, 4)
+        assert eng.stats()["counters"]["dispatch_retries"] == 2
+    finally:
+        eng.close()
+
+
+def test_dispatch_retries_exhausted_fails_cleanly(artifact):
+    eng, _ = _engine(artifact, dispatch_retries=1)
+    try:
+        with fault.inject("serving.dispatch"):      # unlimited fires
+            f = eng.infer([np.ones((1, 8), np.float32)])
+            with pytest.raises(fault.FaultInjected):
+                f.result(timeout=30)
+        assert eng.stats()["counters"]["failed"] == 1
+        # engine survives: next request serves normally
+        assert eng.infer_sync([np.ones((1, 8), np.float32)],
+                              timeout=30)[0].shape == (1, 4)
+    finally:
+        eng.close()
+
+
+def test_enqueue_fault_propagates_to_caller(artifact):
+    eng, _ = _engine(artifact)
+    try:
+        with fault.inject("serving.enqueue:count=1"):
+            with pytest.raises(fault.FaultInjected):
+                eng.infer([np.ones((1, 8), np.float32)])
+        assert eng.infer_sync([np.ones((1, 8), np.float32)],
+                              timeout=30)[0].shape == (1, 4)
+    finally:
+        eng.close()
+
+
+def test_concurrent_clients_engine(artifact):
+    eng, pred = _engine(artifact)
+    try:
+        rng = np.random.RandomState(3)
+        reqs = _dyadic_requests(rng, 40)
+        refs = [np.asarray(pred.run([x])[0]) for x in reqs]
+        results = [None] * len(reqs)
+
+        def client(idx):
+            for i in range(idx, len(reqs), 8):
+                results[i] = eng.infer_sync([reqs[i]], timeout=30)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for out, ref in zip(results, refs):
+            np.testing.assert_array_equal(out[0], ref)
+        st = eng.stats()
+        assert st["counters"]["responses"] == 40
+        assert st["recompiles_after_warmup"] == 0
+    finally:
+        eng.close()
+
+
+def test_engine_stats_fields(artifact):
+    eng, _ = _engine(artifact)
+    try:
+        eng.infer_sync([np.ones((3, 8), np.float32)], timeout=30)
+        st = eng.stats()
+        assert st["state"] == "running"
+        assert st["buckets"] == [1, 2, 4, 8]
+        assert st["counters"]["rows"] == 3
+        assert st["counters"]["padded_rows"] == 1   # 3 -> bucket 4
+        assert 0 < st["mean_batch_occupancy"] <= 1
+        assert st["latency_ms"]["count"] >= 1
+        assert st["latency_ms"]["p99"] >= st["latency_ms"]["p50"]
+    finally:
+        eng.close()
+
+
+# --------------------------------------------------------------- http --
+def test_http_concurrent_clients(artifact):
+    eng, pred = _engine(artifact)
+    srv = serving.ServingServer(eng, port=0).start()
+    try:
+        client = serving.Client(srv.url)
+        assert client.healthz()["status"] == "running"
+        rng = np.random.RandomState(4)
+        reqs = _dyadic_requests(rng, 24)
+        refs = [np.asarray(pred.run([x])[0]) for x in reqs]
+        results = [None] * len(reqs)
+
+        def worker(idx):
+            for i in range(idx, len(reqs), 6):
+                results[i] = client.predict(reqs[i])
+
+        threads = [threading.Thread(target=worker, args=(c,))
+                   for c in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for out, ref in zip(results, refs):
+            assert out[0].dtype == np.float32
+            np.testing.assert_array_equal(out[0], ref)
+
+        m = client.metrics()
+        assert m["counters"]["responses"] >= 24
+        assert m["recompiles_after_warmup"] == 0
+        assert {"p50", "p95", "p99"} <= set(m["latency_ms"])
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_http_npy_roundtrip(artifact):
+    eng, pred = _engine(artifact)
+    srv = serving.ServingServer(eng, port=0).start()
+    try:
+        client = serving.Client(srv.url)
+        x = (np.arange(24).reshape(3, 8) / 4.0).astype(np.float32)
+        out = client.predict_npy(x)
+        np.testing.assert_array_equal(out, np.asarray(pred.run([x])[0]))
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_http_error_mapping(artifact):
+    eng, _ = _engine(artifact, max_queue=1)
+    srv = serving.ServingServer(eng, port=0).start()
+    try:
+        client = serving.Client(srv.url)
+        with pytest.raises(serving.ServingError, match="400"):
+            client.predict([np.ones((2, 8)), np.ones((2, 8))])  # 2 inputs
+        eng.pause()
+        # fill the 1-slot queue, then expect a shed mapped to QueueFull
+        f = eng.infer([np.ones((1, 8), np.float32)])
+        with pytest.raises(serving.QueueFull):
+            client.predict(np.ones((1, 8), np.float32))
+        eng.resume()
+        f.result(timeout=30)
+        # draining/closed healthz flips to 503 payload
+        eng.drain(timeout=30)
+        assert client.healthz()["status"] in ("draining", "closed")
+    finally:
+        srv.close()
+        eng.close()
+
+
+# -------------------------------------------- predictor pad-to-bucket --
+def _save_plain(tmp_path, seed=0):
+    paddle.seed(seed)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    prefix = os.path.join(str(tmp_path), "pad")
+    jit.save(model, prefix, input_spec=[InputSpec([None, 4], "float32")])
+    return model, prefix
+
+
+def test_predictor_pads_to_pow2_bucket(tmp_path):
+    model, prefix = _save_plain(tmp_path)
+    pred = inference.create_predictor(inference.Config(prefix))
+    monitor.stat_reset("inference.pad_hits")
+    monitor.stat_reset("inference.compile_misses")
+    model.eval()
+    for n in (3, 5, 6, 3):
+        x = np.random.RandomState(n).standard_normal(
+            (n, 4)).astype(np.float32)
+        got, = pred.run([x])
+        assert np.asarray(got).shape == (n, 2)      # sliced back
+        want = model(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3,
+                                   atol=1e-5)
+    # 3 -> compile 4; 5,6 -> compile 8; second 3 -> pad hit, no compile
+    assert pred.num_compiled_variants() == 2
+    assert monitor.get_stat("inference.compile_misses") == 2
+    assert monitor.get_stat("inference.pad_hits") == 2  # 6->8 and 3->4
+
+
+def test_predictor_pad_prefers_declared_bucket(tmp_path):
+    _, prefix = _save_plain(tmp_path)
+    config = inference.Config(prefix)
+    config.add_shape_bucket((6, 4))
+    pred = inference.create_predictor(config)
+    n0 = pred.num_compiled_variants()
+    got, = pred.run([np.ones((5, 4), np.float32)])
+    # 5 fits the declared 6-bucket: served from it, not from pow2(5)=8
+    assert pred.num_compiled_variants() == n0
+    assert np.asarray(got).shape == (5, 2)
+
+
+def test_predictor_pad_policy_none_restores_legacy(tmp_path):
+    _, prefix = _save_plain(tmp_path)
+    config = inference.Config(prefix)
+    config.set_batch_pad_policy("none")
+    pred = inference.create_predictor(config)
+    n0 = pred.num_compiled_variants()
+    for n in (3, 5, 6):
+        pred.run([np.ones((n, 4), np.float32)])
+    assert pred.num_compiled_variants() == n0 + 3   # one per size
+    with pytest.raises(ValueError, match="pad policy"):
+        config.set_batch_pad_policy("bogus")
+
+
+def test_predictor_share_external_data_accepts_list(tmp_path):
+    model, prefix = _save_plain(tmp_path)
+    pred = inference.create_predictor(inference.Config(prefix))
+    name = pred.get_input_names()[0]
+    pred.get_input_handle(name).share_external_data(
+        [[0.5, 1.0, -0.25, 2.0]])          # bare list, no .dtype
+    out, = pred.run()
+    assert np.asarray(out).shape == (1, 2)
+
+
+def test_predictor_int64_bucket_aot_hits(tmp_path):
+    """AOT bucket keys must canonicalize dtypes (i64->i32) exactly like
+    run(), or int64 artifacts recompile on first serve."""
+    paddle.seed(3)
+    model = nn.Embedding(10, 4)
+    prefix = os.path.join(str(tmp_path), "emb")
+    jit.save(model, prefix, input_spec=[InputSpec([None], "int64")])
+    config = inference.Config(prefix)
+    config.add_shape_bucket((6,))
+    pred = inference.create_predictor(config)
+    n0 = pred.num_compiled_variants()
+    assert n0 >= 1
+    out, = pred.run([np.arange(6, dtype=np.int64)])
+    assert pred.num_compiled_variants() == n0   # AOT variant hit
+    assert np.asarray(out).shape == (6, 4)
+    out, = pred.run([np.arange(5, dtype=np.int64)])
+    assert pred.num_compiled_variants() == n0   # padded into the bucket
+    assert np.asarray(out).shape == (5, 4)
+
+
+def test_predictor_float64_input_canonicalized(tmp_path):
+    """f64 feeds must land in the SAME f32 variant jnp.asarray produces
+    (x64-disabled jax), not compile a phantom f64 signature."""
+    _, prefix = _save_plain(tmp_path)
+    pred = inference.create_predictor(inference.Config(prefix))
+    pred.run([np.ones((4, 4), np.float32)])
+    n0 = pred.num_compiled_variants()
+    out, = pred.run([np.ones((4, 4), np.float64)])
+    assert pred.num_compiled_variants() == n0
+    assert np.asarray(out).shape == (4, 2)
+
+
+def test_predictor_pad_flag_default():
+    assert paddle.get_flags("inference_pad_policy")[
+        "inference_pad_policy"] == "bucket"
+    assert inference.Config().batch_pad_policy() == "bucket"
+
+
+class _TwoHead(nn.Layer):
+    """Batched output + a fixed [8, 3] output whose leading dim equals
+    the pad bucket — the trap for shape-heuristic output slicing."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 2)
+
+    def forward(self, x):
+        return self.fc(x), paddle.ones([8, 3])
+
+
+def _save_two_head(tmp_path):
+    paddle.seed(1)
+    model = _TwoHead()
+    prefix = os.path.join(str(tmp_path), "twohead")
+    jit.save(model, prefix, input_spec=[InputSpec([None, 4], "float32")])
+    return prefix
+
+
+def test_predictor_pad_does_not_slice_unbatched_output(tmp_path):
+    prefix = _save_two_head(tmp_path)
+    pred = inference.create_predictor(inference.Config(prefix))
+    assert pred.batched_output_mask() == [True, False]
+    outs = pred.run([np.ones((5, 4), np.float32)])  # pads 5 -> 8
+    assert np.asarray(outs[0]).shape == (5, 2)      # sliced back
+    assert np.asarray(outs[1]).shape == (8, 3)      # NOT mis-sliced
+    np.testing.assert_array_equal(np.asarray(outs[1]), np.ones((8, 3)))
+
+
+def test_engine_does_not_slice_unbatched_output(tmp_path):
+    prefix = _save_two_head(tmp_path)
+    pred = inference.create_predictor(inference.Config(prefix))
+    eng = serving.InferenceEngine(pred, max_batch_size=8,
+                                  batch_timeout_ms=5.0)
+    try:
+        eng.warmup()
+        assert eng._out_mask == [True, False]
+        futs = [eng.infer([np.ones((n, 4), np.float32)])
+                for n in (3, 5)]                    # coalesce to 8 rows
+        for n, f in zip((3, 5), futs):
+            out = f.result(timeout=30)
+            assert out[0].shape == (n, 2)
+            assert out[1].shape == (8, 3)           # whole fixed output
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------- monitor histograms --
+def test_stat_observe_and_quantile():
+    monitor.stat_reset("t.lat")
+    for v in [1.0] * 50 + [10.0] * 45 + [100.0] * 5:
+        monitor.stat_observe("t.lat", v)
+    assert abs(monitor.quantile("t.lat", 0.5) - 1.0) < 0.2
+    assert 8.0 < monitor.quantile("t.lat", 0.9) < 12.0
+    assert 80.0 < monitor.quantile("t.lat", 0.99) < 120.0
+    s = monitor.histogram_summary("t.lat")
+    assert s["count"] == 100
+    assert s["min"] == 1.0 and s["max"] == 100.0
+    assert abs(s["mean"] - (50 + 450 + 500) / 100.0) < 1e-9
+    monitor.stat_reset("t.lat")
+    assert monitor.histogram_summary("t.lat")["count"] == 0
+    assert monitor.quantile("t.lat", 0.5) == 0.0
+
+
+def test_histograms_do_not_disturb_counters():
+    monitor.stat_reset()
+    monitor.stat_add("c", 2)
+    monitor.stat_observe("h", 3.0)
+    assert monitor.all_stats() == {"c": 2}      # counters only
+    assert "h" in monitor.all_histograms()
+    monitor.stat_reset()
+    assert monitor.all_histograms() == {}
+
+
+def test_quantile_extremes_are_exact():
+    monitor.stat_reset("t.q")
+    for v in (0.5, 2.0, 7.0):
+        monitor.stat_observe("t.q", v)
+    assert monitor.quantile("t.q", 0.0) == 0.5
+    assert monitor.quantile("t.q", 1.0) == 7.0
+
+
+# ------------------------------------------------------- smoke gates --
+def test_serve_smoke_in_process():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import serve_smoke
+        failures = serve_smoke.run_checks(requests=32, clients=4)
+    finally:
+        sys.path.pop(0)
+    assert failures == [], failures
+
+
+def test_serving_chaos_in_process():
+    from paddle_tpu.testing import chaos
+    assert chaos.serving_main(requests=24, clients=3) == 0

@@ -1,18 +1,27 @@
 #!/usr/bin/env python
-"""Chaos smoke test: preemption-safe training under injected faults.
+"""Chaos smoke test: training and serving under injected faults.
 
-Runs :func:`paddle_tpu.testing.chaos.main` — a tiny train loop twice
-(fault-free vs under the canned chaos spec: checkpoint-fs write flakes,
-one DataLoader worker hard-killed mid-epoch, SIGTERM mid-training) —
-and exits non-zero unless the faulted run resumes to completion with
-bitwise-identical final parameters.
+Scenarios (``--scenario``, default ``all``):
+
+- ``training`` — :func:`paddle_tpu.testing.chaos.main`: a tiny train
+  loop twice (fault-free vs under the canned chaos spec: checkpoint-fs
+  write flakes, one DataLoader worker hard-killed mid-epoch, SIGTERM
+  mid-training); fails unless the faulted run resumes to completion
+  with bitwise-identical final parameters.
+- ``serving`` — :func:`paddle_tpu.testing.chaos.serving_main`: the
+  dynamic-batching engine under injected dispatcher flakes, queue-full
+  shedding, and in-queue deadline expiry; fails unless every accepted
+  request gets a bitwise-correct response or a clean shed/deadline
+  error — never a hang or a wrong answer.
 
 Usage::
 
-    python tools/chaos_smoke.py [--epochs 4] [--verbose]
+    python tools/chaos_smoke.py [--scenario all|training|serving]
+                                [--epochs 4] [--verbose]
 
-CI treats a non-zero exit as a robustness regression.  The same flow
-runs in-process from tests/test_fault_tolerance.py.
+CI treats a non-zero exit as a robustness regression.  The same flows
+run in-process from tests/test_fault_tolerance.py and
+tests/test_serving.py.
 """
 from __future__ import annotations
 
@@ -27,11 +36,18 @@ if REPO not in sys.path:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument("--scenario", default="all",
+                    choices=["all", "training", "serving"])
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     from paddle_tpu.testing import chaos
-    return chaos.main(epochs=args.epochs, verbose=args.verbose)
+    rc = 0
+    if args.scenario in ("all", "training"):
+        rc |= chaos.main(epochs=args.epochs, verbose=args.verbose)
+    if args.scenario in ("all", "serving"):
+        rc |= chaos.serving_main(verbose=args.verbose)
+    return rc
 
 
 if __name__ == "__main__":

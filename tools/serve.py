@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Serve a saved inference artifact over HTTP.
+
+Load the artifact (the ``.pdmodel`` prefix written by ``paddle.jit.save``
+/ ``paddle.static.save_inference_model``), warm up the batch buckets so
+the hot path never compiles, and serve:
+
+    python tools/serve.py /path/to/model_prefix --port 8000
+
+    curl localhost:8000/healthz
+    curl localhost:8000/metrics
+    curl -X POST localhost:8000/predict \
+         -H 'Content-Type: application/json' \
+         -d '{"inputs": [[[0.1, 0.2, 0.3, 0.4]]]}'
+
+``inputs`` is a list of per-input arrays (or a name->array dict), each
+with a leading batch dim.  SIGINT/SIGTERM drain in-flight work before
+exit.  See README "Serving" for bucket/padding and backpressure
+semantics.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[1],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    ap.add_argument("model", help="artifact path prefix (as passed to "
+                                  "jit.save / save_inference_model)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-batch-size", type=int, default=32)
+    ap.add_argument("--batch-timeout-ms", type=float, default=2.0)
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="default per-request in-queue deadline")
+    ap.add_argument("--buckets", default=None,
+                    help="comma-separated batch buckets to pad to "
+                         "(default: powers of two up to max batch)")
+    ap.add_argument("--rest-shape", action="append", default=None,
+                    metavar="D0,D1,...",
+                    help="per-input shape without the batch dim, once per "
+                         "input (only needed when the artifact's non-batch "
+                         "dims are symbolic)")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip AOT warmup (first requests will compile)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="log every HTTP request")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import inference, serving
+
+    config = inference.Config(args.model)
+    predictor = inference.create_predictor(config)
+    buckets = ([int(b) for b in args.buckets.split(",")]
+               if args.buckets else None)
+    engine = serving.InferenceEngine(
+        predictor, max_batch_size=args.max_batch_size,
+        batch_timeout_ms=args.batch_timeout_ms, max_queue=args.max_queue,
+        default_deadline_ms=args.deadline_ms, buckets=buckets)
+    if not args.no_warmup:
+        rest = ([tuple(int(d) for d in s.split(","))
+                 for s in args.rest_shape] if args.rest_shape else None)
+        n = engine.warmup(rest_shapes=rest)
+        print(f"warmed {len(engine.buckets)} buckets "
+              f"{engine.buckets} -> {n} compiled variants", flush=True)
+
+    srv = serving.ServingServer(engine, host=args.host, port=args.port,
+                                verbose=args.verbose)
+    stop = {"sig": None}
+
+    def _on_signal(signum, frame):
+        stop["sig"] = signum
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    print(f"serving {args.model} on {srv.url}  "
+          f"(POST /predict, GET /healthz, GET /metrics)", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        print("draining...", flush=True)
+        srv.close()
+        engine.drain(timeout=30.0)
+        engine.close()
+        c = engine.stats()["counters"]
+        print(f"served {c['responses']}/{c['requests']} requests in "
+              f"{c['batches']} batches (shed={c['shed']}, "
+              f"expired={c['deadline_expired']})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""CI smoke gate for the serving engine (sibling of bench_smoke.py /
+chaos_smoke.py).
+
+Drives a short engine run on CPU — tiny model, burst of ragged
+concurrent requests — and exits non-zero when the serving hot path
+regresses:
+
+1. **recompiles** — after ``warmup()`` the dispatcher must always pad
+   into a precompiled bucket; any hot-path compile means the
+   bucket/padding strategy broke (``recompiles_after_warmup != 0``).
+2. **batch occupancy** — coalescing must actually happen: burst-submitted
+   requests have to ride shared micro-batches (mean occupancy above a
+   floor AND > 1 request per batch on average).
+3. **stuck futures** — after ``close()`` every accepted request's future
+   must be resolved (result or clean error); a pending future is a hang
+   a real client would have felt.
+4. **correctness under load** — every response must match the
+   single-request Predictor answer bitwise (dyadic weights/inputs make
+   float accumulation exact, so batching/padding cannot hide behind
+   tolerance).
+
+Usage:  python tools/serve_smoke.py [--requests N] [--clients C]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+OCCUPANCY_FLOOR = 0.5
+COALESCE_FLOOR = 1.5        # mean requests per batch under burst load
+
+
+def run_checks(requests: int = 64, clients: int = 8,
+               verbose: bool = False) -> list:
+    """Returns a list of failure strings (empty = healthy)."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, jit, nn, serving
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.testing.chaos import make_dyadic_model
+
+    failures = []
+    paddle.seed(11)
+    model = make_dyadic_model(in_dim=8, hidden=16, out_dim=4)
+    prefix = os.path.join(tempfile.mkdtemp(prefix="serve_smoke_"), "m")
+    jit.save(model, prefix, input_spec=[InputSpec([None, 8], "float32")])
+    pred = inference.create_predictor(inference.Config(prefix))
+
+    engine = serving.InferenceEngine(pred, max_batch_size=8,
+                                     batch_timeout_ms=10.0,
+                                     max_queue=2 * requests)
+    warm = engine.warmup()
+    if verbose:
+        print(f"warmed buckets {engine.buckets}: {warm} variants")
+
+    rng = np.random.RandomState(3)
+    reqs = [(rng.randint(-8, 9, (rng.randint(1, 5), 8)) / 4.0)
+            .astype(np.float32) for _ in range(requests)]
+    refs = [np.asarray(pred.run([x])[0]) for x in reqs]
+    base_variants = pred.num_compiled_variants()
+
+    # burst submission: every client enqueues its whole share before
+    # waiting, so the dispatcher always has a populated queue to
+    # coalesce from — makes the occupancy gate deterministic
+    futures = [None] * requests
+    def client(idx):
+        for i in range(idx, requests, clients):
+            futures[i] = engine.infer(reqs[i])
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = []
+    for f in futures:
+        try:
+            results.append(f.result(timeout=60))
+        except Exception as e:      # noqa: BLE001 - recorded, gated below
+            results.append(e)
+
+    engine.drain(timeout=30)
+    stats = engine.stats()
+    engine.close()
+
+    for i, (res, ref) in enumerate(zip(results, refs)):
+        if isinstance(res, Exception):
+            failures.append(f"request {i} failed: "
+                            f"{type(res).__name__}: {res}")
+        elif not np.array_equal(res[0], ref):
+            failures.append(
+                f"request {i}: batched response differs from the "
+                f"single-request answer (max "
+                f"|d|={np.abs(res[0] - ref).max():.3e})")
+    if pred.num_compiled_variants() != base_variants \
+            or stats["recompiles_after_warmup"] != 0:
+        failures.append(
+            f"hot-path recompiles: {stats['recompiles_after_warmup']} "
+            f"after warmup (bucket padding must keep the compile cache "
+            f"hot)")
+    if stats["mean_batch_occupancy"] < OCCUPANCY_FLOOR:
+        failures.append(
+            f"batch occupancy {stats['mean_batch_occupancy']:.2f} below "
+            f"floor {OCCUPANCY_FLOOR} (padding waste too high)")
+    if stats["requests_per_batch"] < COALESCE_FLOOR:
+        failures.append(
+            f"coalescing regression: {stats['requests_per_batch']:.2f} "
+            f"requests/batch under burst load (floor {COALESCE_FLOOR})")
+    unresolved = [i for i, f in enumerate(futures) if not f.done()]
+    if unresolved:
+        failures.append(f"stuck futures after close(): {unresolved}")
+    if verbose:
+        print(f"occupancy={stats['mean_batch_occupancy']:.2f} "
+              f"reqs/batch={stats['requests_per_batch']:.2f} "
+              f"batches={stats['counters']['batches']} "
+              f"p95={stats['latency_ms']['p95']:.1f}ms")
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    failures = run_checks(requests=args.requests, clients=args.clients,
+                          verbose=args.verbose)
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("serve_smoke: engine healthy (0 hot-path recompiles, coalesced "
+          "batches, bitwise-correct responses, no stuck futures)")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())
