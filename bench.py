@@ -102,10 +102,24 @@ def _retry_bench(fn, *args, attempts=3):
 
     Retries rebuild the model from scratch: after a failed dispatch the
     donated input buffers of the in-flight step are in an undefined
-    state, so resuming the same step loop is unsound."""
+    state, so resuming the same step loop is unsound.
+
+    Every suite's result embeds the monitor-counter DELTA its run
+    produced (``monitor_counters``: compile counts, pad hits, fs/batch
+    retries, ...) so a BENCH_r0*.json trajectory explains a perf delta
+    — "0.8x because 40 recompiles" — instead of just reporting it."""
+    from paddle_tpu.utils import monitor
     for i in range(attempts):
+        before = monitor.all_stats()
         try:
-            return fn(*args)
+            res = fn(*args)
+            if isinstance(res, dict):
+                after = monitor.all_stats()
+                delta = {k: after[k] - before.get(k, 0)
+                         for k in sorted(after)
+                         if after[k] != before.get(k, 0)}
+                res["monitor_counters"] = delta
+            return res
         except Exception as e:  # noqa: BLE001 - classify then re-raise
             if i == attempts - 1 or not _is_transient(e):
                 raise

@@ -66,6 +66,7 @@ from . import static  # noqa: E402
 from . import inference  # noqa: E402
 from . import serving  # noqa: E402
 from . import profiler  # noqa: E402
+from . import observability  # noqa: E402
 from . import utils  # noqa: E402
 from . import quantization  # noqa: E402
 from .parallel import DataParallel  # noqa: E402

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import autograd, profiler_hook, static_hooks
+from . import autograd, obs_hook, profiler_hook, static_hooks
 from .enforce import with_op_hint
 from .flags import get_flag
 
@@ -171,8 +171,12 @@ def apply(fn: Callable, *inputs, op_name: str | None = None,
                 diff_idx.append(i)
 
     # host-op profiling (reference: RecordEvent inside Tracer::TraceOp)
+    # + structured op tracing: both gated so the disabled path is one
+    # module-attribute None-check each (observability contract)
     prof = profiler_hook.current()
-    t_prof = time.perf_counter() if prof is not None else None
+    trc = obs_hook._tracer
+    t_prof = (time.perf_counter()
+              if (prof is not None or trc is not None) else None)
 
     try:
         if diff_idx:
@@ -213,6 +217,8 @@ def apply(fn: Callable, *inputs, op_name: str | None = None,
                         o, jax.core.Tracer):
                     o.block_until_ready()
         prof._record(name, time.perf_counter() - t_prof)
+    if trc is not None:
+        trc.op(name, t_prof, time.perf_counter())
 
     multi = isinstance(outs, (tuple, list))
     out_seq = list(outs) if multi else [outs]

@@ -8,10 +8,24 @@ name the way ``AppendErrorOpHint`` does (reference: imperative/tracer.cc:188).
 """
 from __future__ import annotations
 
+from . import obs_hook
+
 
 class EnforceError(RuntimeError):
-    """Base of the taxonomy (reference: error_codes.proto)."""
+    """Base of the taxonomy (reference: error_codes.proto).
+
+    When a flight recorder is installed (observability), constructing
+    any error in the taxonomy dumps the black box — the framework's
+    typed failures are exactly the crashes worth a post-mortem.  The
+    handler dedups by exception object, so a later re-report (e.g. the
+    Executor catching this error) never double-dumps."""
     code = "LEGACY"
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        h = obs_hook._crash
+        if h is not None:
+            h(self, f"enforce.{type(self).__name__}")
 
 
 class InvalidArgumentError(EnforceError, ValueError):

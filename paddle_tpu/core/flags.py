@@ -151,6 +151,18 @@ define_flag("serving_dispatch_retries", 2,
             "InferenceEngine: batch dispatch attempts after a failure "
             "before the batch's requests are failed (inference is pure, "
             "so a flaked dispatch is safely retried).")
+define_flag("metrics_dump_path", "",
+            "When set, training appends periodic monitor-metrics "
+            "snapshots (stats + histograms, one JSON object per line) "
+            "to this JSONL file — Model.fit auto-attaches the "
+            "hapi.callbacks.MetricsDump callback; other loops can call "
+            "observability.dump_metrics() directly.")
+define_flag("flight_recorder_path", "",
+            "Default dump path for the crash flight recorder "
+            "(observability.install_flight_recorder).  On EnforceError, "
+            "an exception escaping Executor.run, SIGTERM or an "
+            "unhandled exception, the last tracer events + a full "
+            "metrics snapshot are written here atomically.")
 define_flag("pallas_attention_dropout_min_seqlen", 512,
             "Flash threshold when attention dropout is active: the XLA "
             "path must materialize [B,H,L,L] dropout masks in HBM, so "

@@ -1,0 +1,34 @@
+"""Observability hook shared by every instrumented layer.
+
+Lives in core so hot paths (eager dispatch, Executor.run, the serving
+dispatcher) pay ONE module-attribute None-check when tracing is off —
+the same gating pattern as :mod:`core.profiler_hook`.  Instrumented
+sites read ``obs_hook._tracer`` directly (a single LOAD_ATTR, no call)
+and only touch the tracer object when it is not None; the crash hook
+``_crash`` gates the flight recorder the same way.
+
+This module must stay import-free: it is pulled in by core, utils, io
+and serving alike, and a single stray import here would cycle."""
+from __future__ import annotations
+
+_tracer = None      # paddle_tpu.observability.Tracer when enabled
+_crash = None       # callable(exc, context_str) when a flight
+                    # recorder is installed
+
+
+def set_tracer(tracer) -> None:
+    global _tracer
+    _tracer = tracer
+
+
+def current():
+    return _tracer
+
+
+def set_crash_handler(fn) -> None:
+    global _crash
+    _crash = fn
+
+
+def crash_handler():
+    return _crash

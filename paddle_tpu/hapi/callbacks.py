@@ -174,6 +174,37 @@ class Checkpoint(Callback):
         self.on_train_cleanup()
 
 
+class MetricsDump(Callback):
+    """Append monitor-metrics snapshots (stats + histograms) as JSONL
+    while ``Model.fit`` runs — the training-side feed of the unified
+    metrics exporter (``observability.dump_metrics``).
+
+    One line per ``save_freq`` epochs plus one at train end; each line
+    is a full ``observability.metrics_snapshot`` tagged with the epoch.
+    ``path`` defaults to ``FLAGS_metrics_dump_path``; when that flag is
+    set, ``Model.fit`` attaches this callback automatically."""
+
+    def __init__(self, path=None, save_freq=1):
+        super().__init__()
+        self.path = path
+        self.save_freq = max(1, int(save_freq))
+
+    def _dump(self, tag, extra=None):
+        from ..core.flags import get_flag
+        path = self.path or get_flag("metrics_dump_path")
+        if not path:
+            return
+        from ..observability import dump_metrics
+        dump_metrics(path, extra={"tag": tag, **(extra or {})})
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (epoch + 1) % self.save_freq == 0:
+            self._dump("epoch_end", {"epoch": epoch})
+
+    def on_train_end(self, logs=None):
+        self._dump("train_end")
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
                  min_delta=0, baseline=None, save_best_model=True):
@@ -351,6 +382,10 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
         cbks.append(ProgBarLogger(verbose=verbose))
     if not any(isinstance(c, LRScheduler) for c in cbks):
         cbks.append(LRScheduler())
+    from ..core.flags import get_flag
+    if get_flag("metrics_dump_path") and not any(
+            isinstance(c, MetricsDump) for c in cbks):
+        cbks.append(MetricsDump())
     cl = CallbackList(cbks)
     cl.set_model(model)
     cl.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,

@@ -12,6 +12,7 @@ path (the analog of the reference's ZeroCopyTensor path).
 """
 from __future__ import annotations
 
+import itertools
 import pickle
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -148,8 +149,11 @@ class Tensor:
 class Predictor:
     """reference: inference/api/analysis_predictor.h:82."""
 
+    _SERIALS = itertools.count(1)
+
     def __init__(self, config: Config):
         self.config = config
+        self._serial = f"predictor#{next(Predictor._SERIALS)}"
         if config.params_file:
             # weights are baked into the StableHLO artifact at save time;
             # a swapped .pdiparams cannot be injected — fail loudly rather
@@ -258,6 +262,16 @@ class Predictor:
             fn = fn.lower(*avals).compile()  # AOT: no trace on serve path
             self._compiled[key] = fn
             self._register_bucket(shapes_dtypes)
+            # recompile attribution AFTER the lower/compile succeeded —
+            # a failing (and retried) compile must not record identical
+            # signatures and read as "unexplained".  After load, every
+            # further compile is a new shape bucket (or donation-set
+            # change).
+            from ..observability import record_compile
+            record_compile("predictor", self._serial, {
+                "bucket": tuple(shapes_dtypes),
+                "undonated_inputs": tuple(sorted(no_donate)),
+            }, note="serve-path miss" if from_run else "aot")
         return fn
 
     def _aot_compile(self):

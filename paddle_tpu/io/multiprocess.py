@@ -285,6 +285,11 @@ class _WorkerPool:
         self._spawn(w, respawn=True, replace=True)
         self.restarts += 1
         monitor.stat_add("dataloader.worker_restarts")
+        from ..core import obs_hook
+        trc = obs_hook._tracer
+        if trc is not None:
+            trc.emit("worker_restart", "dataloader.worker",
+                     args={"worker": w, "exitcode": code})
         return code
 
     def drain_worker(self, w, handler):

@@ -14,6 +14,7 @@ traced program into dygraph autograd.
 from __future__ import annotations
 
 import functools
+import itertools
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -55,12 +56,17 @@ class StaticFunction:
     single differentiable op.
     """
 
+    _SERIALS = itertools.count(1)
+
     def __init__(self, fn: Callable, input_spec=None, layer=None):
         self._fn = fn.forward if layer is not None and fn is layer else fn
         self._layer = layer if layer is not None else _find_layer(fn)
         self._input_spec = input_spec
         self._cache: Dict[Any, Callable] = {}
         self._fn = self._convert_control_flow(self._fn)
+        # recompile-attribution identity (id() could be recycled)
+        self._serial = (f"{getattr(self._fn, '__name__', 'to_static')}"
+                        f"#{next(StaticFunction._SERIALS)}")
         functools.update_wrapper(self, self._fn)
 
     @staticmethod
@@ -83,7 +89,10 @@ class StaticFunction:
     def layer(self):
         return self._layer
 
-    def _build(self, treedef, n_tensors, static_leaves, training):
+    _NONCE = itertools.count(1)
+
+    def _build(self, treedef, n_tensors, static_leaves, training,
+               recompile_field=None):
         layer = self._layer
         fn = self._fn
         n_p = len(param_list(layer)) if layer else 0
@@ -119,6 +128,19 @@ class StaticFunction:
                 is_leaf=lambda x: isinstance(x, Tensor))
             return out_arrays, tuple(new_b)
 
+        # recompile attribution once the build assembled (a cache miss
+        # at this layer = a fresh trace+compile at first call): new
+        # input structure / static-arg values, or a training flip.
+        # recompile_field marks builds that bypass the cache by design
+        # (unhashable static leaves, AOT export) so they read as a
+        # named cause instead of "unexplained".
+        from ..observability import record_compile
+        sig = {}
+        if recompile_field is not None:
+            sig[recompile_field] = next(StaticFunction._NONCE)
+        sig["input_structure"] = (str(treedef), repr(static_leaves))
+        sig["training"] = training
+        record_compile("jit", self._serial, sig)
         return jax.jit(pure_fn)
 
     def __call__(self, *args, **kwargs):
@@ -141,8 +163,10 @@ class StaticFunction:
             key = None
             compiled = None
         if compiled is None:
-            compiled = self._build(treedef, len(tensor_args), static_leaves,
-                                   training)
+            compiled = self._build(
+                treedef, len(tensor_args), static_leaves, training,
+                recompile_field=(None if key is not None
+                                 else "uncacheable_call"))
             if key is not None:
                 self._cache[key] = compiled
 
@@ -195,7 +219,7 @@ class StaticFunction:
             _TENSOR_SENTINEL if isinstance(l, Tensor) else l for l in leaves)
         training = bool(self._layer.training) if self._layer else False
         compiled = self._build(treedef, len(tensor_args), static_leaves,
-                               training)
+                               training, recompile_field="export_call")
         return compiled, tensor_args
 
 

@@ -1,0 +1,104 @@
+"""paddle_tpu.observability — unified tracing, metrics and post-mortems.
+
+The reproduction's four telemetry islands (profiler host spans,
+``utils.monitor`` gauges/histograms, the serving ``/metrics`` endpoint,
+``fault.fired.*`` counters) correlate here:
+
+- :func:`enable` installs a process-wide :class:`Tracer` — a ring
+  buffer of typed events (spans, eager op dispatches, compiles, worker
+  restarts, checkpoint save/restore/fallback, serving dispatches,
+  fault fires) with step/request correlation ids, exportable as
+  chrome-trace JSON or JSONL.  Disabled (the default), every
+  instrumented hot path pays one module-attribute None-check
+  (``core.obs_hook``, same pattern as ``core.profiler_hook``).
+- :func:`explain_compiles` attributes every XLA compile the static
+  Executor, the jit layer and the inference Predictor performed to a
+  named cause (new program version, new feed signature, new bucket,
+  ...) with a diff against the previous signature — always on, counted
+  per-cause in ``monitor``.
+- :func:`prometheus_text` / :func:`metrics_snapshot` /
+  :func:`dump_metrics` export the whole monitor registry as Prometheus
+  text exposition or JSON (``serving/http.py`` content-negotiates
+  ``/metrics``; ``hapi.callbacks.MetricsDump`` +
+  ``FLAGS_metrics_dump_path`` append JSONL from training).
+- :func:`install_flight_recorder` arms the crash flight recorder:
+  EnforceError / executor exceptions / SIGTERM / sys.excepthook dump
+  the last N events + full metrics snapshot atomically for post-mortem.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from ..core import obs_hook
+from .compiles import explain_compiles, record_compile, reset_compiles
+from .flight import (dump_flight, flight_recorder_path,
+                     install_flight_recorder, uninstall_flight_recorder)
+from .metrics import dump_metrics, metrics_snapshot, prometheus_text
+from .tracer import EVENT_KINDS, Tracer
+
+__all__ = [
+    "Tracer", "EVENT_KINDS", "enable", "disable", "enabled",
+    "get_tracer", "emit", "span", "counter", "set_step",
+    "record_compile", "explain_compiles", "reset_compiles",
+    "prometheus_text", "metrics_snapshot", "dump_metrics",
+    "install_flight_recorder", "uninstall_flight_recorder",
+    "dump_flight", "flight_recorder_path",
+]
+
+
+def enable(capacity: int = 8192, trace_ops: bool = True) -> Tracer:
+    """Install (and return) a fresh process-wide tracer."""
+    t = Tracer(capacity=capacity, trace_ops=trace_ops)
+    obs_hook.set_tracer(t)
+    return t
+
+
+def disable() -> None:
+    """Remove the tracer; instrumented sites return to the one
+    None-check disabled path."""
+    obs_hook.set_tracer(None)
+
+
+def enabled() -> bool:
+    return obs_hook.current() is not None
+
+
+def get_tracer() -> Optional[Tracer]:
+    return obs_hook.current()
+
+
+def emit(kind: str, name: str, **args) -> None:
+    """Emit one event on the active tracer; no-op when disabled."""
+    t = obs_hook._tracer
+    if t is not None:
+        t.emit(kind, name, args=args or None)
+
+
+def counter(name: str, delta=1, value=None) -> None:
+    """Emit a counter-delta event; no-op when disabled."""
+    t = obs_hook._tracer
+    if t is not None:
+        t.counter(name, delta, value=value)
+
+
+def set_step(step: int) -> None:
+    """Set the step correlation id on the active tracer (no-op when
+    disabled)."""
+    t = obs_hook._tracer
+    if t is not None:
+        t.set_step(step)
+
+
+@contextlib.contextmanager
+def span(name: str, **args):
+    """Span context manager; a no-op (still yields) when disabled."""
+    t = obs_hook._tracer
+    if t is None:
+        yield None
+        return
+    sid = t.begin_span(name, **args)
+    try:
+        yield sid
+    finally:
+        t.end_span(sid)

@@ -1,0 +1,126 @@
+"""Recompile attribution: every XLA compile gets a named cause.
+
+The three compiling layers — the static Executor, the jit
+(to_static) cache, and the inference Predictor — report each compile
+here with a structured *signature* (an ordered dict of the cache-key
+components that could have forced it).  Attribution is central: the
+previous signature for the same (component, identity) is diffed against
+the new one, the first changed field (in the caller's significance
+order) names the cause — ``new_program_version``, ``new_feed_signature``,
+``new_bucket``, ... — and the diff itself is kept so
+:func:`explain_compiles` can show *what* changed, not just that
+something did.  A compile whose signature matches its predecessor
+exactly is ``unexplained`` — the smoke gate (tools/obs_smoke.py)
+asserts that count stays 0.
+
+Always on: compiles are rare and cost seconds, so attribution is not
+gated behind ``observability.enable()`` — only the tracer *event* per
+compile is.  Each record also counts ``compiles.<component>.<cause>``
+and ``compiles.total`` in monitor, so bench/CI trajectories explain
+perf deltas per cause.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..core import obs_hook
+from ..utils import monitor
+
+__all__ = ["record_compile", "explain_compiles", "reset_compiles"]
+
+_MAX_RECORDS = 2048          # ring of full records; totals never drop
+
+_lock = threading.Lock()
+_records: collections.deque = collections.deque(maxlen=_MAX_RECORDS)
+_prev: Dict[Tuple[str, object], dict] = {}
+_totals: collections.Counter = collections.Counter()
+
+
+def _freeze(v):
+    """Signature values must be hashable/comparable; stringify the rest."""
+    if isinstance(v, (int, float, bool, str, bytes, type(None))):
+        return v
+    if isinstance(v, (tuple, list)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, (set, frozenset)):
+        return tuple(sorted(_freeze(x) for x in v))
+    return repr(v)
+
+
+def record_compile(component: str, identity, signature: Dict[str, object],
+                   note: str = "") -> dict:
+    """Report one compile.
+
+    ``component``: "executor" | "jit" | "predictor" | ... .
+    ``identity``: what recompiles are diffed against — the program
+    serial, the StaticFunction instance serial, the Predictor serial.
+    ``signature``: ordered cache-key components, most significant
+    first; the first field that differs from the previous compile of
+    the same identity names the cause (``new_<field>``).
+    """
+    sig = {k: _freeze(v) for k, v in signature.items()}
+    now = time.time()
+    with _lock:
+        prev = _prev.get((component, identity))
+        if prev is None:
+            cause = "first_compile"
+            changed: Dict[str, tuple] = {}
+        else:
+            changed = {k: (prev.get(k), v) for k, v in sig.items()
+                       if prev.get(k) != v}
+            if changed:
+                cause = "new_" + next(k for k in sig if k in changed)
+            else:
+                cause = "unexplained"
+        _prev[(component, identity)] = sig
+        rec = {
+            "time": now,
+            "component": component,
+            "identity": identity,
+            "cause": cause,
+            "changed": changed,
+            "signature": sig,
+        }
+        if note:
+            rec["note"] = note
+        _records.append(rec)
+        _totals[(component, cause)] += 1
+    monitor.stat_add(f"compiles.{component}.{cause}")
+    monitor.stat_add("compiles.total")
+    trc = obs_hook._tracer
+    if trc is not None:
+        trc.emit("compile", f"{component}.compile",
+                 args={"cause": cause, "identity": str(identity),
+                       "changed": sorted(changed)})
+    return rec
+
+
+def explain_compiles(component: Optional[str] = None) -> dict:
+    """Why did every compile happen?
+
+    Returns ``{"total", "unexplained", "by_cause": {"component.cause":
+    n}, "records": [...]}`` — ``records`` keeps the newest
+    ``_MAX_RECORDS`` full entries (cause + field-level diff), the
+    totals cover the whole process lifetime.  ``component`` filters
+    both."""
+    with _lock:
+        recs = [dict(r) for r in _records
+                if component is None or r["component"] == component]
+        totals = {f"{c}.{cause}": n for (c, cause), n in _totals.items()
+                  if component is None or c == component}
+    total = sum(totals.values())
+    unexplained = sum(n for k, n in totals.items()
+                      if k.endswith(".unexplained"))
+    return {"total": total, "unexplained": unexplained,
+            "by_cause": dict(sorted(totals.items())), "records": recs}
+
+
+def reset_compiles() -> None:
+    """Drop attribution history (tests / fresh smoke runs)."""
+    with _lock:
+        _records.clear()
+        _prev.clear()
+        _totals.clear()

@@ -1,0 +1,99 @@
+"""Metrics export: monitor gauges + histograms as Prometheus text or JSON.
+
+One exporter for every telemetry island: ``monitor.all_stats()`` /
+``all_histograms()`` (which the serving engine, fs retry loop,
+checkpoint store, fault injector and compile attribution all feed)
+render as
+
+- **Prometheus text exposition** (:func:`prometheus_text`) — gauges per
+  stat, ``summary`` metrics per histogram (p50/p95/p99 quantile labels
+  plus ``_sum``/``_count``), names sanitized to the Prometheus charset
+  under a ``paddle_tpu_`` prefix.  ``serving/http.py`` serves this from
+  ``/metrics`` when the scraper's Accept header asks for text.
+- **JSON snapshots** (:func:`metrics_snapshot`) — the same registry as
+  one timestamped dict, appendable as JSONL flight files from training
+  via :func:`dump_metrics` (the ``hapi.callbacks.MetricsDump`` callback
+  + ``FLAGS_metrics_dump_path``).
+"""
+from __future__ import annotations
+
+import json
+import re
+import time
+from typing import Dict, Optional
+
+from ..core import flags
+from ..utils import monitor
+
+__all__ = ["prometheus_text", "metrics_snapshot", "dump_metrics"]
+
+_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_PREFIX = "paddle_tpu_"
+
+
+def _prom_name(name: str) -> str:
+    n = _PREFIX + _BAD.sub("_", name)
+    return n
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def prometheus_text(extra_gauges: Optional[Dict[str, float]] = None
+                    ) -> str:
+    """The whole monitor registry (plus caller-supplied gauges) in
+    Prometheus text exposition format (version 0.0.4)."""
+    stats = monitor.all_stats()
+    hists = monitor.all_histograms()
+    hist_names = {_prom_name(n) for n in hists}
+    lines = []
+    for name in sorted(stats):
+        m = _prom_name(name)
+        if m in hist_names:     # a stat and a histogram sharing a name
+            m += "_stat"        # must not collide in the exposition
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {_fmt(stats[name])}")
+    for name in sorted(hists):
+        m = _prom_name(name)
+        s = hists[name]
+        lines.append(f"# TYPE {m} summary")
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            lines.append(f'{m}{{quantile="{q}"}} {_fmt(s[key])}')
+        lines.append(f"{m}_sum {_fmt(s['sum'])}")
+        lines.append(f"{m}_count {_fmt(int(s['count']))}")
+    for name in sorted(extra_gauges or {}):
+        m = _prom_name(name)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {_fmt(extra_gauges[name])}")
+    return "\n".join(lines) + "\n"
+
+
+def metrics_snapshot(extra: Optional[dict] = None) -> dict:
+    """Timestamped JSON-ready snapshot of every stat and histogram."""
+    snap = {
+        "time": time.time(),
+        "stats": monitor.all_stats(),
+        "histograms": monitor.all_histograms(),
+    }
+    if extra:
+        snap.update(extra)
+    return snap
+
+
+def dump_metrics(path: Optional[str] = None,
+                 extra: Optional[dict] = None) -> str:
+    """Append one :func:`metrics_snapshot` line to the JSONL flight
+    file at ``path`` (default ``FLAGS_metrics_dump_path``)."""
+    path = path or flags.get_flag("metrics_dump_path")
+    if not path:
+        raise ValueError(
+            "no metrics dump path: pass path= or set "
+            "FLAGS_metrics_dump_path")
+    with open(path, "a") as f:
+        f.write(json.dumps(metrics_snapshot(extra)) + "\n")
+    return path

@@ -25,7 +25,7 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 
-from ..core import profiler_hook
+from ..core import obs_hook, profiler_hook
 
 __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
@@ -52,26 +52,46 @@ class RecordEvent:
     """Named span (reference: platform/profiler.h:127 RecordEvent).
 
     Context manager or ``begin()``/``end()`` pair.  Emits a
-    jax.profiler.TraceAnnotation (shows on the trace's host track) and,
-    when a Profiler is active, accumulates host time under ``name``."""
+    jax.profiler.TraceAnnotation (shows on the trace's host track),
+    accumulates host time under ``name`` when a Profiler is active, and
+    lands on the observability tracer as a nested span (correct parent
+    attribution) when tracing is enabled.
+
+    Robustness contract: ``end()`` without a prior ``begin()`` is a
+    no-op (not a TypeError), ``end()`` is idempotent, and the context
+    manager closes the span even when the body raises."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._ann = None
         self._t0 = None
+        self._span = None
 
     def begin(self):
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
+        trc = obs_hook._tracer
+        if trc is not None:
+            self._span = trc.begin_span(self.name)
         self._t0 = time.perf_counter()
         return self
 
     def end(self):
-        dt = time.perf_counter() - self._t0
-        self._ann.__exit__(None, None, None)
+        t0, self._t0 = self._t0, None
+        if t0 is None:      # begin() never ran, or end() ran already
+            return
+        dt = time.perf_counter() - t0
+        ann, self._ann = self._ann, None
+        if ann is not None:
+            ann.__exit__(None, None, None)
         prof = profiler_hook.current()
         if prof is not None:
             prof._record(self.name, dt, kind="span")
+        span, self._span = self._span, None
+        if span is not None:
+            trc = obs_hook._tracer
+            if trc is not None:
+                trc.end_span(span)
 
     __enter__ = begin
 

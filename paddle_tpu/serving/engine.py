@@ -24,6 +24,7 @@ cf. Ragged Paged Attention, PAPERS.md):
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
 from concurrent.futures import Future
@@ -31,7 +32,7 @@ from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
-from ..core import flags
+from ..core import flags, obs_hook
 from ..testing import fault
 from ..utils import monitor
 
@@ -55,8 +56,11 @@ class EngineClosed(ServingError):
     """The engine is draining or closed; no new requests are accepted."""
 
 
+_REQUEST_IDS = itertools.count(1)   # process-wide request correlation ids
+
+
 class _Request:
-    __slots__ = ("arrays", "rows", "future", "deadline", "t_enq")
+    __slots__ = ("arrays", "rows", "future", "deadline", "t_enq", "rid")
 
     def __init__(self, arrays, rows, deadline):
         self.arrays = arrays
@@ -64,6 +68,7 @@ class _Request:
         self.future: Future = Future()
         self.deadline = deadline            # monotonic seconds, or None
         self.t_enq = time.monotonic()
+        self.rid = next(_REQUEST_IDS)
 
 
 def _safe_set_result(fut: Future, value) -> None:
@@ -249,6 +254,10 @@ class InferenceEngine:
             if len(self._queue) >= self._max_queue:
                 self._c["shed"] += 1
                 monitor.stat_add("serving.shed")
+                trc = obs_hook._tracer
+                if trc is not None:
+                    trc.emit("serving", "shed",
+                             args={"rid": req.rid, "rows": n})
                 raise QueueFull(
                     f"queue full ({self._max_queue} requests); retry with "
                     f"backoff")
@@ -259,6 +268,10 @@ class InferenceEngine:
             self._c["requests"] += 1
             monitor.stat_add("serving.requests")
             self._cv.notify_all()
+        trc = obs_hook._tracer
+        if trc is not None:
+            trc.emit("serving", "enqueue",
+                     args={"rid": req.rid, "rows": n})
         return req.future
 
     def infer_sync(self, inputs, deadline_ms: Optional[float] = None,
@@ -272,6 +285,11 @@ class InferenceEngine:
         self._queued_deadlines -= 1
         self._c["deadline_expired"] += 1
         monitor.stat_add("serving.deadline_expired")
+        trc = obs_hook._tracer
+        if trc is not None:
+            trc.emit("serving", "deadline_expired",
+                     args={"rid": r.rid,
+                           "waited_ms": (now - r.t_enq) * 1000.0})
         _safe_set_exception(r.future, DeadlineExceeded(
             f"deadline expired after "
             f"{(now - r.t_enq) * 1000:.1f} ms in queue"))
@@ -366,6 +384,7 @@ class InferenceEngine:
             feeds.append(a)
         last_exc: Optional[BaseException] = None
         outs = None
+        t_disp = time.perf_counter()
         for attempt in range(self._retries + 1):
             try:
                 fault.point("serving.dispatch",
@@ -381,6 +400,15 @@ class InferenceEngine:
                 if attempt < self._retries:
                     self._c["dispatch_retries"] += 1
                     monitor.stat_add("serving.dispatch_retries")
+        trc = obs_hook._tracer
+        if trc is not None:
+            # one typed event per coalesced dispatch, correlated to the
+            # member requests by id
+            trc.emit("serving", "dispatch", ts=t_disp,
+                     dur=time.perf_counter() - t_disp,
+                     args={"rids": [r.rid for r in batch], "rows": rows,
+                           "bucket": target, "attempts": attempt + 1,
+                           "ok": last_exc is None})
         if last_exc is not None:
             for r in batch:
                 _safe_set_exception(r.future, last_exc)

@@ -13,9 +13,13 @@ dispatcher coalesces into micro-batches):
   JSON responses carry ``outputs``/``names``/``dtypes``; npy requests
   get the first output back as npy bytes.
 - ``GET /healthz`` — 200 while serving, 503 when draining/closed.
-- ``GET /metrics`` — the engine's stats JSON: queue depth, batch
-  occupancy, padding waste, request/shed/deadline counters, latency
-  p50/p95/p99.
+- ``GET /metrics`` — content-negotiated.  Default (and any JSON
+  Accept): the engine's stats JSON — queue depth, batch occupancy,
+  padding waste, request/shed/deadline counters, latency p50/p95/p99.
+  When the Accept header asks for ``text/plain`` / OpenMetrics (what a
+  Prometheus scraper sends): the full ``observability.prometheus_text``
+  exposition — every ``monitor`` stat and histogram in the process plus
+  the engine's own gauges under ``paddle_tpu_serving_engine_*``.
 
 Error mapping: shed -> 503 (+Retry-After), deadline -> 504, malformed
 -> 400, engine closed -> 503.
@@ -91,7 +95,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_json(200 if st in ("running", "paused") else 503,
                              {"status": st})
         elif path == "/metrics":
-            self._reply_json(200, self.engine.stats())
+            accept = (self.headers.get("Accept") or "").lower()
+            if ("text/plain" in accept or "openmetrics" in accept
+                    or "prometheus" in accept):
+                from ..observability import prometheus_text
+                stats = self.engine.stats()
+                gauges = {f"serving_engine_{k}": v
+                          for k, v in stats.items()
+                          if isinstance(v, (int, float))}
+                gauges.update({f"serving_engine_{k}": v
+                               for k, v in stats["counters"].items()})
+                self._reply(200, prometheus_text(gauges).encode(),
+                            ctype="text/plain; version=0.0.4; "
+                                  "charset=utf-8")
+            else:
+                self._reply_json(200, self.engine.stats())
         else:
             self._reply_json(404, {"error": "NotFound", "message": self.path})
 
@@ -284,3 +302,13 @@ class Client:
 
     def metrics(self) -> dict:
         return self._get_json("/metrics")
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition (the scraper's view of /metrics)."""
+        req = urlrequest.Request(self.base_url + "/metrics",
+                                 headers={"Accept": "text/plain"})
+        try:
+            with urlrequest.urlopen(req, timeout=self.timeout) as r:
+                return r.read().decode()
+        except urlerror.HTTPError as e:
+            self._raise_for(e)

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import obs_hook
 from ..core.flags import get_flag
 from ..core.tensor import Tensor
 from .program import Program, Variable, default_main_program
@@ -337,6 +338,26 @@ class Executor:
             return program._run_loaded(feed, fetch_list, return_numpy)
         if program is None:
             program = default_main_program()
+        # observability: a span per run when tracing is on (one
+        # module-attribute None-check when off), and any exception
+        # escaping the step feeds the crash flight recorder before
+        # propagating — the executor is where a training step dies
+        trc = obs_hook._tracer
+        sid = (trc.begin_span("executor.run", program=program._serial)
+               if trc is not None else None)
+        try:
+            return self._run(program, feed, fetch_list, return_numpy,
+                             seed)
+        except Exception as e:
+            h = obs_hook._crash
+            if h is not None:
+                h(e, f"executor.run(program#{program._serial})")
+            raise
+        finally:
+            if sid is not None:
+                trc.end_span(sid)
+
+    def _run(self, program, feed, fetch_list, return_numpy, seed):
         # chaos hook: lets fault specs crash a training step on demand
         # (preemption drills around the checkpoint/restore path)
         from ..testing import fault
@@ -362,6 +383,15 @@ class Executor:
 
         self._track(program)
         donate = bool(get_flag("static_donate"))
+        # per-run counter doubles as the step correlation id: events
+        # this run emits (compiles, checkpoint saves, fault fires)
+        # carry it on the trace
+        run_i = self._run_counts.get(program._serial, 0) + 1
+        self._run_counts[program._serial] = run_i
+        trc = obs_hook._tracer
+        if trc is not None:
+            trc.set_step(run_i)
+
         key = (program._serial, program._version, feed_names,
                tuple((a.shape, str(a.dtype)) for a in feed_arrays),
                tuple(fetch_names), program._optimizer is not None, donate)
@@ -384,6 +414,18 @@ class Executor:
                                    donate)
             self._cache[key] = compiled
             self._compile_count += 1
+            # recompile attribution: the first changed field (most
+            # significant first) names the cause of this compile
+            from ..observability import record_compile
+            record_compile("executor", program._serial, {
+                "program_version": program._version,
+                "feed_signature": tuple(
+                    (tuple(a.shape), str(a.dtype)) for a in feed_arrays),
+                "feed_names": feed_names,
+                "fetch_set": tuple(fetch_names),
+                "optimizer": program._optimizer is not None,
+                "donate": donate,
+            })
 
         state = self._state_for(program, params)
 
@@ -391,8 +433,6 @@ class Executor:
         # random ops fold the per-run key via seed_scope; an explicit
         # ``seed`` reproduces a run, the default auto-increments (the
         # counter lives ON DEVICE for the train path — no upload)
-        run_i = self._run_counts.get(program._serial, 0) + 1
-        self._run_counts[program._serial] = run_i
         if state.seed_val != program.random_seed:
             state.seed_val = program.random_seed
             state.base_key = jax.random.PRNGKey(program.random_seed)
@@ -573,6 +613,15 @@ class Executor:
                                           fetch_names)
             self._legacy_cache[key] = compiled
             self._compile_count += 1
+            from ..observability import record_compile
+            record_compile("executor_legacy", program._serial, {
+                "program_version": program._version,
+                "feed_signature": tuple(
+                    (tuple(a.shape), str(a.dtype)) for a in feed_arrays),
+                "feed_names": feed_names,
+                "fetch_set": tuple(fetch_names),
+                "optimizer": program._optimizer is not None,
+            })
         run_i = self._run_counts.get(program._serial, 0) + 1
         self._run_counts[program._serial] = run_i
         rng_key = jax.random.fold_in(

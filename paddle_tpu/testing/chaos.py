@@ -110,7 +110,10 @@ def _train(ckpt_dir, epochs, num_workers=2, sigterm_after_epoch=None,
 
 
 def main(epochs=4, verbose=False, workdir=None):
+    import json
+
     import paddle_tpu as paddle
+    from paddle_tpu import observability
     from paddle_tpu.testing import fault
     from paddle_tpu.utils import fs, monitor
 
@@ -134,6 +137,12 @@ def main(epochs=4, verbose=False, workdir=None):
             print("== chaos run ==")
         chaos_dir = f"{scheme}://{workdir}/chaos_ckpt"
         monitor.stat_reset()
+        # black box: faulted runs must leave a readable flight record —
+        # the SIGTERM preemption notice triggers the dump (the recorder
+        # installs its handler first; the epoch range's chains to it)
+        flight_path = os.path.join(workdir, "flight_record.json")
+        observability.enable(capacity=4096)
+        observability.install_flight_recorder(path=flight_path)
         fault.arm(CHAOS_SPEC, seed=0)
         try:
             out = _train(chaos_dir, epochs, verbose=verbose,
@@ -152,11 +161,31 @@ def main(epochs=4, verbose=False, workdir=None):
             print("FAIL: resume run ended early", file=sys.stderr)
             return 1
 
+        # the black box must exist and show what actually happened
+        flight_problems = []
+        if not os.path.exists(flight_path):
+            flight_problems.append(
+                "faulted run left no flight-recorder dump")
+        else:
+            with open(flight_path) as f:
+                box = json.load(f)
+            if box.get("reason") != "SIGTERM":
+                flight_problems.append(
+                    f"flight dump reason {box.get('reason')!r}, "
+                    f"expected 'SIGTERM'")
+            kinds = {e.get("kind") for e in box.get("events", [])}
+            if "fault" not in kinds:
+                flight_problems.append(
+                    "flight dump lacks the injected fault event")
+            if "checkpoint" not in kinds:
+                flight_problems.append(
+                    "flight dump lacks checkpoint events")
+
         stats = monitor.all_stats()
         if verbose:
             print("recovery stats:", {k: v for k, v in sorted(
                 stats.items()) if not k.startswith("fault.")})
-        problems = []
+        problems = list(flight_problems)
         if stats.get("fs.retries", 0) < 2:
             problems.append(f"fs flake not retried "
                             f"(fs.retries={stats.get('fs.retries', 0)})")
@@ -175,9 +204,11 @@ def main(epochs=4, verbose=False, workdir=None):
             return 1
         print("chaos_smoke OK: training survived fs flakes, a worker "
               "kill, and SIGTERM preemption with bitwise-identical "
-              "final params")
+              "final params (+ a readable flight-recorder black box)")
         return 0
     finally:
+        observability.uninstall_flight_recorder()
+        observability.disable()
         paddle.set_flags(old_backoff)
         fs._REGISTRY.pop(scheme, None)
         if own_tmp:

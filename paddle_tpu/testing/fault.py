@@ -229,6 +229,14 @@ def _hit(name: str, detail: Tuple) -> None:
             return
     from ..utils import monitor
     monitor.stat_add(f"fault.fired.{name}")
+    from ..core import obs_hook
+    trc = obs_hook._tracer
+    if trc is not None:
+        # the fire lands on the trace BEFORE the raise/exit, so a crash
+        # flight dump always shows the injected fault that caused it
+        trc.emit("fault", name,
+                 args={"detail": [str(d) for d in detail],
+                       "action": rule.action})
     msg = rule.msg or (f"injected fault at '{name}'"
                        + (f" ({', '.join(map(str, detail))})"
                           if detail else ""))

@@ -34,6 +34,7 @@ from typing import Dict, Iterator, List, Optional
 from . import fs as _fsmod
 from . import monitor
 from ..core import flags as _flags
+from ..core import obs_hook as _obs_hook
 from ..framework_io import dumps as _dumps
 from ..framework_io import loads as _loads
 from ..testing import fault
@@ -141,6 +142,10 @@ class SnapshotStore:
         _fsmod.write_atomic(self._meta_path(),
                             json.dumps(meta).encode("utf-8"))
         monitor.stat_add("checkpoint.saves")
+        trc = _obs_hook._tracer
+        if trc is not None:
+            trc.emit("checkpoint", "save",
+                     args={"epoch": int(epoch), "dir": self.dir})
         keep = {s["dir"] for s in snaps}
         for d in self._fs.list(self.dir):
             if d.startswith("epoch_") and d not in keep:
@@ -197,6 +202,11 @@ class SnapshotStore:
             if payloads is None:
                 attempts.append(str(snap.get("dir")))
                 monitor.stat_add("checkpoint.fallbacks")
+                trc = _obs_hook._tracer
+                if trc is not None:
+                    trc.emit("checkpoint", "fallback",
+                             args={"snapshot": str(snap.get("dir")),
+                                   "dir": self.dir})
                 continue
             # decode everything BEFORE applying anything: a corrupt
             # payload that slipped past hashing still can't part-load
@@ -210,6 +220,12 @@ class SnapshotStore:
                     f"verification; resumed from older intact "
                     f"'{snap['dir']}' (epoch {snap['epoch']})")
             monitor.stat_add("checkpoint.restores")
+            trc = _obs_hook._tracer
+            if trc is not None:
+                trc.emit("checkpoint", "restore",
+                         args={"epoch": int(snap["epoch"]),
+                               "snapshot": str(snap["dir"]),
+                               "fell_back_past": attempts})
             return int(snap["epoch"]) + 1
         raise CheckpointError(
             f"checkpoint dir '{self.dir}' has a published meta but no "
@@ -273,6 +289,10 @@ class TrainEpochRange:
     def _on_preempt(self):
         self._preempted.set()
         monitor.stat_add("checkpoint.preempt_requests")
+        trc = _obs_hook._tracer
+        if trc is not None:
+            trc.emit("checkpoint", "preempt_request",
+                     args={"dir": self.dir})
 
     # -- iteration ---------------------------------------------------------
     def __iter__(self) -> Iterator[int]:

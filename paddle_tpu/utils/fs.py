@@ -122,6 +122,10 @@ def retry_call(op_name: str, fn, *args, **kwargs):
                 raise
             monitor.stat_add("fs.retries")
             monitor.stat_add(f"fs.retries.{op_name}")
+            from ..core import obs_hook
+            trc = obs_hook._tracer
+            if trc is not None:
+                trc.counter(f"fs.retries.{op_name}", 1)
             delay = min(base * (2 ** (attempt - 1)), 10.0)
             delay *= 1.0 + 0.25 * _retry_rng.random()      # jitter
             delay = min(delay, max(0.0, deadline - elapsed))

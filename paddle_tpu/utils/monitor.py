@@ -63,22 +63,27 @@ class _Histogram:
     def quantile(self, q: float) -> float:
         """Estimate the q-quantile (0 <= q <= 1) from the buckets.
 
-        Returns the geometric midpoint of the bucket holding the rank,
-        clamped to the exactly-tracked [min, max] — so p0/p100 are exact
-        and interior quantiles carry ~15% bucket-resolution error."""
+        Interpolates linearly by rank within the bucket holding the
+        rank ``q*n``: a rank at the bucket's first sample reads the
+        bucket's lower edge, at its last the upper edge — exact at
+        bucket edges and exact for uniformly-spread samples, where the
+        old geometric-midpoint estimate carried a fixed ~15%
+        bucket-resolution error regardless of where the rank fell.
+        Estimates clamp to the exactly-tracked [min, max], so p0/p100
+        are always exact and a single-valued bucket reads exactly."""
         if self.n == 0:
             return 0.0
         if q <= 0.0:
             return self.vmin
         if q >= 1.0:
             return self.vmax
-        rank = max(1.0, q * self.n)
+        rank = q * self.n
         seen = 0
         for i, c in enumerate(self.counts):
-            seen += c
-            if seen >= rank:
+            prev, seen = seen, seen + c
+            if c and seen >= rank:
                 lo, hi = _bucket_bounds(i)
-                est = math.sqrt(lo * hi)
+                est = lo + (hi - lo) * ((rank - prev) / c)
                 return min(max(est, self.vmin), self.vmax)
         return self.vmax
 

@@ -1,0 +1,555 @@
+"""Unified observability (ISSUE 5): structured tracer, recompile
+attribution, Prometheus/JSON metrics export, crash flight recorder —
+plus the satellite contracts (disabled-path overhead, histogram
+quantile interpolation, RecordEvent robustness)."""
+import json
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, observability as obs, optimizer, profiler
+from paddle_tpu.core import dispatch, obs_hook
+from paddle_tpu.testing import fault
+from paddle_tpu.utils import monitor
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable()
+    obs.uninstall_flight_recorder()
+    yield
+    obs.uninstall_flight_recorder()
+    obs.disable()
+
+
+def _static_mlp(seed=7, in_dim=8):
+    paddle.seed(seed)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, in_dim], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        h = paddle.static.nn.fc(x, 16, activation="relu")
+        pred = paddle.static.nn.fc(h, 1)
+        loss = F.mse_loss(pred, y)
+        optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return main, loss
+
+
+def _feed(n, in_dim=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.randn(n, in_dim).astype(np.float32),
+            "y": rng.randn(n, 1).astype(np.float32)}
+
+
+# ---------------------------------------------------------------- tracer --
+def test_disabled_path_contract():
+    """The tier-1 overhead contract: off means ONE module-attribute
+    check, and the monitor hot paths never grew an observability hook."""
+    assert obs_hook.current() is None
+    assert not obs.enabled()
+    # the hook read is a bare module-global load — nothing else
+    assert obs_hook.current.__code__.co_names == ("_tracer",)
+    # instrumented hot paths read obs_hook._tracer directly and never
+    # import the observability package per call
+    assert "obs_hook" in dispatch.apply.__code__.co_names
+    assert "observability" not in dispatch.apply.__code__.co_names
+    # stat_add / stat_observe hot paths are untouched (no tracer refs)
+    for fn in (monitor.stat_add, monitor.stat_observe,
+               monitor.StatRegistry.add, monitor.StatRegistry.observe,
+               monitor._Histogram.observe):
+        names = fn.__code__.co_names
+        assert not any(n in ("obs_hook", "_tracer", "observability",
+                             "tracer", "emit") for n in names), \
+            f"{fn.__qualname__} grew an observability reference: {names}"
+    # module-level helpers are no-ops while disabled
+    obs.emit("instant", "nope")
+    obs.counter("nope", 1)
+    obs.set_step(3)
+    with obs.span("nope"):
+        pass
+
+
+def test_tracer_records_ops_and_spans_with_nesting():
+    t = obs.enable(capacity=256)
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    _ = (x * 2.0).sum()
+    with profiler.RecordEvent("outer"):
+        with profiler.RecordEvent("inner"):
+            pass
+    evs = t.events()
+    kinds = {e["kind"] for e in evs}
+    assert "op" in kinds and "span" in kinds
+    spans = {e["name"]: e for e in evs if e["kind"] == "span"}
+    assert spans["inner"]["parent"] == spans["outer"]["id"]
+    assert spans["outer"].get("parent") is None
+    ops = [e for e in evs if e["kind"] == "op"]
+    assert all(e["dur"] >= 0 for e in ops)
+
+
+def test_tracer_ring_buffer_bounds_memory():
+    t = obs.enable(capacity=16)
+    for i in range(100):
+        t.emit("instant", f"e{i}")
+    evs = t.events()
+    assert len(evs) == 16
+    assert evs[-1]["name"] == "e99"     # newest kept
+    assert t.emitted == 100
+
+
+def test_chrome_trace_schema_and_jsonl(tmp_path):
+    t = obs.enable(capacity=256)
+    with t.span("phase", detail=1):
+        t.counter("c", 2)
+        t.emit("instant", "marker")
+    trace = t.chrome_trace()
+    assert trace["traceEvents"]
+    phs = set()
+    for ev in trace["traceEvents"]:
+        assert isinstance(ev["name"], str)
+        assert ev["ph"] in {"X", "i", "C", "B", "E", "M"}
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        assert isinstance(ev["pid"], int)
+        assert isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+        phs.add(ev["ph"])
+    assert {"X", "i", "C"} <= phs
+    p = tmp_path / "trace.json"
+    t.export_chrome_trace(str(p))
+    json.load(open(p))                          # parses
+    jsonl = t.export_jsonl(str(tmp_path / "t.jsonl"))
+    rows = [json.loads(ln) for ln in jsonl.splitlines()]
+    assert rows and all("kind" in r and "time" in r for r in rows)
+
+
+def test_step_correlation_from_executor():
+    t = obs.enable()
+    paddle.enable_static()
+    try:
+        main, loss = _static_mlp()
+        exe = paddle.static.Executor()
+        for _ in range(3):
+            exe.run(main, feed=_feed(8), fetch_list=[loss])
+        exe.close()
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+    runs = [e for e in t.events()
+            if e["kind"] == "span" and e["name"] == "executor.run"]
+    assert [e["step"] for e in runs] == [1, 2, 3]
+
+
+# ------------------------------------------------- RecordEvent satellite --
+def test_record_event_end_without_begin_is_noop():
+    r = profiler.RecordEvent("never")
+    r.end()                     # was: TypeError on perf_counter() - None
+    r.end()                     # idempotent too
+
+
+def test_record_event_exception_safe_and_nested_under_tracer():
+    t = obs.enable()
+    with pytest.raises(ValueError):
+        with profiler.RecordEvent("outer"):
+            with profiler.RecordEvent("inner"):
+                raise ValueError("boom")
+    spans = {e["name"]: e for e in t.events() if e["kind"] == "span"}
+    # both spans closed despite the raise, nesting preserved
+    assert spans["inner"]["parent"] == spans["outer"]["id"]
+    ev = profiler.RecordEvent("twice").begin()
+    ev.end()
+    ev.end()                    # second end is a no-op
+    assert len([e for e in t.events() if e["name"] == "twice"]) == 1
+
+
+# ------------------------------------------------- quantile satellite ----
+def test_quantile_linear_interpolation_exact_at_bucket_edges():
+    monitor.stat_reset("q.edge")
+    # 4 samples in the [1, 10^(1/8)) bucket and 4 in [1000, 10^3.125)
+    # (at 1200, so the max-clamp stays out of the way)
+    for _ in range(4):
+        monitor.stat_observe("q.edge", 1.0)
+    for _ in range(4):
+        monitor.stat_observe("q.edge", 1200.0)
+    # rank at the lower bucket's LAST sample reads its upper edge exactly
+    assert monitor.quantile("q.edge", 0.5) == pytest.approx(
+        10.0 ** (1.0 / 8.0))
+    # a rank just inside the upper bucket reads its lower edge (1000)
+    assert monitor.quantile("q.edge", 0.5001) == pytest.approx(
+        1000.0, rel=1e-3)
+    # one sample deep into a 4-sample bucket: lo + (hi-lo)/4 by rank
+    lo, hi = 1000.0, 10.0 ** 3.125
+    assert monitor.quantile("q.edge", 5.0 / 8.0) == pytest.approx(
+        lo + (hi - lo) * 0.25)
+    monitor.stat_reset("q.edge")
+
+
+def test_quantile_single_valued_bucket_is_exact():
+    monitor.stat_reset("q.single")
+    for _ in range(10):
+        monitor.stat_observe("q.single", 3.7)
+    # min==max clamp: every interior quantile is exactly the value
+    for q in (0.1, 0.25, 0.5, 0.9, 0.99):
+        assert monitor.quantile("q.single", q) == 3.7
+    monitor.stat_reset("q.single")
+
+
+def test_quantile_interpolates_by_rank_within_bucket():
+    monitor.stat_reset("q.lin")
+    # 8 samples in one bucket [10, 10^(9/8)): rank q*8 moves linearly
+    # from lo to hi across the bucket
+    for _ in range(8):
+        monitor.stat_observe("q.lin", 10.5)
+    lo, hi = 10.0, 10.0 ** (9.0 / 8.0)
+    est = lo + (hi - lo) * 0.5
+    # min/max clamp to the single observed value wins here
+    assert monitor.quantile("q.lin", 0.5) == 10.5
+    monitor.stat_reset("q.lin")
+    # mixed values spread inside the same bucket: interpolation lands
+    # between them, clamped within [vmin, vmax]
+    for v in (10.1, 10.4, 10.8, 12.0):
+        monitor.stat_observe("q.lin", v)
+    q50 = monitor.quantile("q.lin", 0.5)
+    assert 10.1 <= q50 <= 12.0
+    assert q50 == pytest.approx(lo + (hi - lo) * (2.0 / 4.0))
+    assert est  # silence linters: est documents the formula
+    monitor.stat_reset("q.lin")
+
+
+def test_quantile_extremes_and_empty_unchanged():
+    monitor.stat_reset("q.ext")
+    for v in (0.5, 2.0, 7.0):
+        monitor.stat_observe("q.ext", v)
+    assert monitor.quantile("q.ext", 0.0) == 0.5
+    assert monitor.quantile("q.ext", 1.0) == 7.0
+    monitor.stat_reset("q.ext")
+    assert monitor.quantile("q.ext", 0.5) == 0.0
+
+
+# ---------------------------------------------- recompile attribution ----
+def test_executor_compile_attribution_causes():
+    obs.reset_compiles()
+    paddle.enable_static()
+    try:
+        main, loss = _static_mlp()
+        exe = paddle.static.Executor()
+        exe.run(main, feed=_feed(8), fetch_list=[loss])
+        exe.run(main, feed=_feed(8, seed=1), fetch_list=[loss])  # cached
+        exe.run(main, feed=_feed(4), fetch_list=[loss])
+        # edit the program: another op bumps the version
+        with paddle.static.program_guard(main):
+            _ = paddle.static.nn.fc(main.feed_vars["x"], 4)
+        exe.run(main, feed=_feed(4), fetch_list=[loss])
+        exe.close()
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+    rep = obs.explain_compiles("executor")
+    causes = [r["cause"] for r in rep["records"]]
+    assert causes == ["first_compile", "new_feed_signature",
+                      "new_program_version"]
+    assert rep["unexplained"] == 0
+    # the diff names what changed, old -> new
+    sig_change = rep["records"][1]["changed"]
+    assert "feed_signature" in sig_change
+    assert monitor.get_stat("compiles.executor.new_feed_signature") >= 1
+
+
+def test_predictor_compile_attribution_new_bucket(tmp_path):
+    from paddle_tpu import inference, jit
+    from paddle_tpu.jit import InputSpec
+
+    obs.reset_compiles()
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 2))
+    prefix = str(tmp_path / "m")
+    jit.save(model, prefix, input_spec=[InputSpec([None, 4], "float32")])
+    pred = inference.create_predictor(inference.Config(prefix))
+    for n in (1, 2, 3, 5):
+        pred.run([np.zeros((n, 4), np.float32)])
+    rep = obs.explain_compiles("predictor")
+    causes = [r["cause"] for r in rep["records"]]
+    assert causes[0] == "first_compile"
+    assert set(causes[1:]) == {"new_bucket"}
+    assert len(rep["records"]) == pred.num_compiled_variants()
+    assert rep["unexplained"] == 0
+
+
+def test_jit_compile_attribution():
+    from paddle_tpu.jit import to_static
+
+    obs.reset_compiles()
+
+    @to_static
+    def f(a, scale):
+        return a * scale
+
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    f(x, 2.0)
+    f(x, 2.0)               # cache hit: no new record
+    f(x, 3.0)               # new static-leaf value
+    rep = obs.explain_compiles("jit")
+    causes = [r["cause"] for r in rep["records"]]
+    assert causes == ["first_compile", "new_input_structure"]
+    assert rep["unexplained"] == 0
+
+
+# ------------------------------------------------------ metrics export ---
+PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+naif]+$")
+
+
+def test_prometheus_text_parses_and_covers_registry():
+    monitor.stat_reset()
+    monitor.stat_add("obs.test.counter", 5)
+    monitor.stat_observe("obs.test.lat", 2.5)
+    text = obs.prometheus_text({"extra_gauge": 1.25})
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert PROM_LINE.match(line), f"bad exposition line: {line!r}"
+    assert "paddle_tpu_obs_test_counter 5" in text
+    assert 'paddle_tpu_obs_test_lat{quantile="0.5"} 2.5' in text
+    assert "paddle_tpu_obs_test_lat_count 1" in text
+    assert "paddle_tpu_extra_gauge 1.25" in text
+    monitor.stat_reset()
+
+
+def test_prometheus_name_collision_between_stat_and_histogram():
+    monitor.stat_reset()
+    monitor.stat_add("clash", 1)
+    monitor.stat_observe("clash", 2.0)
+    text = obs.prometheus_text()
+    # the gauge renames rather than colliding with the summary family
+    assert "paddle_tpu_clash_stat 1" in text
+    assert "paddle_tpu_clash_count 1" in text
+    monitor.stat_reset()
+
+
+def test_metrics_snapshot_and_jsonl_dump(tmp_path):
+    monitor.stat_add("snap.c", 2)
+    snap = obs.metrics_snapshot()
+    assert snap["stats"]["snap.c"] >= 2 and "histograms" in snap
+    p = str(tmp_path / "metrics.jsonl")
+    obs.dump_metrics(p, extra={"tag": "t1"})
+    obs.dump_metrics(p, extra={"tag": "t2"})
+    rows = [json.loads(ln) for ln in open(p).read().splitlines()]
+    assert [r["tag"] for r in rows] == ["t1", "t2"]
+    assert all("stats" in r for r in rows)
+    with pytest.raises(ValueError):
+        obs.dump_metrics()      # no path, no flag
+
+
+def test_metrics_dump_callback(tmp_path):
+    from paddle_tpu.hapi.callbacks import MetricsDump
+    p = str(tmp_path / "fit_metrics.jsonl")
+    cb = MetricsDump(path=p, save_freq=2)
+    cb.on_epoch_end(0)
+    cb.on_epoch_end(1)          # (1+1) % 2 == 0 -> dumps
+    cb.on_train_end()
+    rows = [json.loads(ln) for ln in open(p).read().splitlines()]
+    assert [r["tag"] for r in rows] == ["epoch_end", "train_end"]
+    assert rows[0]["epoch"] == 1
+
+
+def test_http_metrics_content_negotiation(tmp_path):
+    from paddle_tpu import inference, jit, serving
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.serving.http import Client, ServingServer
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 2))
+    prefix = str(tmp_path / "m")
+    jit.save(model, prefix, input_spec=[InputSpec([None, 4], "float32")])
+    pred = inference.create_predictor(inference.Config(prefix))
+    engine = serving.InferenceEngine(pred, max_batch_size=4,
+                                     batch_timeout_ms=1.0)
+    engine.warmup()
+    engine.infer_sync([np.zeros((1, 4), np.float32)], timeout=30)
+    with ServingServer(engine, port=0) as srv:
+        client = Client(srv.url)
+        js = client.metrics()           # default stays JSON
+        assert js["counters"]["responses"] >= 1
+        text = client.metrics_text()    # Accept: text/plain -> Prometheus
+        assert text.startswith("# TYPE")
+        assert "paddle_tpu_serving_engine_queue_depth" in text
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                assert PROM_LINE.match(line), line
+    engine.close()
+
+
+# --------------------------------------------------- flight recorder -----
+def test_flight_recorder_on_executor_crash(tmp_path):
+    t = obs.enable()
+    flight = str(tmp_path / "flight.json")
+    obs.install_flight_recorder(path=flight)
+    paddle.enable_static()
+    try:
+        main, loss = _static_mlp()
+        exe = paddle.static.Executor()
+        exe.run(main, feed=_feed(8), fetch_list=[loss])
+        with fault.inject("executor.run:count=1"):
+            with pytest.raises(fault.FaultInjected):
+                exe.run(main, feed=_feed(8), fetch_list=[loss])
+        exe.close()
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+    box = json.load(open(flight))
+    assert box["exception"]["type"] == "FaultInjected"
+    assert "executor.run" in box["reason"]
+    kinds = {e["kind"] for e in box["events"]}
+    assert "fault" in kinds             # the injected fault is on tape
+    assert "compile" in kinds
+    assert box["stats"] and "histograms" in box
+    assert box["compiles"]["total"] >= 1
+    assert t.events()                   # tracer survived the dump
+
+
+def test_flight_recorder_on_enforce_error(tmp_path):
+    from paddle_tpu.core.enforce import InvalidArgumentError, enforce
+    flight = str(tmp_path / "flight.json")
+    obs.install_flight_recorder(path=flight)
+    with pytest.raises(InvalidArgumentError):
+        enforce(False, "observability test failure")
+    box = json.load(open(flight))
+    assert box["reason"].startswith("enforce.")
+    assert box["exception"]["type"] == "InvalidArgumentError"
+    assert "observability test failure" in box["exception"]["message"]
+
+
+def test_flight_recorder_same_exception_dumps_once(tmp_path):
+    flight = str(tmp_path / "flight.json")
+    obs.install_flight_recorder(path=flight)
+    monitor.stat_reset("flight.dumps")
+    from paddle_tpu.core.enforce import InvalidArgumentError
+    paddle.enable_static()
+    try:
+        main, loss = _static_mlp()
+        exe = paddle.static.Executor()
+        with fault.inject(
+                "executor.run:count=1,exc=FaultInjected"):
+            with pytest.raises(fault.FaultInjected):
+                exe.run(main, feed=_feed(8), fetch_list=[loss])
+        exe.close()
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+    assert monitor.get_stat("flight.dumps") == 1
+    assert InvalidArgumentError  # imported for taxonomy visibility
+
+
+def test_flight_recorder_distinct_exceptions_each_dump(tmp_path):
+    # dedup must be per live OBJECT: a freed exception's recycled id
+    # must not swallow dumps for later, distinct errors
+    from paddle_tpu.core.enforce import InvalidArgumentError
+    flight = str(tmp_path / "flight.json")
+    obs.install_flight_recorder(path=flight)
+    monitor.stat_reset("flight.dumps")
+    for i in range(5):
+        InvalidArgumentError(f"err {i}")     # constructed, then freed
+    assert monitor.get_stat("flight.dumps") == 5
+    box = json.load(open(flight))
+    assert "err 4" in box["exception"]["message"]   # the LATEST error
+
+
+def test_flight_recorder_traceback_upgrades_dump(tmp_path):
+    # EnforceError dumps at construction (no stack yet); the re-report
+    # from the raise boundary carries the traceback and must overwrite
+    from paddle_tpu.core.enforce import NotFoundError
+    flight = str(tmp_path / "flight.json")
+    obs.install_flight_recorder(path=flight)
+    monitor.stat_reset("flight.dumps")
+
+    def deep():
+        raise NotFoundError("lost thing")
+
+    try:
+        deep()
+    except NotFoundError as e:
+        obs_hook.crash_handler()(e, "executor.run(test)")
+        # a third report of the same traceback'd object stays deduped
+        obs_hook.crash_handler()(e, "executor.run(test)")
+    assert monitor.get_stat("flight.dumps") == 2
+    box = json.load(open(flight))
+    tb = "".join(box["exception"]["traceback"])
+    assert "deep" in tb                     # stack frames present
+
+
+def test_end_span_with_foreign_id_does_not_drain_stack():
+    t = obs.enable()
+    outer = t.begin_span("outer")
+    inner = t.begin_span("inner")
+    t.end_span(inner)
+    t.end_span(inner)       # double end: ignored
+    t.end_span(99999)       # never-begun id: ignored
+    assert not [e for e in t.events() if e["name"] == "outer"]
+    t.end_span(outer)
+    spans = {e["name"]: e for e in t.events() if e["kind"] == "span"}
+    assert spans["inner"]["parent"] == spans["outer"]["id"]
+    assert len([e for e in t.events() if e["name"] == "inner"]) == 1
+
+
+def test_flight_recorder_uninstall_restores_hooks(tmp_path):
+    prev_hook = sys.excepthook
+    obs.install_flight_recorder(path=str(tmp_path / "f.json"))
+    assert sys.excepthook is not prev_hook
+    assert obs_hook.crash_handler() is not None
+    assert obs.flight_recorder_path() == str(tmp_path / "f.json")
+    obs.uninstall_flight_recorder()
+    assert sys.excepthook is prev_hook
+    assert obs_hook.crash_handler() is None
+    assert obs.flight_recorder_path() is None
+
+
+def test_manual_dump_flight(tmp_path):
+    obs.enable()
+    obs.emit("instant", "before_dump")
+    p = str(tmp_path / "manual.json")
+    out = obs.dump_flight(path=p, reason="manual-test")
+    assert out == p
+    box = json.load(open(p))
+    assert box["reason"] == "manual-test"
+    assert box["exception"] is None
+    assert any(e["name"] == "before_dump" for e in box["events"])
+
+
+# ----------------------------------------------------- serving events ----
+def test_serving_events_carry_request_ids(tmp_path):
+    from paddle_tpu import inference, jit, serving
+    from paddle_tpu.jit import InputSpec
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 2))
+    prefix = str(tmp_path / "m")
+    jit.save(model, prefix, input_spec=[InputSpec([None, 4], "float32")])
+    pred = inference.create_predictor(inference.Config(prefix))
+    engine = serving.InferenceEngine(pred, max_batch_size=4,
+                                     batch_timeout_ms=1.0)
+    engine.warmup()
+    t = obs.enable()
+    engine.infer_sync([np.zeros((2, 4), np.float32)], timeout=30)
+    engine.drain(timeout=10)
+    engine.close()
+    sv = [e for e in t.events() if e["kind"] == "serving"]
+    enq = [e for e in sv if e["name"] == "enqueue"]
+    disp = [e for e in sv if e["name"] == "dispatch"]
+    assert enq and disp
+    rid = enq[0]["args"]["rid"]
+    assert rid in disp[0]["args"]["rids"]       # request correlation
+    assert disp[0]["args"]["ok"] is True
+    assert disp[0]["dur"] >= 0
+
+
+# ------------------------------------------------------------ CI gate ----
+def test_obs_smoke_in_process():
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import obs_smoke
+    failures = obs_smoke.run_checks()
+    assert failures == []

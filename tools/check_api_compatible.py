@@ -44,6 +44,7 @@ _MODULES = [
     "paddle_tpu.vision.transforms", "paddle_tpu.vision.datasets",
     "paddle_tpu.vision.ops", "paddle_tpu.text.datasets",
     "paddle_tpu.distribution", "paddle_tpu.profiler",
+    "paddle_tpu.observability",
     "paddle_tpu.inference", "paddle_tpu.serving",
     "paddle_tpu.quantization",
     "paddle_tpu.utils", "paddle_tpu.onnx",

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""CI smoke gate for unified observability (sibling of bench_smoke.py /
+chaos_smoke.py / serve_smoke.py).
+
+Drives a short train + serve loop on CPU with tracing ON and exits
+non-zero when the observability contract regresses:
+
+1. **flight recorder** — an injected crash (``fault`` rule on
+   ``executor.run``) must leave a readable flight-recorder dump that
+   contains the injected fault event, the exception, and a full
+   metrics snapshot.
+2. **recompile attribution** — ``explain_compiles()`` must report ZERO
+   unexplained compiles across the run; the executor's second feed
+   signature must be attributed to ``new_feed_signature``; every
+   Predictor compile in the serve loop must carry a named cause and
+   their count must equal ``num_compiled_variants()`` (100%
+   attribution).
+3. **metrics export** — the HTTP ``/metrics`` endpoint must serve the
+   Prometheus text exposition under an Accept: text/plain header
+   (every line must parse) while keeping the JSON stats for default
+   clients; the JSONL metrics dump must append parseable lines.
+4. **trace integrity** — the chrome-trace export must satisfy the
+   trace-event schema (name/ph/ts/pid/tid per event, dur on complete
+   events) and carry span, op, compile and serving events.
+
+Usage:  python tools/obs_smoke.py [--verbose]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# metric_name{labels} value  — the text exposition grammar subset we emit
+PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+naif]+$")
+
+_CHROME_PH = {"X", "i", "C", "B", "E", "M"}
+
+
+def _check_chrome_schema(trace: dict, failures: list) -> None:
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        failures.append("chrome trace has no traceEvents")
+        return
+    for ev in evs:
+        probs = []
+        if not isinstance(ev.get("name"), str):
+            probs.append("name")
+        if ev.get("ph") not in _CHROME_PH:
+            probs.append("ph")
+        if not isinstance(ev.get("ts"), (int, float)) or ev["ts"] < 0:
+            probs.append("ts")
+        if not isinstance(ev.get("pid"), int):
+            probs.append("pid")
+        if not isinstance(ev.get("tid"), int):
+            probs.append("tid")
+        if ev.get("ph") == "X" and not (
+                isinstance(ev.get("dur"), (int, float)) and ev["dur"] >= 0):
+            probs.append("dur")
+        if probs:
+            failures.append(f"trace event violates schema ({probs}): "
+                            f"{ev}")
+            return
+
+
+def run_checks(verbose: bool = False) -> list:
+    """Returns a list of failure strings (empty = healthy)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import inference, jit, observability as obs
+    from paddle_tpu import optimizer, serving
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.serving.http import Client, ServingServer
+    from paddle_tpu.testing import fault
+    from paddle_tpu.testing.chaos import make_dyadic_model
+    from paddle_tpu.utils import monitor
+
+    failures: list = []
+    workdir = tempfile.mkdtemp(prefix="obs_smoke_")
+    obs.reset_compiles()
+    tracer = obs.enable(capacity=8192)
+    flight = os.path.join(workdir, "flight_record.json")
+    obs.install_flight_recorder(path=flight)
+    try:
+        # -- short static train loop (two feed signatures) ----------------
+        paddle.enable_static()
+        try:
+            paddle.seed(7)
+            main = paddle.static.Program()
+            with paddle.static.program_guard(main):
+                x = paddle.static.data("x", [None, 8], "float32")
+                y = paddle.static.data("y", [None, 1], "float32")
+                h = paddle.static.nn.fc(x, 16, activation="relu")
+                pred = paddle.static.nn.fc(h, 1)
+                loss = F.mse_loss(pred, y)
+                optimizer.SGD(learning_rate=0.01).minimize(loss)
+            exe = paddle.static.Executor()
+            rng = np.random.RandomState(0)
+
+            def feed(n):
+                return {"x": rng.randn(n, 8).astype(np.float32),
+                        "y": rng.randn(n, 1).astype(np.float32)}
+
+            for _ in range(4):
+                exe.run(main, feed=feed(8), fetch_list=[loss])
+            exe.run(main, feed=feed(4), fetch_list=[loss])
+
+            # -- injected crash must leave a black box --------------------
+            crashed = False
+            with fault.inject("executor.run:count=1"):
+                try:
+                    exe.run(main, feed=feed(8), fetch_list=[loss])
+                except fault.FaultInjected:
+                    crashed = True
+            if not crashed:
+                failures.append("injected executor.run fault never fired")
+            if not os.path.exists(flight):
+                failures.append("no flight-recorder dump after the "
+                                "injected crash")
+            else:
+                box = json.load(open(flight))
+                kinds = {e.get("kind") for e in box.get("events", [])}
+                if "fault" not in kinds:
+                    failures.append(f"flight dump lacks the injected "
+                                    f"fault event (kinds: {kinds})")
+                if (box.get("exception") or {}).get("type") \
+                        != "FaultInjected":
+                    failures.append("flight dump lacks the exception")
+                if not box.get("stats") or "histograms" not in box:
+                    failures.append("flight dump lacks the metrics "
+                                    "snapshot")
+            exe.close()
+        finally:
+            paddle.disable_static()
+            paddle.static.reset_default_programs()
+
+        rep = obs.explain_compiles("executor")
+        causes = [r["cause"] for r in rep["records"]]
+        if "new_feed_signature" not in causes:
+            failures.append(f"feed-signature recompile not attributed "
+                            f"(causes: {causes})")
+
+        # -- serve loop: every compile must carry a named cause -----------
+        paddle.seed(5)
+        model = make_dyadic_model()
+        prefix = os.path.join(workdir, "m")
+        jit.save(model, prefix,
+                 input_spec=[InputSpec([None, 8], "float32")])
+        pred = inference.create_predictor(inference.Config(prefix))
+        engine = serving.InferenceEngine(pred, max_batch_size=8,
+                                         batch_timeout_ms=5.0,
+                                         max_queue=64)
+        engine.warmup()
+        reqs = [(rng.randint(-8, 9, (int(rng.randint(1, 5)), 8)) / 4.0)
+                .astype(np.float32) for _ in range(24)]
+        futures = [engine.infer([r]) for r in reqs]
+        for f in futures:
+            f.result(60)
+
+        prep = obs.explain_compiles("predictor")
+        n_attr = len([r for r in prep["records"]
+                      if r["cause"] != "unexplained"])
+        if n_attr != pred.num_compiled_variants():
+            failures.append(
+                f"predictor compiles not 100% attributed: "
+                f"{n_attr} records vs {pred.num_compiled_variants()} "
+                f"variants")
+        total = obs.explain_compiles()
+        if total["unexplained"] != 0:
+            failures.append(f"{total['unexplained']} unexplained "
+                            f"compile(s): {total['by_cause']}")
+        if total["total"] == 0:
+            failures.append("no compiles recorded at all")
+
+        # -- /metrics content negotiation + Prometheus grammar ------------
+        srv = ServingServer(engine, port=0).start()
+        try:
+            client = Client(srv.url)
+            js = client.metrics()
+            if "counters" not in js or "latency_ms" not in js:
+                failures.append("JSON /metrics lost the engine stats")
+            text = client.metrics_text()
+            bad = [ln for ln in text.splitlines()
+                   if ln and not ln.startswith("#")
+                   and not PROM_LINE.match(ln)]
+            if bad:
+                failures.append(f"unparseable Prometheus lines: "
+                                f"{bad[:3]}")
+            if "paddle_tpu_serving_latency_ms" not in text:
+                failures.append("Prometheus output lacks the serving "
+                                "latency summary")
+            if "paddle_tpu_serving_engine_queue_depth" not in text:
+                failures.append("Prometheus output lacks the engine "
+                                "gauges")
+        finally:
+            srv.close()
+            engine.close()
+
+        # -- JSONL metrics dump -------------------------------------------
+        dump_path = os.path.join(workdir, "metrics.jsonl")
+        obs.dump_metrics(dump_path)
+        obs.dump_metrics(dump_path)
+        lines = open(dump_path).read().splitlines()
+        if len(lines) != 2 or not all(
+                "stats" in json.loads(ln) for ln in lines):
+            failures.append("metrics JSONL dump is malformed")
+
+        # -- trace integrity ----------------------------------------------
+        trace = tracer.chrome_trace()
+        _check_chrome_schema(trace, failures)
+        kinds = {e.get("kind") for e in tracer.events()}
+        for want in ("span", "op", "compile", "serving", "fault"):
+            if want not in kinds:
+                failures.append(f"tracer recorded no '{want}' events "
+                                f"(kinds: {kinds})")
+        if verbose:
+            print(f"events={len(tracer.events())} kinds={sorted(kinds)} "
+                  f"compiles={total['by_cause']} "
+                  f"flight={os.path.exists(flight)}")
+        _ = monitor.get_stat("flight.dumps")
+    finally:
+        obs.uninstall_flight_recorder()
+        obs.disable()
+        shutil.rmtree(workdir, ignore_errors=True)
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    failures = run_checks(verbose=args.verbose)
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("obs_smoke: observability healthy (crash black box written, "
+          "100% of compiles attributed, Prometheus + JSON /metrics "
+          "served, trace schema valid)")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())
