@@ -592,6 +592,35 @@ def bench_static(args, dev, on_tpu):
         dt_lenet, lenet_loss = _timed_static_loop(
             lexe, lmain, lloss, {"x": lx, "y": ly}, lenet_steps)
         lenet_compiles = lexe.compile_count
+
+        # static cost model (ISSUE 6): predicted FLOPs/peak-bytes next
+        # to the measured numbers, so BENCH_r*.json tracks model
+        # accuracy over time (predicted-vs-measured drift per round)
+        def _predicted(prog, loss_var, bsz):
+            rep = prog.analyze(fetch_list=[loss_var], batch_size=bsz)
+            m = rep.memory
+            return {
+                "fwd_gflops_per_step": round(
+                    rep.totals["flops_fwd"] / 1e9, 4),
+                "train_gflops_per_step": round(
+                    rep.totals["flops_train"] / 1e9, 4),
+                "peak_mib_donated": round(
+                    m.peak_bytes_donated / 2**20, 2),
+                "peak_mib_no_donation": round(
+                    m.peak_bytes_no_donation / 2**20, 2),
+                "arithmetic_intensity": round(
+                    rep.totals["arithmetic_intensity"], 2),
+                "unmodeled_ops": rep.totals["unmodeled"]["count"],
+                "fusion_candidates": len(rep.fusion_candidates),
+            }
+
+        mlp_pred = _predicted(main, loss, batch)
+        lenet_pred = _predicted(lmain, lloss, lenet_batch)
+        mlp_pred["achieved_gflops_per_sec"] = round(
+            mlp_pred["train_gflops_per_step"] * steps / dt_fast, 2)
+        lenet_pred["achieved_gflops_per_sec"] = round(
+            lenet_pred["train_gflops_per_step"] * lenet_steps / dt_lenet,
+            2)
     finally:
         paddle.disable_static()
         paddle.static.reset_default_programs()
@@ -606,6 +635,7 @@ def bench_static(args, dev, on_tpu):
         "compile_count": compiles,           # must be 1 (one feed sig)
         "host_feed_converts": converts,      # must be 0 (jax feeds)
         "donated": True,
+        "analyzer": mlp_pred,                # static cost model (ISSUE 6)
         "config": {"hidden": hidden, "depth": depth, "batch": batch,
                    "optimizer": "adam"},
         "static_lenet": {
@@ -616,6 +646,7 @@ def bench_static(args, dev, on_tpu):
             "compile_count": lenet_compiles,
             "batch": lenet_batch,
             "final_loss": round(lenet_loss, 4),
+            "analyzer": lenet_pred,
         },
     }
 

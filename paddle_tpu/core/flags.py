@@ -99,6 +99,13 @@ define_flag("static_verify", False,
             "each Program before its first compile, and record file:line "
             "anchors for every op at build time.  Off by default: "
             "verification adds one eval_shape re-trace per op.")
+define_flag("static_anchors", False,
+            "Record a file:line source anchor on every op "
+            "Program.record appends — the cheap subset of "
+            "FLAGS_static_verify (one frame walk per recorded op at "
+            "build time, no per-run verification), so "
+            "Program.analyze() reports and lint/analyze CLIs carry "
+            "user-source anchors.")
 define_flag("static_donate", True,
             "Donate parameter/optimizer buffers of the static Executor's "
             "compiled train step (jax.jit donate_argnums), updating "

@@ -16,7 +16,16 @@ and reports, with file:line anchors:
   conversion, per ``_LoopTransformer._body_ok``);
 - ``D2S103`` shadowed builtins (``print``/``int``/``float``/``bool``
   rebound by a param, local store, or module/closure binding), which the
-  builtin transformer therefore skips rewriting.
+  builtin transformer therefore skips rewriting;
+- ``D2S104`` host-sync calls on traced tensors — the same hazard the
+  Program analyzer's host-transfer pass reports on recorded graphs,
+  caught here earlier at the AST level.  ``.numpy()`` / ``.item()`` /
+  ``.tolist()`` are errors: nothing rewrites them, so under
+  ``to_static`` they concretize a tracer (a TypeError deep in jit).
+  ``float()``/``int()``/``bool()`` are warnings: the cast transformer
+  silently lowers them to a tensor ``astype`` — the code runs, but it
+  never yields the Python scalar it reads as (and in eager TPU code
+  the same call is a device→host sync point).
 
 "Tensor-dependent" is a static taint over the AST: function parameters
 are assumed tensors; taint flows through assignments, attributes,
@@ -39,6 +48,10 @@ __all__ = ["LintDiagnostic", "lint"]
 # calls that produce concrete (non-traced) values even on tensor args
 _CONCRETE_FNS = {"isinstance", "issubclass", "hasattr", "getattr",
                  "callable", "len", "type", "id", "repr", "str"}
+# methods that force a device->host sync (and concretize a tracer)
+_HOST_SYNC_METHODS = {"numpy", "item", "tolist"}
+# builtin conversions that concretize a traced truth/scalar value
+_HOST_SYNC_BUILTINS = {"float", "int", "bool"}
 # attributes that are concrete Python metadata at trace time — control
 # flow over them (`if x.shape[0] > 1`, `for i in range(x.ndim)`) is safe
 _CONCRETE_ATTRS = {"shape", "ndim", "dtype", "name"}
@@ -67,6 +80,10 @@ class LintDiagnostic:
 
     def __repr__(self):
         return f"LintDiagnostic({self!s})"
+
+    def to_dict(self) -> dict:
+        """JSON-able record (tools/lint_program.py --format json)."""
+        return {s: getattr(self, s) for s in self.__slots__}
 
 
 # -- taint ------------------------------------------------------------------
@@ -300,10 +317,42 @@ def lint(fn) -> List[LintDiagnostic]:
                     f"values through loop variables instead",
                     function=name))
 
-    # -- D2S103: shadowed builtins ----------------------------------------
+    # -- D2S104: host-sync calls on traced tensors ------------------------
     env0 = _decoration_env(fn)
-    shadowed = _shadowed_builtins(fdef0, env0) & {"print", "int",
-                                                  "float", "bool"}
+    shadowed_all = _shadowed_builtins(fdef0, env0)
+    for n in ast.walk(fdef0):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Attribute) and f.attr in _HOST_SYNC_METHODS \
+                and _tensorish(f.value, tainted):
+            line, col = anchor(n)
+            diags.append(LintDiagnostic(
+                file, line, col, "D2S104", "error",
+                f"host-sync call `{ast.unparse(n)}` on a traced tensor: "
+                f"under to_static this concretizes the tracer (TypeError "
+                f"at trace time), and in eager TPU code it stalls the "
+                f"async dispatch pipeline with a device->host sync; "
+                f"return the tensor and convert OUTSIDE the compiled "
+                f"function", function=name))
+        elif (isinstance(f, ast.Name) and f.id in _HOST_SYNC_BUILTINS
+                and f.id not in shadowed_all and n.args
+                and _tensorish(n.args[0], tainted)):
+            line, col = anchor(n)
+            diags.append(LintDiagnostic(
+                file, line, col, "D2S104", "warning",
+                f"`{f.id}(...)` on a traced tensor "
+                f"(`{ast.unparse(n)}`) does not produce a Python "
+                f"{f.id} under to_static: the cast transformer lowers "
+                f"it to a tensor astype, so code expecting a host "
+                f"scalar (formatting, dict keys, plain-Python math) "
+                f"misbehaves — and in eager TPU code the same call "
+                f"stalls the pipeline with a device->host sync; keep "
+                f"the value a tensor, or convert outside the compiled "
+                f"function", function=name))
+
+    # -- D2S103: shadowed builtins ----------------------------------------
+    shadowed = shadowed_all & {"print", "int", "float", "bool"}
     if shadowed:
         for n in ast.walk(fdef0):
             if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)

@@ -51,7 +51,8 @@ def _freeze(v):
 
 
 def record_compile(component: str, identity, signature: Dict[str, object],
-                   note: str = "") -> dict:
+                   note: str = "", predicted: Optional[dict] = None
+                   ) -> dict:
     """Report one compile.
 
     ``component``: "executor" | "jit" | "predictor" | ... .
@@ -60,6 +61,12 @@ def record_compile(component: str, identity, signature: Dict[str, object],
     ``signature``: ordered cache-key components, most significant
     first; the first field that differs from the previous compile of
     the same identity names the cause (``new_<field>``).
+    ``predicted``: the static cost model's numbers for the compiled
+    step (FLOPs, peak bytes — static/analysis/cost.compile_summary);
+    kept on the record but deliberately OUT of the signature, so a
+    cost-model change can never masquerade as a recompile cause.
+    ``explain_compiles`` surfaces it next to the attribution, which is
+    where predicted-vs-measured drift shows up.
     """
     sig = {k: _freeze(v) for k, v in signature.items()}
     now = time.time()
@@ -86,6 +93,8 @@ def record_compile(component: str, identity, signature: Dict[str, object],
         }
         if note:
             rec["note"] = note
+        if predicted:
+            rec["predicted"] = dict(predicted)
         _records.append(rec)
         _totals[(component, cause)] += 1
     monitor.stat_add(f"compiles.{component}.{cause}")

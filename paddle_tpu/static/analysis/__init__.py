@@ -1,27 +1,40 @@
-"""Program IR analysis: def-use graph + verifier pass framework.
+"""Program IR analysis: def-use graph, verifier passes, cost model.
 
 TPU-native analog of the reference's ``ir::Graph`` + ``Pass`` layer
 (reference: paddle/fluid/framework/ir/graph.h, pass.h,
 graph_helper.cc HasCircle/TopologySortOperations): a compile-time
 analysis tier over the recorded ``_OpNode`` list that catches malformed
-programs BEFORE they reach ``jax.jit``, where the same defects surface
-as cryptic trace errors deep inside XLA lowering.
+programs BEFORE they reach ``jax.jit`` — and, since ISSUE 6, *prices*
+well-formed ones before they reach the hardware.
 
 Entry points:
 
-- :func:`check` — run verifier passes, return structured
-  :class:`Diagnostic` objects (never raises);
+- :func:`check` — run verifier + TPU-readiness hazard passes, return
+  structured :class:`Diagnostic` objects (never raises);
 - :func:`verify` — run :func:`check` and raise
   :class:`~paddle_tpu.core.enforce.GraphVerificationError` on errors
   (``Program.verify()`` delegates here);
+- :func:`analyze` / ``Program.analyze()`` — the quantitative tier
+  (cost.py / liveness.py / hazards.py): per-op FLOPs + byte volumes
+  with an explicit ``unmodeled`` bucket, donation-aware peak-memory
+  bounds, a roofline summary over :data:`CHIP_SPECS`, TPU-readiness
+  hazards, and fusion candidates ranked by HBM traffic saved;
 - ``FLAGS_static_verify`` (core/flags.py) — makes ``static.Executor``
-  verify each (program, version) once before its first compile.
+  verify each (program, version) once before its first compile;
+  ``FLAGS_static_anchors`` — the cheap subset that only records
+  file:line anchors so analyzer reports carry source locations.
 
 Every future graph-transform pass (fused computation-collective
 scheduling, mega-kernelization) builds on :class:`DefUseGraph`'s
-producer/consumer infrastructure.
+producer/consumer infrastructure; the Pallas kernel tier consumes
+``ProgramReport.fusion_candidates``.
 """
+from .cost import (CHIP_SPECS, ChipSpec, OpCost, ProgramReport,  # noqa: F401
+                   analyze, compile_summary)
 from .graph import DefUseGraph  # noqa: F401
+from .hazards import (HAZARD_PASS_REGISTRY, DonationAliasPass,  # noqa: F401
+                      HostTransferPass, WideDtypePass, hazard_passes)
+from .liveness import MemoryEstimate, aval_bytes, estimate_memory  # noqa
 from .passes import (PASS_REGISTRY, AnalysisPass, CrossProgramLeakPass,  # noqa
                      DeadCodePass, Diagnostic, NameCollisionPass,
                      ShapeDtypeConsistencyPass, UseBeforeProducePass,
@@ -32,4 +45,9 @@ __all__ = [
     "CrossProgramLeakPass", "DeadCodePass", "ShapeDtypeConsistencyPass",
     "NameCollisionPass", "check", "verify", "default_passes",
     "PASS_REGISTRY",
+    # quantitative tier (ISSUE 6)
+    "analyze", "compile_summary", "ProgramReport", "OpCost", "ChipSpec",
+    "CHIP_SPECS", "MemoryEstimate", "estimate_memory", "aval_bytes",
+    "hazard_passes", "HostTransferPass", "WideDtypePass",
+    "DonationAliasPass", "HAZARD_PASS_REGISTRY",
 ]

@@ -108,7 +108,8 @@ class DefUseGraph:
 
     def loc_of(self, i: int) -> Optional[str]:
         """file:line anchor recorded for node ``i`` (present when
-        FLAGS_static_verify was on at record time)."""
+        FLAGS_static_verify or FLAGS_static_anchors was on at record
+        time)."""
         loc = getattr(self.nodes[i], "loc", None)
         if loc is None:
             return None

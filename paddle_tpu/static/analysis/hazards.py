@@ -1,0 +1,169 @@
+"""TPU-readiness hazard passes.
+
+The verifier passes (passes.py) prove a Program *well-formed*; these
+prove it *TPU-shaped*.  Each is an :class:`AnalysisPass` emitting the
+same structured :class:`Diagnostic` records, so ``check``/``verify``,
+``Program.analyze()`` and ``tools/lint_program.py`` surface them with
+no extra wiring.  Severity policy: a hazard that silently corrupts
+scale-out behavior (a megabyte of training data baked into the
+executable) is an ``error``; a perf/precision surprise is a
+``warning``; a benign-but-worth-knowing canonicalization is ``info``.
+
+Covered hazard classes (ISSUE 6 tentpole d):
+
+- **host-transfer** — eager Tensors / NumPy arrays captured as op
+  constants.  The value is frozen into the compiled executable: it is
+  re-uploaded at every compile, silently forks from the live host
+  object, and a scalar that changes across program builds forces a
+  recompile per value (the "recompile-prone scalar feed").  Also flags
+  any recorded op whose name is a known host-sync (``numpy``/``item``/
+  ``tolist``) — the device pipeline stalls at that point every run.
+- **wide-dtype** — float64 avals the TPU runtime silently canonicalizes
+  to float32 (jax x64 off), and int64/uint64 avals that land as int32.
+- **donation-alias** — distinct Parameters sharing one buffer (tied
+  weights by array aliasing).  A buffer may appear in the donated set
+  once, so the Executor's dup-buffer guard copies every extra alias
+  each run — donation quietly stops being zero-copy for them.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .graph import DefUseGraph
+from .liveness import aval_bytes, param_array
+from .passes import AnalysisPass, Diagnostic
+
+__all__ = ["HostTransferPass", "WideDtypePass", "DonationAliasPass",
+           "hazard_passes", "HAZARD_PASS_REGISTRY"]
+
+# a baked constant this large is training data in the executable
+_CONST_ERROR_BYTES = 1 << 20
+# above this it is at least a perf smell worth a warning
+_CONST_WARN_BYTES = 4 << 10
+
+_HOST_SYNC_OPS = frozenset({"numpy", "item", "tolist", "asnumpy"})
+
+
+class HostTransferPass(AnalysisPass):
+    """Captured host/device constants and host-sync points."""
+
+    name = "host-transfer"
+
+    def run(self, graph: DefUseGraph, fetch_list=None) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for i, node in enumerate(graph.nodes):
+            if node.op_name in _HOST_SYNC_OPS:
+                out.append(self._diag(
+                    graph, Diagnostic.ERROR,
+                    f"op '{node.op_name}' forces a device->host sync "
+                    f"every run, stalling the async dispatch pipeline; "
+                    f"fetch the value through fetch_list instead",
+                    op_index=i))
+            for tag, x in node.in_specs:
+                if tag == "c":
+                    a = x
+                elif tag == "l" and isinstance(x, np.ndarray):
+                    a = x
+                else:
+                    continue
+                nb = aval_bytes(a)
+                if nb >= _CONST_ERROR_BYTES:
+                    sev, why = Diagnostic.ERROR, (
+                        "baked into the compiled executable — this is "
+                        "tensor data riding the program, re-uploaded on "
+                        "every compile and invisible to checkpoints")
+                elif nb >= _CONST_WARN_BYTES:
+                    sev, why = Diagnostic.WARNING, (
+                        "captured as a compile-time constant; it forks "
+                        "silently from the live host value and bloats "
+                        "the executable")
+                else:
+                    sev, why = Diagnostic.INFO, (
+                        "captured as a compile-time constant; rebuilding "
+                        "the program with a different value forces a "
+                        "recompile (recompile-prone scalar feed) — "
+                        "declare it with static.data and feed it instead")
+                kind = ("host ndarray" if isinstance(x, np.ndarray)
+                        else "eager Tensor")
+                out.append(self._diag(
+                    graph, sev,
+                    f"{kind} constant ({nb} bytes, shape "
+                    f"{list(a.shape)}) {why}", op_index=i))
+        return out
+
+
+class WideDtypePass(AnalysisPass):
+    """64-bit avals the TPU runtime will canonicalize narrower."""
+
+    name = "wide-dtype"
+
+    def run(self, graph: DefUseGraph, fetch_list=None) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        seen = set()
+
+        def flag(v, op_index=None):
+            if id(v) in seen:
+                return
+            seen.add(id(v))
+            dt = np.dtype(v.data.dtype)
+            if dt in (np.float64, np.complex128):
+                out.append(self._diag(
+                    graph, Diagnostic.WARNING,
+                    f"Variable '{v.name}' is {dt.name}: the TPU runtime "
+                    f"canonicalizes it to {np.dtype(np.float32).name if dt == np.float64 else 'complex64'} "
+                    f"silently (jax x64 disabled) — declare float32, or "
+                    f"expect doubled memory/bandwidth if x64 is forced "
+                    f"on", op_index=op_index, var_name=v.name))
+            elif dt in (np.int64, np.uint64):
+                out.append(self._diag(
+                    graph, Diagnostic.INFO,
+                    f"Variable '{v.name}' is {dt.name}: runtime arrays "
+                    f"land as {'int32' if dt == np.int64 else 'uint32'} "
+                    f"under the default jax config; declare the narrow "
+                    f"dtype to make the program say what it runs",
+                    op_index=op_index, var_name=v.name))
+
+        for v in graph.feeds.values():
+            flag(v)
+        for i, node in enumerate(graph.nodes):
+            for v in node.out_vars:
+                flag(v, op_index=i)
+        return out
+
+
+class DonationAliasPass(AnalysisPass):
+    """Distinct Parameters aliasing one buffer: un-donatable."""
+
+    name = "donation-alias"
+
+    def run(self, graph: DefUseGraph, fetch_list=None) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        by_buf: dict = {}
+        for plist in graph.params_of.values():
+            for p in plist:
+                group = by_buf.setdefault(id(param_array(p)), [])
+                if not any(q is p for q in group):
+                    group.append(p)
+        for group in by_buf.values():
+            if len(group) > 1:
+                names = ", ".join(repr(p.name) for p in group)
+                out.append(Diagnostic(
+                    Diagnostic.WARNING, self.name,
+                    f"{len(group)} Parameters ({names}) share one "
+                    f"underlying buffer: a buffer may enter the donated "
+                    f"set once, so the Executor copies every extra "
+                    f"alias per run — tie weights through one Parameter "
+                    f"object (or accept the copy)",
+                    var_name=group[0].name))
+        return out
+
+
+def hazard_passes() -> List[AnalysisPass]:
+    """The TPU-readiness pass family, in reporting order."""
+    return [HostTransferPass(), WideDtypePass(), DonationAliasPass()]
+
+
+HAZARD_PASS_REGISTRY = {cls.name: cls for cls in (
+    HostTransferPass, WideDtypePass, DonationAliasPass)}

@@ -47,7 +47,8 @@ class Diagnostic:
     """One structured finding (severity, pass, message, op/var anchors).
 
     ``loc`` is a ``file:line`` string when the op recorded a source
-    anchor (FLAGS_static_verify on at build time), else None.
+    anchor (FLAGS_static_verify or FLAGS_static_anchors on at build
+    time), else None.
     """
 
     __slots__ = ("severity", "pass_name", "message", "op_index",
@@ -55,6 +56,7 @@ class Diagnostic:
 
     ERROR = "error"
     WARNING = "warning"
+    INFO = "info"
 
     def __init__(self, severity: str, pass_name: str, message: str,
                  op_index: Optional[int] = None,
@@ -85,6 +87,11 @@ class Diagnostic:
 
     def __repr__(self):
         return f"Diagnostic({self!s})"
+
+    def to_dict(self) -> dict:
+        """JSON-able record (tools/lint_program.py --format json and
+        ProgramReport.to_dict serialize diagnostics through this)."""
+        return {s: getattr(self, s) for s in self.__slots__}
 
 
 class AnalysisPass:
@@ -362,12 +369,17 @@ PASS_REGISTRY = {cls.name: cls for cls in (
 def check(program: Program, fetch_list: Optional[Sequence] = None,
           passes: Optional[Sequence[AnalysisPass]] = None
           ) -> List[Diagnostic]:
-    """Run verifier passes; return ALL diagnostics (errors + warnings)
-    without raising.  ``fetch_list`` entries may be Variables or names;
-    liveness analysis is skipped when no fetch roots are known."""
+    """Run verifier + TPU-readiness hazard passes; return ALL
+    diagnostics (errors, warnings, infos) without raising.
+    ``fetch_list`` entries may be Variables or names; liveness analysis
+    is skipped when no fetch roots are known.  An explicit ``passes``
+    sequence replaces the whole default pipeline."""
+    from .hazards import hazard_passes
     graph = DefUseGraph(program)
     out: List[Diagnostic] = []
-    for p in (passes if passes is not None else default_passes()):
+    pipeline = (passes if passes is not None
+                else list(default_passes()) + hazard_passes())
+    for p in pipeline:
         out.extend(p.run(graph, fetch_list))
     return out
 

@@ -414,6 +414,20 @@ class Executor:
                                    donate)
             self._cache[key] = compiled
             self._compile_count += 1
+            # static cost model: predicted FLOPs / peak bytes ride the
+            # attribution record (and monitor gauges) so
+            # explain_compiles-style tooling can show predicted-vs-
+            # measured drift per compiled (program, signature).
+            # Best-effort by contract: compile_summary returns None
+            # rather than raising on a cost-model gap.
+            from .analysis.cost import compile_summary
+            predicted = compile_summary(program, donate=donate)
+            if predicted is not None:
+                from ..utils import monitor
+                monitor.stat_set("predicted.executor.flops",
+                                 predicted["flops"])
+                monitor.stat_set("predicted.executor.peak_bytes",
+                                 predicted["peak_bytes"])
             # recompile attribution: the first changed field (most
             # significant first) names the cause of this compile
             from ..observability import record_compile
@@ -425,7 +439,7 @@ class Executor:
                 "fetch_set": tuple(fetch_names),
                 "optimizer": program._optimizer is not None,
                 "donate": donate,
-            })
+            }, predicted=predicted)
 
         state = self._state_for(program, params)
 

@@ -200,7 +200,9 @@ class Program:
         multi = isinstance(out_avals, (tuple, list))
         avals = list(out_avals) if multi else [out_avals]
         out_vars = [Variable(a, self) for a in avals]
-        loc = _caller_loc() if get_flag("static_verify") else None
+        loc = (_caller_loc()
+               if (get_flag("static_verify") or get_flag("static_anchors"))
+               else None)
         self.nodes.append(_OpNode(fn, kw, op_name, in_specs, out_vars,
                                   multi, extra_params=seen_params,
                                   extra_vars=seen_vars, loc=loc))
@@ -220,6 +222,24 @@ class Program:
         from .analysis import verify as _verify
         return _verify(self, fetch_list=fetch_list,
                        raise_on_error=raise_on_error)
+
+    def analyze(self, fetch_list=None, feed_shapes=None, batch_size=None,
+                chip=None, top_k=5):
+        """Quantitative static analysis (static/analysis/cost.py):
+        per-op FLOPs and byte volumes with an explicit ``unmodeled``
+        bucket, donation-aware peak-memory bounds, a roofline summary
+        per chip spec, TPU-readiness hazards, and top-k fusion
+        candidates ranked by HBM traffic saved.  ``batch_size``
+        substitutes dynamic feed dims (declared ``None``/-1) and
+        re-derives every aval; ``feed_shapes`` overrides specific feeds
+        exactly.  Returns a :class:`ProgramReport` (``.render()`` for
+        text, ``.to_dict()``/``.to_json()`` for machines).  Enable
+        ``FLAGS_static_anchors`` before building the program for
+        ``file:line`` anchors in the report."""
+        from .analysis import analyze as _analyze
+        return _analyze(self, fetch_list=fetch_list,
+                        feed_shapes=feed_shapes, batch_size=batch_size,
+                        chip=chip, top_k=top_k)
 
     # -- introspection -----------------------------------------------------
     def parameters(self) -> List[Parameter]:

@@ -1,0 +1,519 @@
+"""Quantitative static analysis (ISSUE 6): cost model, liveness,
+TPU-readiness hazards, Program.analyze, D2S104 lint, CLIs, and the
+executor's per-compile predictions.
+
+Hand counts in these tests are written out from the layer algebra
+(2*M*K*N matmuls etc.), independent of the analyzer's rule tables."""
+import contextlib
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, optimizer
+from paddle_tpu.static import analysis
+from paddle_tpu.static.analysis import (CHIP_SPECS, Diagnostic,
+                                        MemoryEstimate, ProgramReport)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+    paddle.static.reset_default_programs()
+    paddle.set_flags({"FLAGS_static_verify": False,
+                      "FLAGS_static_anchors": False})
+
+
+def _mlp_program(hidden=8, depth=2, with_opt=True):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, hidden], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        h = x
+        for _ in range(depth):
+            h = paddle.static.nn.fc(h, hidden, activation="relu")
+        pred = paddle.static.nn.fc(h, 1)
+        loss = F.mse_loss(pred, y)
+        if with_opt:
+            optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return main, loss
+
+
+# ------------------------------------------------------------- cost --
+def test_linear_flops_exact():
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [4, 16], "float32")
+        lin = nn.Linear(16, 8)
+        out = lin(x)
+    rep = main.analyze(fetch_list=[out])
+    # one linear: 2*B*K*N matmul + B*N bias
+    assert rep.totals["flops_fwd"] == 2 * 4 * 16 * 8 + 4 * 8
+    c = rep.per_op[0]
+    assert c.op_name == "linear" and c.rule == "matmul" and c.modeled
+    # bytes: in 4x16, params 16x8 + 8, out 4x8 (float32)
+    assert c.in_bytes == 4 * 16 * 4
+    assert c.param_bytes == (16 * 8 + 8) * 4
+    assert c.out_bytes == 4 * 8 * 4
+
+
+def test_matmul_reduce_and_elementwise_rules():
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        a = paddle.static.data("a", [3, 5], "float32")
+        b = paddle.static.data("b", [5, 7], "float32")
+        m = paddle.matmul(a, b)        # 2*3*7*5
+        r = (m * 2.0).sum()            # 21 mul + 21 reduce
+    rep = main.analyze(fetch_list=[r])
+    by_name = {c.op_name: c for c in rep.per_op}
+    assert by_name["matmul"].flops == 2 * 3 * 7 * 5
+    assert by_name["multiply"].flops == 21
+    assert by_name["sum"].flops == 21
+    assert rep.totals["flops_fwd"] == 2 * 3 * 7 * 5 + 42
+
+
+def test_conv_flops_formula():
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [2, 3, 8, 8], "float32")
+        conv = nn.Conv2D(3, 4, 3, padding=1)
+        out = conv(x)
+    rep = main.analyze(fetch_list=[out])
+    c = rep.per_op[0]
+    # out [2,4,8,8]; dot = 3*3*3; + bias
+    out_n = 2 * 4 * 8 * 8
+    assert c.flops == 2 * out_n * 27 + out_n
+    assert c.rule == "conv"
+
+
+def test_unmodeled_bucket_is_explicit():
+    from paddle_tpu.core import dispatch
+
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [4, 4], "float32")
+        y = dispatch.apply(lambda a: a @ a, x, op_name="frobnicate")
+    rep = main.analyze(fetch_list=[y])
+    c = rep.per_op[0]
+    assert not c.modeled and c.rule == "unmodeled" and c.flops == 0
+    un = rep.totals["unmodeled"]
+    assert un["count"] == 1 and un["ops"] == ["frobnicate"]
+    assert un["bytes"] == c.total_bytes > 0
+    assert un["flops_unknown"] is True
+
+
+def test_batch_size_rederives_avals():
+    main, loss = _mlp_program(hidden=8, depth=1, with_opt=False)
+    r1 = main.analyze(fetch_list=[loss])            # placeholder batch 1
+    r32 = main.analyze(fetch_list=[loss], batch_size=32)
+    # this MLP's forward scales exactly linearly with the batch
+    assert r32.totals["flops_fwd"] == 32 * r1.totals["flops_fwd"]
+    assert r32.batch_hint == 32
+    # feed_shapes overrides one feed exactly
+    r8 = main.analyze(fetch_list=[loss],
+                      feed_shapes={"x": (8, 8), "y": (8, 1)})
+    assert r8.totals["flops_fwd"] == 8 * r1.totals["flops_fwd"]
+
+
+# --------------------------------------------------------- liveness --
+def test_activation_peak_tracks_last_use():
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [256], "float32")   # 1 KiB
+        a = x * 2.0
+        b = a + 1.0
+        c = b - 0.5
+    rep = main.analyze(fetch_list=[c])
+    m = rep.memory
+    # at any point at most 2 of {x,a,b,c} are live (producer + consumer)
+    assert m.activation_peak_bytes == 2 * 1024
+    assert m.peak_bytes_donated == m.peak_bytes_no_donation  # inference
+    assert not m.training
+    assert isinstance(m, MemoryEstimate)
+
+
+def test_fetched_var_stays_live_to_the_end():
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [256], "float32")
+        a = x * 2.0        # fetched: must stay live through c
+        b = a + 1.0
+        c = b - 0.5
+    peak_ab = main.analyze(fetch_list=[a, c]).memory
+    peak_c = main.analyze(fetch_list=[c]).memory
+    assert peak_ab.activation_peak_bytes == 3 * 1024
+    assert peak_c.activation_peak_bytes == 2 * 1024
+
+
+def test_training_memory_donation_bound():
+    main, loss = _mlp_program(hidden=8, depth=2)
+    rep = main.analyze(fetch_list=[loss], batch_size=4)
+    m = rep.memory
+    # retained = op outputs only (feeds are accounted once, separately):
+    # 4 hidden activations (4,8) + pred (4,1) + scalar loss ()
+    assert m.retained_activation_bytes == (4 * 4 * 8 * 4) + 16 + 4
+    assert m.feed_bytes == 4 * (8 + 1) * 4
+    assert m.training
+    # Adam: m+v slots = 2x trainable bytes (exact, via eval_shape)
+    assert m.slot_bytes == 2 * m.trainable_param_bytes
+    assert not m.slots_estimated
+    assert m.grad_bytes == m.trainable_param_bytes
+    # donated peak strictly below the naive bound, by exactly the
+    # second copy of params + slots that donation avoids
+    assert m.peak_bytes_donated < m.peak_bytes_no_donation
+    assert (m.peak_bytes_no_donation - m.peak_bytes_donated
+            == m.trainable_param_bytes + m.slot_bytes)
+
+
+def test_sgd_has_no_slots():
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        loss = F.mse_loss(paddle.static.nn.fc(x, 1), y)
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    m = main.analyze(fetch_list=[loss]).memory
+    assert m.slot_bytes == 0 and m.trainable_param_bytes > 0
+
+
+# ----------------------------------------------------------- fusion --
+def test_fusion_candidates_ranked_by_saved_traffic():
+    main, loss = _mlp_program(hidden=16, depth=2)
+    rep = main.analyze(fetch_list=[loss], batch_size=8)
+    assert rep.fusion_candidates, "linear+relu chains must be found"
+    top = rep.fusion_candidates[0]
+    assert top["op_names"] == ["linear", "relu"]
+    # saved = intermediate written+read once each: 2 * 8*16*4 bytes
+    assert top["saved_bytes"] == 2 * 8 * 16 * 4
+    assert (top["unfused_traffic_bytes"] - top["fused_traffic_bytes"]
+            == top["saved_bytes"])
+    saved = [c["saved_bytes"] for c in rep.fusion_candidates]
+    assert saved == sorted(saved, reverse=True)
+
+
+def test_fetched_intermediate_breaks_the_chain():
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [64], "float32")
+        a = x * 2.0
+        b = F.relu(a)
+        c = b + 1.0
+    # unfetched middle: one x*2+relu+add chain
+    rep = main.analyze(fetch_list=[c])
+    assert [c_["op_names"] for c_ in rep.fusion_candidates] == [
+        ["multiply", "relu", "add"]]
+    # fetching the intermediate forbids fusing across it
+    rep2 = main.analyze(fetch_list=[b, c])
+    assert [c_["op_names"] for c_ in rep2.fusion_candidates] == [
+        ["multiply", "relu"]]
+
+
+# --------------------------------------------------------- roofline --
+def test_roofline_specs_and_selection():
+    main, loss = _mlp_program()
+    rep = main.analyze(fetch_list=[loss], batch_size=4)
+    assert set(rep.roofline) == set(CHIP_SPECS)
+    for r in rep.roofline.values():
+        assert r["predicted_step_s"] > 0
+        assert 0 < r["predicted_mfu"] <= 1.0
+        assert r["bound"] in ("compute", "memory")
+        assert r["fits_hbm"] is True
+    one = main.analyze(fetch_list=[loss], chip="v5e")
+    assert list(one.roofline) == ["v5e"]
+    with pytest.raises(KeyError, match="unknown chip"):
+        main.analyze(fetch_list=[loss], chip="v9000")
+
+
+# ----------------------------------------------------------- report --
+def test_report_json_roundtrip_and_render():
+    main, loss = _mlp_program()
+    rep = main.analyze(fetch_list=[loss], batch_size=4)
+    assert isinstance(rep, ProgramReport)
+    d = json.loads(rep.to_json())
+    assert d["ops"] == len(main.nodes)
+    assert d["totals"]["flops_train"] == rep.totals["flops_train"]
+    assert len(d["per_op"]) == len(main.nodes)
+    assert d["memory"]["peak_bytes_donated"] > 0
+    text = rep.render()
+    for token in ("flops:", "memory:", "roofline", "fusion candidates",
+                  "per-op:", "linear"):
+        assert token in text, text
+
+
+def test_anchors_flag_records_loc_without_verification():
+    paddle.set_flags({"FLAGS_static_anchors": True})
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [4], "float32")
+        y = x * 2.0  # <- anchor line
+    assert main.nodes[0].loc is not None
+    assert main.nodes[0].loc[0].endswith("test_analysis_cost.py")
+    rep = main.analyze(fetch_list=[y])
+    assert rep.per_op[0].loc and "test_analysis_cost.py:" in rep.per_op[0].loc
+    # anchors alone never enable per-run verification
+    exe = paddle.static.Executor()
+    exe.run(main, feed={"x": np.zeros(4, np.float32)}, fetch_list=[y])
+    assert exe._verified == set()
+
+
+# ---------------------------------------------------------- hazards --
+def test_wide_dtype_hazards():
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        a = paddle.static.data("a", [4], "float64")
+        i = paddle.static.data("i", [4], "int64")
+        b = a * 2.0
+    diags = analysis.check(main)
+    wide = [d for d in diags if d.pass_name == "wide-dtype"]
+    sev = {d.var_name: d.severity for d in wide}
+    assert sev["a"] == Diagnostic.WARNING       # f64: silently narrowed
+    assert sev["i"] == Diagnostic.INFO          # i64: lands as int32
+    # the recorded OUTPUT is already float32 — jnp canonicalized the
+    # f64 away at record time, which is exactly the hazard's point
+    assert str(b.data.dtype) == "float32" and b.name not in sev
+    # hazards are warnings/infos: verify() must not raise on them
+    main.verify()
+
+
+def test_captured_const_hazard_severity_scales_with_bytes():
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [300000], "float32")
+        x2 = paddle.static.data("x2", [2048], "float32")
+        small = x + paddle.to_tensor(3.0)                   # scalar
+        mid = x2 * paddle.to_tensor(
+            np.ones(2048, np.float32))                      # 8 KiB
+        big = x + paddle.to_tensor(
+            np.ones(300000, np.float32))                    # ~1.2 MiB
+    diags = [d for d in analysis.check(main)
+             if d.pass_name == "host-transfer"]
+    sevs = [d.severity for d in diags]
+    assert sevs.count(Diagnostic.INFO) == 1      # recompile-prone scalar
+    assert sevs.count(Diagnostic.WARNING) == 1   # 8 KiB const
+    assert sevs.count(Diagnostic.ERROR) == 1     # data baked in program
+    err = next(d for d in diags if d.severity == Diagnostic.ERROR)
+    assert "baked into the compiled executable" in err.message
+    # error-severity hazard fails verify(), like a verifier error
+    from paddle_tpu.core.enforce import GraphVerificationError
+    with pytest.raises(GraphVerificationError, match="host-transfer"):
+        main.verify()
+
+
+def test_donation_alias_hazard_on_tied_params():
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        l1, l2 = nn.Linear(8, 8), nn.Linear(8, 8)
+        l2.weight.data = l1.weight.data          # tie by aliasing
+        out = l2(l1(x))
+    diags = [d for d in analysis.check(main)
+             if d.pass_name == "donation-alias"]
+    assert len(diags) == 1 and diags[0].severity == Diagnostic.WARNING
+    assert "share one" in diags[0].message
+
+
+def test_clean_program_has_no_hazards_and_check_stays_empty():
+    main, loss = _mlp_program()
+    assert [d for d in analysis.check(main, fetch_list=[loss])] == []
+    rep = main.analyze(fetch_list=[loss])
+    assert rep.hazards == []
+
+
+# ------------------------------------------- executor integration --
+def test_executor_records_prediction_per_compile():
+    from paddle_tpu.observability import explain_compiles
+    from paddle_tpu.utils import monitor
+
+    main, loss = _mlp_program(hidden=8, depth=1)
+    exe = paddle.static.Executor()
+    feed = {"x": np.zeros((4, 8), np.float32),
+            "y": np.zeros((4, 1), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    recs = [r for r in explain_compiles("executor")["records"]
+            if r["identity"] == main._serial]
+    assert recs and "predicted" in recs[-1]
+    pred = recs[-1]["predicted"]
+    want = main.analyze(fetch_list=[loss])
+    assert pred["flops_fwd"] == want.totals["flops_fwd"]
+    assert pred["flops"] == want.totals["flops_train"]
+    assert pred["peak_bytes"] == want.memory.peak_bytes_donated
+    assert pred["unmodeled_ops"] == 0
+    # prediction stays OUT of the attribution signature: a second feed
+    # signature compiles with cause new_feed_signature, not unexplained
+    feed2 = {"x": np.zeros((8, 8), np.float32),
+             "y": np.zeros((8, 1), np.float32)}
+    exe.run(main, feed=feed2, fetch_list=[loss])
+    recs = [r for r in explain_compiles("executor")["records"]
+            if r["identity"] == main._serial]
+    assert recs[-1]["cause"] == "new_feed_signature"
+    assert monitor.get_stat("predicted.executor.flops") == pred["flops"]
+    assert monitor.get_stat("predicted.executor.peak_bytes") > 0
+    exe.close()
+
+
+def test_analyze_does_not_perturb_donated_training():
+    """Reading shapes through the analyzer must not unbind or escape
+    the executor-resident params (param_array peeks, never fetches)."""
+    main, loss = _mlp_program(hidden=8, depth=1)
+    exe = paddle.static.Executor()
+    feed = {"x": np.ones((4, 8), np.float32),
+            "y": np.ones((4, 1), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    state = exe._states[main._serial]
+    state.escaped.clear()
+    main.analyze(fetch_list=[loss])       # peeks at bound params
+    assert state.escaped == set()         # no slot was marked escaped
+    l1, = exe.run(main, feed=feed, fetch_list=[loss])
+    assert np.isfinite(l1).all()
+    exe.close()
+
+
+# ------------------------------------------------------ D2S104 lint --
+def _fx_numpy_sync(x):
+    v = x.sum()
+    arr = v.numpy()
+    return arr
+
+
+def _fx_float_sync(x):
+    s = float(x.sum())
+    return s * 2
+
+
+def _fx_concrete_conversions(x, n=3):
+    b = int(x.shape[0])      # shape metadata: concrete, fine
+    m = float(len(x))        # len() is concrete
+    k = int(n)               # plain python param use... tainted too,
+    return x * (b + m + k)   # but n is a param -> conservatively flagged
+
+
+def test_lint_d2s104_numpy_and_item():
+    from paddle_tpu.jit.lint import lint
+    diags = lint(_fx_numpy_sync)
+    assert [d.code for d in diags] == ["D2S104"]
+    assert diags[0].severity == "error"  # nothing rewrites .numpy()
+    assert "v.numpy()" in diags[0].message
+    src = open(__file__).read().splitlines()[diags[0].line - 1]
+    assert "v.numpy()" in src
+
+
+def test_lint_d2s104_float_conversion_is_a_warning():
+    from paddle_tpu.jit.lint import lint
+    diags = lint(_fx_float_sync)
+    assert [d.code for d in diags] == ["D2S104"]
+    # the cast transformer LOWERS float() to astype — the code runs,
+    # it just never yields a Python scalar; warning, not error
+    assert diags[0].severity == "warning"
+    assert "astype" in diags[0].message
+
+
+def test_lint_d2s104_skips_concrete_metadata():
+    from paddle_tpu.jit.lint import lint
+    diags = lint(_fx_concrete_conversions)
+    # int(x.shape[0]) and float(len(x)) are concrete; only int(n) (a
+    # parameter, conservatively tensor-tainted) is flagged
+    assert [d.code for d in diags] == ["D2S104"]
+    assert "int(n)" in diags[0].message
+
+
+def _fx_shadowed_float(x, float=None):
+    return float(x)
+
+
+def test_lint_d2s104_not_doubled_on_shadowed_builtin():
+    from paddle_tpu.jit.lint import lint
+    diags = lint(_fx_shadowed_float)
+    # the shadowed builtin is D2S103's finding, not a host sync
+    assert [d.code for d in diags] == ["D2S103"]
+
+
+# ------------------------------------------------------------- CLIs --
+_HAZARD_MODULE = """
+import numpy as np
+import paddle_tpu as paddle
+
+main = paddle.static.Program()
+with paddle.static.program_guard(main):
+    x = paddle.static.data("x", [300000], "float32")
+    big = x + paddle.to_tensor(np.ones(300000, np.float32))
+    loss = big.sum()
+"""
+
+
+def _run_cli(mod, argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = mod.main(argv)
+    return rc, buf.getvalue()
+
+
+def _tool(name):
+    sys.path.insert(0, TOOLS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.remove(TOOLS)
+
+
+def test_lint_program_json_and_hazard_exit(tmp_path):
+    mod = tmp_path / "hazard_script.py"
+    mod.write_text(_HAZARD_MODULE)
+    lint_program = _tool("lint_program")
+    rc, out = _run_cli(lint_program, [str(mod), "--format", "json"])
+    rep = json.loads(out)          # machine-readable: parses as one doc
+    assert rc == 1                 # error-severity HAZARD fails the run
+    assert rep["errors"] == 1 and rep["programs"]
+    diags = rep["programs"][0]["diagnostics"]
+    err = next(d for d in diags if d["severity"] == "error")
+    assert err["pass_name"] == "host-transfer"
+    assert err["loc"] and "hazard_script.py" in err["loc"]
+
+
+def test_analyze_program_cli_text_and_json(tmp_path):
+    mod = tmp_path / "train_mod.py"
+    mod.write_text(
+        "import paddle_tpu as paddle\n"
+        "import paddle_tpu.nn.functional as F\n"
+        "from paddle_tpu import optimizer\n"
+        "main = paddle.static.Program()\n"
+        "with paddle.static.program_guard(main):\n"
+        "    x = paddle.static.data('x', [None, 8], 'float32')\n"
+        "    y = paddle.static.data('y', [None, 1], 'float32')\n"
+        "    loss = F.mse_loss(paddle.static.nn.fc(x, 1), y)\n"
+        "    optimizer.Adam(learning_rate=1e-3).minimize(loss)\n"
+        "loss.name = 'loss'\n")
+    analyze_program = _tool("analyze_program")
+    rc, out = _run_cli(analyze_program,
+                       [str(mod), "--fetch", "loss", "--batch-size", "4"])
+    assert rc == 0, out
+    assert "roofline (predicted):" in out and "fusion candidates" in out
+    assert "train_mod.py:" in out      # FLAGS_static_anchors anchored
+    rc, out = _run_cli(
+        analyze_program,
+        [str(mod), "--format", "json", "--batch-size", "4", "--chip",
+         "v5e"])
+    assert rc == 0
+    doc = json.loads(out)
+    rep = doc["programs"][0]["report"]
+    # fc: 2*B*8*1 matmul + B bias; mse: 4 per output element (B=4)
+    assert rep["totals"]["flops_fwd"] == 2 * 4 * 8 + 4 + 4 * 4
+    assert list(rep["roofline"]) == ["v5e"]
+
+
+def test_analyze_smoke_in_process():
+    smoke = _tool("analyze_smoke")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = smoke.main()
+    assert rc == 0, buf.getvalue()
+    assert "analyze_smoke: PASS" in buf.getvalue()

@@ -11,15 +11,20 @@ Usage:
   python tools/lint_program.py my_train_script.py
   python tools/lint_program.py mypkg.model --fetch loss
   python tools/lint_program.py script.py --lint-all --strict
+  python tools/lint_program.py script.py --format json   # CI annotation
 
 The module is imported under ``paddle.enable_static()`` with
 ``FLAGS_static_verify`` on (so recorded ops carry file:line anchors); a
 reference-style script therefore builds its Programs at import time.
 Every ``static.Program`` found in the module namespace is run through
-``static.analysis.check``; every ``jit.to_static`` function (and, with
-``--lint-all``, every plain module-level function) is run through the
-dy2static lint.  Exit status: 1 when any error-severity finding exists
-(warnings too with ``--strict``), else 0.
+``static.analysis.check`` — the verifier passes AND the TPU-readiness
+hazard passes (host-transfer, wide-dtype, donation-alias); every
+``jit.to_static`` function (and, with ``--lint-all``, every plain
+module-level function) is run through the dy2static lint.
+``--format json`` prints one machine-readable object (per-program and
+per-function diagnostic records) instead of the text report.  Exit
+status: 1 when any error-severity finding exists — verifier errors and
+analyzer hazards alike (warnings too with ``--strict``), else 0.
 """
 from __future__ import annotations
 
@@ -65,6 +70,9 @@ def main(argv=None) -> int:
     ap.add_argument("--no-verify-flag", action="store_true",
                     help="do not force FLAGS_static_verify during "
                          "import (ops then record no source anchors)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="'json' prints one machine-readable object "
+                         "(for CI annotation) instead of the report")
     args = ap.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -86,7 +94,18 @@ def main(argv=None) -> int:
 
     fetch = [n for n in args.fetch.split(",") if n]
     resolved_somewhere = set()
-    n_err = n_warn = 0
+    n_err = n_warn = n_info = 0
+    as_json = args.format == "json"
+    report = {"programs": [], "functions": [], "unresolved_fetch": []}
+
+    def tally(sev):
+        nonlocal n_err, n_warn, n_info
+        if sev == Diagnostic.ERROR:
+            n_err += 1
+        elif sev == Diagnostic.INFO:
+            n_info += 1
+        else:
+            n_warn += 1
 
     # -- Programs ---------------------------------------------------------
     programs = [(nm, v) for nm, v in sorted(vars(mod).items())
@@ -104,14 +123,16 @@ def main(argv=None) -> int:
                  if graph.resolve_fetch(f) is not None]
         resolved_somewhere.update(roots)
         diags = analysis.check(prog, fetch_list=roots or None)
-        print(f"Program {nm!r} (#{prog._serial}, {len(prog.nodes)} ops):"
-              f" {len(diags)} finding(s)")
+        report["programs"].append({
+            "name": nm, "serial": prog._serial, "ops": len(prog.nodes),
+            "diagnostics": [d.to_dict() for d in diags]})
+        if not as_json:
+            print(f"Program {nm!r} (#{prog._serial}, "
+                  f"{len(prog.nodes)} ops): {len(diags)} finding(s)")
+            for d in diags:
+                print(f"  {d}")
         for d in diags:
-            print(f"  {d}")
-            if d.severity == Diagnostic.ERROR:
-                n_err += 1
-            else:
-                n_warn += 1
+            tally(d.severity)
 
     # -- functions --------------------------------------------------------
     fns = []
@@ -123,26 +144,35 @@ def main(argv=None) -> int:
             fns.append((nm, v))
     for nm, fn in fns:
         diags = lint(fn)
-        print(f"function {nm!r}: {len(diags)} finding(s)")
+        report["functions"].append({
+            "name": nm, "diagnostics": [d.to_dict() for d in diags]})
+        if not as_json:
+            print(f"function {nm!r}: {len(diags)} finding(s)")
+            for d in diags:
+                print(f"  {d}")
         for d in diags:
-            print(f"  {d}")
-            if d.severity == "error":
-                n_err += 1
-            else:
-                n_warn += 1
+            tally(d.severity)
 
     for f in fetch:
         if f not in resolved_somewhere:
-            print(f"error: --fetch {f!r} does not name a Variable in "
-                  f"any analysed Program (typo?); dead-code analysis "
-                  f"ran without it")
+            report["unresolved_fetch"].append(f)
+            if not as_json:
+                print(f"error: --fetch {f!r} does not name a Variable "
+                      f"in any analysed Program (typo?); dead-code "
+                      f"analysis ran without it")
             n_err += 1
 
-    if not programs and not fns:
+    if not programs and not fns and not as_json:
         print("nothing to analyse: module defines no static.Program and "
               "no to_static function (try --lint-all)")
 
-    print(f"lint_program: {n_err} error(s), {n_warn} warning(s)")
+    report.update(errors=n_err, warnings=n_warn, infos=n_info)
+    if as_json:
+        import json
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(f"lint_program: {n_err} error(s), {n_warn} warning(s), "
+              f"{n_info} info(s)")
     return 1 if (n_err or (args.strict and n_warn)) else 0
 
 
