@@ -764,6 +764,165 @@ def bench_serving(args, dev, on_tpu):
     }
 
 
+def bench_generation(args, dev, on_tpu):
+    """Ragged-generation serving throughput (ISSUE 7 acceptance): a
+    stream of generative requests (ragged prompt lengths AND ragged
+    token budgets) through the continuous-batching ``GenerationEngine``
+    (paged KV cache, token-level scheduling) vs the same requests
+    generated ONE AT A TIME through ``nn.dynamic_decode`` over a dense
+    padded KV cache (beam 1, compile-cached via ``cache=True`` so the
+    baseline pays zero re-trace — the comparison isolates batching, not
+    compile amnesia).  Both sides run the same transformer LM.
+
+    Both sides provision the same serving max context (what the server
+    *admits*, not what this stream happens to send): the dense baseline
+    pays worst-case provisioning on every token — a [t_max] cache
+    update plus dense attention over all t_max rows — while the paged
+    engine allocates pages on demand and its context-bucketed decode
+    step gathers only the live context.  That asymmetry is the paged
+    KV cache's whole point (Ragged Paged Attention, PAPERS.md), on top
+    of token-level batching (one compiled step carries ``num_slots``
+    sequences, freed slots backfilled mid-flight).  The baseline is
+    compile-cached at the single provisioned shape — the standard
+    pre-paging deployment (bucketing the *time* dimension per request
+    is exactly what the page table replaces).
+
+    Gate: >= 3x token throughput inside the same p99 request-latency
+    SLO (``latency_bound_ms``), zero steady-state decode recompiles."""
+    import threading
+
+    from paddle_tpu import nn, serving
+
+    n_requests = args.steps or 48
+    num_slots = 8
+    reps = 2
+    max_new_lo, max_new_hi = 16, 48
+    prompt_lengths = [4, 6, 8, 12, 16, 24, 32]
+    max_context = 512                  # what the server provisions for
+    # per-request p99 SLO both paths must meet: a quiet-machine floor,
+    # widened on loaded runners by the baseline's own measured tail (a
+    # machine-speed proxy) — slot-sharing may not blow up the tail by
+    # more than slo_vs_baseline x a dedicated per-request run
+    slo_floor_ms = 900.0
+    slo_vs_baseline = 3.5
+    t_max_cells = max_context          # dense baseline cache rows
+
+    model = serving.PagedDecoderLM(vocab_size=1024, hidden=256,
+                                   num_layers=2, num_heads=8,
+                                   ffn=2048, seed=7)
+    EOS = model.vocab_size - 1
+    rng = np.random.RandomState(42)
+    prompts = [rng.randint(0, 128, rng.choice(prompt_lengths)).tolist()
+               for _ in range(n_requests)]
+    budgets = [int(rng.randint(max_new_lo, max_new_hi + 1))
+               for _ in range(n_requests)]
+    tokens_total = sum(budgets)
+    t_decode_max = max_new_hi + 1      # budget tokens + the forced EOS
+
+    # -- baseline: per-request dynamic_decode over a dense padded cache --
+    cell = model.make_cell(EOS)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=EOS,
+                               beam_size=1)
+
+    def gen_one(prompt, limit):
+        st = model.init_cell_state(prompt, t_max_cells)
+        st["limit"] = np.full((1,), limit, np.int32)
+        dec.start_token = int(prompt[-1])
+        seq, _, lens = nn.dynamic_decode(dec, st,
+                                         max_step_num=t_decode_max,
+                                         return_length=True, cache=True)
+        n = int(np.asarray(lens.numpy())[0, 0])
+        return np.asarray(seq.numpy())[0, 0, :n]
+
+    # warm both paths: every prompt-length shape for the baseline's
+    # eager prefill, the cached decode loop, and the engine's buckets
+    for L in sorted({len(p) for p in prompts}):
+        gen_one(list(range(1, L + 1)), 2)
+    # pool sized for what the slots can actually reserve (page demand
+    # follows the traffic, not the advertised context — the paged
+    # cache's memory win); prompt buckets cover the traffic mix
+    engine = serving.GenerationEngine(model, num_slots=num_slots,
+                                      page_size=8,
+                                      max_context=max_context,
+                                      num_pages=128,
+                                      prompt_buckets=[8, 16, 32],
+                                      max_queue=4 * n_requests)
+    engine.warmup()
+
+    errors = []
+    conc_lat: list = []
+
+    def client(idx):
+        try:
+            for i in range(idx, n_requests, num_slots):
+                t0 = time.perf_counter()
+                out = engine.generate_sync(prompts[i], timeout=300,
+                                           max_new_tokens=budgets[i])
+                conc_lat.append(time.perf_counter() - t0)
+                if len(out) != budgets[i]:
+                    errors.append(f"req {i}: {len(out)} tokens, "
+                                  f"budget {budgets[i]}")
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(f"{type(e).__name__}: {e}")
+
+    dt_seq = dt_conc = 0.0
+    seq_lat: list = []
+    for _ in range(reps):
+        # sequential per-request generation, as a single-caller server
+        t0 = time.perf_counter()
+        for p, b in zip(prompts, budgets):
+            t1 = time.perf_counter()
+            out = gen_one(p, b)
+            seq_lat.append(time.perf_counter() - t1)
+            if len(out) != b + 1 or out[-1] != EOS:
+                errors.append(f"baseline: {len(out)} tokens for "
+                              f"budget {b}")
+        dt_seq += time.perf_counter() - t0
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(num_slots)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt_conc += time.perf_counter() - t0
+    stats = engine.stats()
+    engine.close()
+    if errors:
+        raise RuntimeError(f"generation bench failed: {errors[:3]}")
+
+    def p99(lat):
+        return float(np.percentile(np.asarray(lat) * 1000.0, 99))
+
+    toks = tokens_total * reps
+    bound_ms = max(slo_floor_ms, slo_vs_baseline * p99(seq_lat))
+    return {
+        "metric": "serving_generation_tokens_per_sec",
+        "value": round(toks / dt_conc, 2),
+        "unit": "tokens/s",
+        "speedup_vs_dynamic_decode": round(dt_seq / dt_conc, 3),
+        "dynamic_decode_tokens_per_sec": round(toks / dt_seq, 2),
+        "requests": n_requests * reps,
+        "num_slots": num_slots,
+        "latency_bound_ms": round(bound_ms, 2),
+        "p99_latency_ms": round(p99(conc_lat), 2),
+        "p99_latency_ms_baseline": round(p99(seq_lat), 2),
+        "p99_within_bound": p99(conc_lat) <= bound_ms,
+        "ttft_ms_p95": round(stats["ttft_ms"]["p95"], 2),
+        "mean_slot_occupancy": round(stats["mean_slot_occupancy"], 3),
+        "prefill_decode_ratio": round(stats["prefill_decode_ratio"], 3),
+        "decode_steps": stats["counters"]["decode_steps"],
+        "recompiles_after_warmup": stats["recompiles_after_warmup"],
+        "page_pool_pages": stats["page_pool"]["num_pages"],
+        "ctx_buckets": stats["ctx_buckets"],
+        "config": {"model": "paged-lm 256h x2L 8H ffn2048", "vocab": 1024,
+                   "prompt_lengths": prompt_lengths,
+                   "max_new": [max_new_lo, max_new_hi],
+                   "page_size": 8, "max_context": max_context},
+    }
+
+
 def bench_lenet_dygraph(args):
     """Dygraph (eager, un-jitted) smoke benchmark (BASELINE.json
     configs[0]): LeNet/MNIST shapes on CPU, measuring per-op Python
@@ -884,6 +1043,13 @@ def main():
         except Exception as e:
             extra["serving"] = {
                 "metric": "serving_engine_requests_per_sec",
+                "error": f"{type(e).__name__}: {e}"}
+        try:
+            extra["serving_generation"] = _retry_bench(
+                bench_generation, args, dev, on_tpu)
+        except Exception as e:
+            extra["serving_generation"] = {
+                "metric": "serving_generation_tokens_per_sec",
                 "error": f"{type(e).__name__}: {e}"}
     if args.suite in ("all", "lenet"):
         extra["lenet_dygraph"] = bench_lenet_dygraph(args)

@@ -158,6 +158,11 @@ define_flag("serving_dispatch_retries", 2,
             "InferenceEngine: batch dispatch attempts after a failure "
             "before the batch's requests are failed (inference is pure, "
             "so a flaked dispatch is safely retried).")
+define_flag("serving_decode_retries", 2,
+            "GenerationEngine: decode-step attempts after a failure "
+            "before the in-flight sequences are failed (the step is "
+            "functional over the KV pool, so a flaked dispatch is "
+            "safely retried).")
 define_flag("metrics_dump_path", "",
             "When set, training appends periodic monitor-metrics "
             "snapshots (stats + histograms, one JSON object per line) "

@@ -33,7 +33,8 @@ from .layer.transformer import (MultiHeadAttention, Transformer,  # noqa
                                 TransformerDecoder, TransformerDecoderLayer,
                                 TransformerEncoder, TransformerEncoderLayer)
 
-from .decode import BeamSearchDecoder, dynamic_decode, gather_tree  # noqa
+from .decode import (BeamSearchDecoder, cell_step, dynamic_decode,  # noqa
+                     gather_tree)
 
 # -- round-4 parity additions --------------------------------------------
 from .layer.activation import LogSigmoid  # noqa: F401,E402

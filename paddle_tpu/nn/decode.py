@@ -13,6 +13,8 @@ final beams) is a reverse ``lax.scan``.
 """
 from __future__ import annotations
 
+import collections
+import threading
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -22,7 +24,8 @@ import numpy as np
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 
-__all__ = ["BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+__all__ = ["BeamSearchDecoder", "dynamic_decode", "gather_tree",
+           "cell_step"]
 
 
 def _gather_tree_impl(step_ids, parent_ids):
@@ -66,46 +69,74 @@ class BeamSearchDecoder:
         self.output_fn = output_fn
 
 
+def _arr(t):
+    return t.data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def cell_step(decoder: BeamSearchDecoder, tokens, states):
+    """One step of the decoder's cell contract — the single-step API a
+    token-level scheduler (serving/generation.py) or a hand-rolled loop
+    can drive directly.
+
+    ``tokens``: [N] token ids (Tensor or array); ``states``: the cell
+    state pytree with leading dim N.  Embeds via ``embedding_fn``, runs
+    ``cell(inputs, states)``, projects via ``output_fn``, and returns
+    ``(log_probs [N, V] float32, new_states)`` with raw-array leaves.
+    ``dynamic_decode`` runs exactly this inside its loop."""
+    dec = decoder
+    inp = tokens if isinstance(tokens, Tensor) else Tensor(
+        jnp.asarray(tokens))
+    if dec.embedding_fn is not None:
+        inp = dec.embedding_fn(inp)
+    out, new_states = dec.cell(inp, jax.tree.map(
+        Tensor, states,
+        is_leaf=lambda x: not isinstance(x, (list, tuple, dict))))
+    if dec.output_fn is not None:
+        out = dec.output_fn(out)
+    logits = _arr(out)
+    new_states = jax.tree.map(_arr, new_states,
+                              is_leaf=lambda x: isinstance(x, Tensor))
+    return jax.nn.log_softmax(logits.astype(jnp.float32)), new_states
+
+
+# cache=True: compiled decode programs keyed on (decoder, shapes).  The
+# entry holds the decoder strongly, so an id can never be recycled into
+# a live key; bounded LRU so abandoned decoders don't pile up.
+_DECODE_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_DECODE_CACHE_MAX = 32
+_DECODE_LOCK = threading.Lock()
+
 
 def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
                    max_step_num: int = 64, is_test: bool = True,
-                   return_length: bool = False, **kwargs):
+                   return_length: bool = False, cache: bool = False,
+                   **kwargs):
     """Beam-search decode loop (reference: nn/decode.py
     dynamic_decode:997).  ``inits``: initial cell state pytree of
     Tensors/arrays with leading batch dim B.  Returns
     ``(token_ids [B, K, max_step_num], beam_scores [B, K])`` (+ lengths
     with ``return_length=True``), beams sorted best-first; positions
     past a beam's end are padded with ``end_token``.
+
+    ``cache=True`` compiles the whole decode loop ONCE per (decoder,
+    state shapes, max_step_num) and replays it for later calls — the
+    per-request serving path (start token is a traced input, so
+    requests differing only in start/initial state hit the same
+    executable).  Caching bakes the current parameter *values* into the
+    executable: use it for frozen-weight inference, not mid-training.
     """
     dec = decoder
     K, V_end = dec.beam_size, dec.end_token
 
-    def _arr(t):
-        return t.data if isinstance(t, Tensor) else jnp.asarray(t)
-
     states0 = jax.tree.map(_arr, inits,
                            is_leaf=lambda x: isinstance(x, Tensor))
-    leaves = jax.tree.leaves(states0)
+    leaves, treedef = jax.tree.flatten(states0)
     assert leaves, "dynamic_decode needs initial states with a batch dim"
     B = leaves[0].shape[0]
     T = int(max_step_num)
 
-    def cell_step(tok_flat, states_flat):
-        """[B*K] tokens + flat states -> ([B*K, V] logprobs, new states)."""
-        inp = Tensor(tok_flat)
-        if dec.embedding_fn is not None:
-            inp = dec.embedding_fn(inp)
-        out, new_states = dec.cell(inp, jax.tree.map(
-            Tensor, states_flat,
-            is_leaf=lambda x: not isinstance(x, (list, tuple, dict))))
-        if dec.output_fn is not None:
-            out = dec.output_fn(out)
-        logits = _arr(out)
-        new_states = jax.tree.map(_arr, new_states,
-                                  is_leaf=lambda x: isinstance(x, Tensor))
-        return jax.nn.log_softmax(logits.astype(jnp.float32)), new_states
-
-    def decode_fn():
+    def decode_run(leaves, start_tok):
+        states0 = jax.tree.unflatten(treedef, leaves)
         # tile the initial state across beams: [B, ...] -> [B*K, ...]
         states = jax.tree.map(
             lambda a: jnp.repeat(a, K, axis=0), states0)
@@ -114,7 +145,7 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
         # multiply (reference kInitialBeamScores)
         scores = jnp.tile(jnp.where(jnp.arange(K) == 0, 0.0, NEG)[None],
                           (B, 1))
-        tokens = jnp.full((B, K), dec.start_token, jnp.int32)
+        tokens = jnp.full((B, K), start_tok, jnp.int32)
         finished = jnp.zeros((B, K), bool)
         # unwritten history must be self-describing for an early exit:
         # ids pad with end_token, parents with the identity permutation
@@ -130,7 +161,7 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
 
         def body(carry):
             t, tokens, scores, states, finished, ids_h, par_h, lens = carry
-            logp, new_states = cell_step(tokens.reshape(-1), states)
+            logp, new_states = cell_step(dec, tokens.reshape(-1), states)
             V = logp.shape[-1]
             logp = logp.reshape(B, K, V)
             # finished beams only extend with end_token at zero cost
@@ -166,6 +197,26 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
             cond, body, carry)
         seq = _gather_tree_impl(ids_h, par_h)          # [T, B, K]
         return seq.transpose(1, 2, 0), scores, lens, t
+
+    runner = decode_run
+    if cache:
+        avals_key = tuple((tuple(a.shape), str(jnp.asarray(a).dtype))
+                          for a in leaves)
+        key = (id(dec), K, T, V_end, treedef, avals_key)
+        with _DECODE_LOCK:
+            hit = _DECODE_CACHE.get(key)
+            if hit is not None:
+                _DECODE_CACHE.move_to_end(key)
+                runner = hit[1]
+        if runner is decode_run:
+            runner = jax.jit(decode_run)
+            with _DECODE_LOCK:
+                _DECODE_CACHE[key] = (dec, runner)
+                while len(_DECODE_CACHE) > _DECODE_CACHE_MAX:
+                    _DECODE_CACHE.popitem(last=False)
+
+    def decode_fn():
+        return runner(leaves, jnp.int32(dec.start_token))
 
     seq, scores, lens, t = apply(decode_fn, op_name="dynamic_decode",
                                  nondiff=True)

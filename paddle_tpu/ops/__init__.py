@@ -21,6 +21,10 @@ from .control_flow import *  # noqa: F401,F403
 from . import linalg  # noqa: F401
 from .linalg import norm, dist  # noqa: F401
 from . import sequence  # noqa: F401
+from . import attention  # noqa: F401
+from .attention import (paged_attention,  # noqa: F401
+                        paged_attention_supported,
+                        register_paged_attention_kernel)
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply as _apply

@@ -12,13 +12,28 @@ users"):
   set of precompiled bucket sizes (zero recompiles after warmup),
   futures-based API, queue-full load shedding, per-request in-queue
   deadlines, graceful ``drain()``/``close()``.
+- :class:`GenerationEngine` — token-level continuous batching for
+  generative traffic over a paged KV cache
+  (:mod:`paddle_tpu.serving.kv_cache`): prefill/decode phase split,
+  admission into free decode slots between steps, eviction + page
+  reclamation on finish/expiry, streaming token futures
+  (:class:`GenerationStream`), zero steady-state recompiles.
+  :class:`PagedDecoderLM` is the reference model for the paged decode
+  contract.
 - :mod:`paddle_tpu.serving.http` — stdlib ``ThreadingHTTPServer``
-  front-end (``/predict``, ``/healthz``, ``/metrics``) plus a tiny
-  client helper; ``tools/serve.py`` is the CLI entry point.
+  front-end (``/predict``, ``/generate`` with chunked token streaming,
+  ``/healthz``, ``/metrics``) plus a keep-alive client helper;
+  ``tools/serve.py`` is the CLI entry point.
 """
 from .engine import (DeadlineExceeded, EngineClosed,  # noqa: F401
                      InferenceEngine, QueueFull, ServingError)
+from .generation import (GenerationEngine, GenerationError,  # noqa: F401
+                         GenerationStream)
+from .kv_cache import KVCacheConfig, PagePool  # noqa: F401
+from .models import PagedDecoderLM  # noqa: F401
 from .http import Client, ServingServer  # noqa: F401
 
 __all__ = ["InferenceEngine", "ServingError", "QueueFull",
-           "DeadlineExceeded", "EngineClosed", "ServingServer", "Client"]
+           "DeadlineExceeded", "EngineClosed", "ServingServer", "Client",
+           "GenerationEngine", "GenerationError", "GenerationStream",
+           "KVCacheConfig", "PagePool", "PagedDecoderLM"]

@@ -1,0 +1,871 @@
+"""Continuous batching: a token-level scheduler over a paged KV cache.
+
+:class:`GenerationEngine` is the generative counterpart of
+:class:`~paddle_tpu.serving.engine.InferenceEngine` (ROADMAP item 3,
+"LLM serving at user scale").  Where the inference engine coalesces
+whole fixed-shape requests, generation is scheduled *per token*:
+
+- **Prefill/decode phase split.**  An admitted request's prompt is run
+  once through a bucket-compiled ``prefill`` (dense causal attention,
+  K/V scattered into freshly reserved pages) producing its first token;
+  afterwards the sequence lives in a *slot* of the in-flight decode
+  batch, where ONE compiled ``decode`` step of static shape
+  ``[num_slots]`` advances every active sequence a token at a time over
+  the paged cache (:mod:`paddle_tpu.serving.kv_cache`).
+- **Continuous batching.**  The scheduler admits queued requests into
+  free slots *between decode steps* and evicts finished / expired
+  sequences the moment they end, freeing their pages — decode slots are
+  recycled mid-flight, never waiting for a whole batch to finish.
+- **Static shapes, zero steady-state recompiles.**  Every compiled
+  entry point is AOT-lowered (``jit(...).lower(...).compile()``) at
+  :meth:`warmup`; the serve path only ever *calls* precompiled
+  executables, so ``recompiles_after_warmup`` is structurally zero.
+  Raggedness lives in page tables and length vectors, not in shapes.
+- **Context-width bucketing.**  The reference paged-attention gather
+  is O(page-table width); compiling one decode variant per power-of-two
+  table width and picking the narrowest that covers the longest
+  *active* sequence keeps the step O(live context), not O(engine max
+  context) — a dense per-request cache must pay worst-case provisioning
+  on every token (the raggedness tax the paged layout removes; the
+  Pallas ragged kernel tier will remove the remaining bucket padding).
+- **Determinism.**  A sequence's tokens depend only on its own prompt,
+  seed, and temperature: per-row computation is independent of batch
+  composition, page placement is invisible through the page table, and
+  sampling keys are derived from (seed, position) — so continuous
+  batching is bitwise-reproducible regardless of admission order (the
+  chaos gate asserts this).
+
+Robustness mirrors the inference engine: bounded queue with
+:class:`~paddle_tpu.serving.engine.QueueFull` shedding, in-queue AND
+mid-generation deadlines (:class:`DeadlineExceeded` evicts a decoding
+sequence and frees its pages), decode-step retries around
+``fault.point("serving.decode_step")`` (the step is functional over the
+pool — injected flakes fire before dispatch, so a retry is safe), and
+``drain()``/``close()`` that never strand a future or leak a page.
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags, obs_hook
+from ..testing import fault
+from ..utils import monitor
+from .engine import (DeadlineExceeded, EngineClosed, QueueFull,
+                     ServingError, _REQUEST_IDS, _safe_set_exception,
+                     _safe_set_result)
+from .kv_cache import KVCacheConfig, PagePool, pages_needed
+
+__all__ = ["GenerationEngine", "GenerationStream", "GenerationError"]
+
+
+class GenerationError(ServingError):
+    """A sequence failed mid-generation (decode retries exhausted)."""
+
+
+_DONE = object()        # stream sentinel: clean end of tokens
+
+
+class GenerationStream:
+    """Handle for one generation request.
+
+    Tokens arrive incrementally via iteration (:meth:`__iter__` /
+    :meth:`tokens`); the full list lands on :attr:`future` when the
+    sequence finishes.  Errors (deadline, shed at eviction, decode
+    failure) raise from both the iterator and ``result()``."""
+
+    def __init__(self, sid: int):
+        self.sid = sid
+        self.future: Future = Future()
+        self._q: "queue.Queue" = queue.Queue()
+        self.finish_reason: Optional[str] = None    # "eos"|"length"|...
+
+    def __iter__(self):
+        return self.tokens()
+
+    def tokens(self, timeout: Optional[float] = None):
+        """Yield token ids as the scheduler produces them."""
+        while True:
+            item = self._q.get(timeout=timeout)
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block for the complete token list."""
+        return self.future.result(timeout)
+
+    # scheduler-side helpers ------------------------------------------------
+    def _push(self, tok: int) -> None:
+        self._q.put(tok)
+
+    def _finish(self, tokens: List[int], reason: str) -> None:
+        # resolve the future BEFORE the queue sentinel: a consumer that
+        # drains tokens() and immediately calls result(0) must never
+        # race a not-yet-resolved future
+        self.finish_reason = reason
+        _safe_set_result(self.future, list(tokens))
+        self._q.put(_DONE)
+
+    def _fail(self, exc: BaseException, reason: str) -> None:
+        self.finish_reason = reason
+        _safe_set_exception(self.future, exc)
+        self._q.put(exc)
+
+
+class _Sequence:
+    """One admitted-or-queued generation request (scheduler-private)."""
+
+    __slots__ = ("prompt", "max_new", "eos_id", "temperature", "seed",
+                 "deadline", "t_enq", "t_first", "sid", "stream", "pages",
+                 "slot", "tokens", "last_token", "position")
+
+    def __init__(self, prompt, max_new, eos_id, temperature, seed,
+                 deadline):
+        self.prompt = prompt                  # np.int32 [T]
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.temperature = temperature
+        self.seed = seed
+        self.deadline = deadline              # monotonic seconds or None
+        self.t_enq = time.monotonic()
+        self.t_first: Optional[float] = None
+        self.sid = next(_REQUEST_IDS)
+        self.stream = GenerationStream(self.sid)
+        self.pages: List[int] = []
+        self.slot: Optional[int] = None
+        self.tokens: List[int] = []           # generated (no prompt)
+        self.last_token = 0
+        self.position = 0                     # total tokens in cache
+
+
+class GenerationEngine:
+    """Continuous-batching generative decode over a paged KV cache.
+
+    Args:
+        model: paged decode contract — attributes ``num_layers`` /
+            ``num_kv_heads`` / ``head_dim`` (KV geometry), methods
+            ``prefill(tokens[T], length, kv, page_table[P])`` ->
+            ``(logits[V], kv)`` and ``decode(tokens[S], positions[S],
+            kv, page_tables[S, P])`` -> ``(logits[S, V], kv)`` (see
+            :class:`~paddle_tpu.serving.models.PagedDecoderLM`).
+        num_slots: static decode-batch width (in-flight sequences).
+        page_size: tokens per KV page.
+        max_context: per-sequence token capacity (prompt + generated).
+        num_pages: physical pool size; defaults to full occupancy
+            (``num_slots * pages_per_seq``) so admission can never be
+            page-starved below slot capacity.
+        prompt_buckets: prompt pad lengths to precompile (each is one
+            AOT variant); default powers of two up to ``max_context``.
+        max_queue / default_deadline_ms: as on ``InferenceEngine``.
+        decode_retries: decode-step re-runs before the in-flight batch
+            is failed (default ``FLAGS_serving_decode_retries``).
+        donate_kv: thread the KV pool through compiled steps with
+            buffer donation (in-place pool updates).  Injected
+            ``serving.decode_step`` faults fire before dispatch, so
+            those retries are always safe; a failure raised by the
+            executable itself is NOT replayed under donation (the
+            inputs may be invalidated) — the in-flight batch is failed
+            and the pool rebuilt instead.
+    """
+
+    def __init__(self, model, num_slots: int = 8, page_size: int = 16,
+                 max_context: int = 256,
+                 num_pages: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 max_queue: int = 256,
+                 default_deadline_ms: Optional[float] = None,
+                 decode_retries: Optional[int] = None,
+                 donate_kv: bool = True):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        self._model = model
+        self._slots_n = int(num_slots)
+        cfg = KVCacheConfig(
+            num_layers=model.num_layers, num_kv_heads=model.num_kv_heads,
+            head_dim=model.head_dim, page_size=page_size,
+            num_pages=(int(num_pages) if num_pages is not None
+                       else self._slots_n *
+                       -(-int(max_context) // int(page_size))),
+            max_context=max_context)
+        self.config = cfg
+        self._pool = PagePool(cfg)
+        self._P = cfg.pages_per_seq
+        if prompt_buckets is None:
+            prompt_buckets, b = [], 8
+            while b < cfg.max_context:
+                prompt_buckets.append(b)
+                b <<= 1
+            prompt_buckets.append(cfg.max_context)
+        self._prompt_buckets = sorted({int(b) for b in prompt_buckets})
+        if self._prompt_buckets[0] < 1 \
+                or self._prompt_buckets[-1] > cfg.max_context:
+            raise ValueError("prompt buckets must lie in "
+                             f"[1, {cfg.max_context}]")
+        # page-table width buckets for the decode step: powers of two up
+        # to the full per-sequence table
+        self._ctx_buckets, b = [], 1
+        while b < self._P:
+            self._ctx_buckets.append(b)
+            b <<= 1
+        self._ctx_buckets.append(self._P)
+        self._max_queue = int(max_queue)
+        self._default_deadline = (float(default_deadline_ms) / 1000.0
+                                  if default_deadline_ms is not None
+                                  else None)
+        self._retries = (flags.get_flag("serving_decode_retries")
+                         if decode_retries is None
+                         else int(decode_retries))
+        self._donate = bool(donate_kv)
+
+        # scheduler state (slots touched only by the scheduler thread)
+        self._slots: List[Optional[_Sequence]] = [None] * self._slots_n
+        self._tables = np.zeros((self._slots_n, self._P), np.int32)
+        # device mirrors of slot state that changes only at admission/
+        # eviction — uploaded once per change, not once per decode step
+        # (tables keyed by context-bucket width)
+        self._tables_dev: Dict[int, object] = {}
+        self._temps = np.zeros((self._slots_n,), np.float32)
+        self._temps_dev = None
+        self._any_sampling = False
+        self._zero_keys = jnp.zeros((self._slots_n, 2), jnp.uint32)
+        self._cv = threading.Condition(threading.Lock())
+        self._queue: collections.deque = collections.deque()
+        self._queued_deadlines = 0
+        self._draining = False
+        self._closing = False
+        self._closed = False
+        self._paused = False
+        self._stepping = False          # a decode/prefill is in flight
+
+        # compiled executables: (kind, bucket) -> AOT executable
+        self._execs: Dict[tuple, object] = {}
+        self._compile_count = 0
+        self._warm_variants: Optional[int] = None
+        self._serial = f"gen-{id(self):x}"
+
+        self._c: Dict[str, Union[int, float]] = collections.defaultdict(int)
+        self._occ_sum = 0.0
+        self._reg = monitor.StatRegistry()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="generation-scheduler",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- admission ---------------------------------------------------------
+    def generate(self, prompt, max_new_tokens: int = 32,
+                 eos_id: Optional[int] = None, temperature: float = 0.0,
+                 seed: int = 0,
+                 deadline_ms: Optional[float] = None) -> GenerationStream:
+        """Enqueue one prompt; returns a :class:`GenerationStream`.
+
+        ``temperature=0`` decodes greedily; ``temperature>0`` samples
+        with a key derived from ``(seed, position)`` — deterministic for
+        fixed arguments regardless of batching.  Raises
+        :class:`QueueFull` / :class:`EngineClosed` / ``ValueError``
+        synchronously."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must carry at least one token")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt.size + max_new
+        if total > self.config.max_context:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
+                f"= {total} exceeds max_context={self.config.max_context}")
+        if prompt.size > self._prompt_buckets[-1]:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens exceeds the largest "
+                f"prompt bucket {self._prompt_buckets[-1]}")
+        need = pages_needed(prompt.size, max_new, self.config.page_size)
+        if need > self._pool.num_pages:
+            raise ValueError(
+                f"request needs {need} pages, pool holds only "
+                f"{self._pool.num_pages}")
+        fault.point("serving.generate", f"prompt={prompt.size}")
+        deadline = None
+        dl_s = (float(deadline_ms) / 1000.0 if deadline_ms is not None
+                else self._default_deadline)
+        if dl_s is not None:
+            deadline = time.monotonic() + dl_s
+        seq = _Sequence(prompt, max_new, eos_id, float(temperature),
+                        int(seed), deadline)
+        with self._cv:
+            if self._closing or self._closed or self._draining:
+                raise EngineClosed("engine is draining or closed")
+            if len(self._queue) >= self._max_queue:
+                self._expire_queued_locked()
+            if len(self._queue) >= self._max_queue:
+                self._c["shed"] += 1
+                monitor.stat_add("serving.decode.shed")
+                self._emit("gen_shed", sid=seq.sid)
+                raise QueueFull(
+                    f"generation queue full ({self._max_queue}); retry "
+                    f"with backoff")
+            self._queue.append(seq)
+            if seq.deadline is not None:
+                self._queued_deadlines += 1
+            self._c["requests"] += 1
+            monitor.stat_add("serving.decode.requests")
+            self._cv.notify_all()
+        self._emit("gen_enqueue", sid=seq.sid, prompt=int(prompt.size),
+                   max_new=max_new)
+        return seq.stream
+
+    def generate_sync(self, prompt, timeout: Optional[float] = None,
+                      **kw) -> List[int]:
+        """Blocking :meth:`generate`; returns the full token list."""
+        return self.generate(prompt, **kw).result(timeout)
+
+    # -- observability helpers ---------------------------------------------
+    def _emit(self, name: str, **args) -> None:
+        trc = obs_hook._tracer
+        if trc is not None:
+            trc.emit("serving", name, args=args)
+
+    # -- compiled entry points ---------------------------------------------
+    def _select_tokens(self, logits, temps, keys):
+        """[N, V] logits -> [N] int32 tokens (greedy or sampled).
+
+        Sampling is a counter-based Gumbel-max draw: per-(seed,
+        position, vocab-index) uniforms from a murmur3-style integer
+        mix, so a sequence's draws depend only on its own request state
+        (never the PRNG impl, the slot index, or batch composition) —
+        the bitwise-reproducibility contract."""
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        V = logits.shape[-1]
+        idx = jnp.arange(1, V + 1, dtype=jnp.uint32)[None, :]
+        x = (keys[:, 0:1] * jnp.uint32(0x9E3779B1)
+             ^ keys[:, 1:2] * jnp.uint32(0x85EBCA77)
+             ^ idx * jnp.uint32(0xC2B2AE3D))
+        x = x ^ (x >> 16)
+        x = x * jnp.uint32(0x7FEB352D)
+        x = x ^ (x >> 15)
+        x = x * jnp.uint32(0x846CA68B)
+        x = x ^ (x >> 16)
+        u = (x >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+        g = -jnp.log(-jnp.log(jnp.clip(u, 1e-7, 1.0 - 1e-7)))
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None] + g
+        sampled = jnp.argmax(scaled, axis=-1).astype(jnp.int32)
+        return jnp.where(temps > 0, sampled, greedy)
+
+    def _decode_step_fn(self, k_pool, v_pool, tokens, positions, tables,
+                        temps, keys):
+        logits, (k_pool, v_pool) = self._model.decode(
+            tokens, positions, (k_pool, v_pool), tables)
+        toks = self._select_tokens(logits, temps, keys)
+        return k_pool, v_pool, toks
+
+    def _prefill_fn(self, k_pool, v_pool, tokens, length, table, temp,
+                    key):
+        logits, (k_pool, v_pool) = self._model.prefill(
+            tokens, length, (k_pool, v_pool), table)
+        tok = self._select_tokens(logits[None], temp[None], key[None])[0]
+        return k_pool, v_pool, tok
+
+    def _get_exec(self, kind: str, bucket: int):
+        key = (kind, bucket)
+        ex = self._execs.get(key)
+        if ex is None:
+            c = self.config
+            f32, i32 = jnp.float32, jnp.int32
+            pool_aval = jax.ShapeDtypeStruct(self._pool.kv[0].shape, f32)
+
+            def aval(shape, dt):
+                return jax.ShapeDtypeStruct(shape, dt)
+
+            donate = (0, 1) if self._donate else ()
+            if kind == "decode":
+                # bucket = page-table width (context bucket), so the
+                # gather is O(live context), not O(max_context)
+                S = self._slots_n
+                fn = jax.jit(self._decode_step_fn, donate_argnums=donate)
+                ex = fn.lower(pool_aval, pool_aval, aval((S,), i32),
+                              aval((S,), i32), aval((S, bucket), i32),
+                              aval((S,), f32),
+                              aval((S, 2), jnp.uint32)).compile()
+            else:
+                fn = jax.jit(self._prefill_fn, donate_argnums=donate)
+                ex = fn.lower(pool_aval, pool_aval, aval((bucket,), i32),
+                              aval((), i32), aval((self._P,), i32),
+                              aval((), f32),
+                              aval((2,), jnp.uint32)).compile()
+            self._execs[key] = ex
+            self._compile_count += 1
+            from ..observability import record_compile
+            record_compile("generation", self._serial, {
+                "kind": kind, "bucket": bucket,
+                "slots": self._slots_n, "pages": c.num_pages,
+                "page_size": c.page_size,
+            }, note="warmup" if self._warm_variants is None
+                    else "serve-path miss")
+        return ex
+
+    def warmup(self) -> int:
+        """AOT-compile every decode context bucket and prompt bucket.
+        Returns the compiled-variant count (baseline for
+        ``recompiles_after_warmup``)."""
+        for b in self._ctx_buckets:
+            self._get_exec("decode", b)
+        for b in self._prompt_buckets:
+            self._get_exec("prefill", b)
+        self._warm_variants = self._compile_count
+        return self._warm_variants
+
+    # -- scheduler ---------------------------------------------------------
+    def _expire_queued_locked(self) -> None:
+        if not self._queue or not self._queued_deadlines:
+            return
+        now = time.monotonic()
+        alive = collections.deque()
+        for s in self._queue:
+            if s.deadline is not None and now > s.deadline:
+                self._queued_deadlines -= 1
+                self._c["deadline_expired"] += 1
+                monitor.stat_add("serving.decode.deadline_expired")
+                self._emit("gen_deadline_expired", sid=s.sid, where="queue")
+                s.stream._fail(DeadlineExceeded(
+                    f"deadline expired after "
+                    f"{(now - s.t_enq) * 1000:.1f} ms in queue"),
+                    "deadline")
+            else:
+                alive.append(s)
+        self._queue = alive
+
+    def _active(self) -> List[_Sequence]:
+        return [s for s in self._slots if s is not None]
+
+    def _admit_locked(self) -> List[_Sequence]:
+        """Move queued requests into free slots while pages last."""
+        admitted = []
+        now = time.monotonic()
+        for i in range(self._slots_n):
+            if self._slots[i] is not None or not self._queue:
+                continue
+            head = self._queue[0]
+            if head.deadline is not None and now > head.deadline:
+                # lapsed while queued: expire instead of admitting
+                self._expire_queued_locked()
+                if not self._queue:
+                    break
+                head = self._queue[0]
+            need = pages_needed(head.prompt.size, head.max_new,
+                                self.config.page_size)
+            pages = self._pool.alloc(need)
+            if pages is None:       # pool starved: wait for an eviction
+                break
+            self._queue.popleft()
+            if head.deadline is not None:
+                self._queued_deadlines -= 1
+            head.pages = pages
+            head.slot = i
+            self._slots[i] = head
+            row = np.zeros((self._P,), np.int32)
+            row[:len(pages)] = pages
+            self._tables[i] = row
+            self._temps[i] = head.temperature
+            self._tables_dev.clear()
+            self._temps_dev = None
+            admitted.append(head)
+            self._c["admitted"] += 1
+            self._c["pages_allocated"] += need
+            monitor.stat_add("serving.decode.admitted")
+        return admitted
+
+    def _evict_locked(self, seq: _Sequence) -> None:
+        """Free a sequence's slot + pages (future/stream already
+        resolved by the caller)."""
+        i = seq.slot
+        if i is not None and self._slots[i] is seq:
+            self._slots[i] = None
+            self._tables[i] = 0
+            self._temps[i] = 0.0
+            self._tables_dev.clear()
+            self._temps_dev = None
+        if seq.pages:
+            self._pool.free(seq.pages)
+            self._c["pages_freed"] += len(seq.pages)
+            seq.pages = []
+        seq.slot = None
+        self._cv.notify_all()
+
+    def _finish(self, seq: _Sequence, reason: str,
+                exc: Optional[BaseException] = None) -> None:
+        now = time.monotonic()
+        with self._cv:
+            self._evict_locked(seq)
+            if exc is None:
+                self._c["finished"] += 1
+            else:
+                self._c["failed"] += 1
+        if exc is None:
+            seq.stream._finish(seq.tokens, reason)
+            monitor.stat_add("serving.decode.finished")
+            lat = (now - seq.t_enq) * 1000.0
+            self._reg.observe("latency_ms", lat)
+            monitor.stat_observe("serving.decode.latency_ms", lat)
+            if seq.t_first is not None and len(seq.tokens) > 1:
+                tpot = ((now - seq.t_first) * 1000.0
+                        / (len(seq.tokens) - 1))
+                self._reg.observe("tpot_ms", tpot)
+        else:
+            seq.stream._fail(exc, reason)
+            monitor.stat_add("serving.decode.failed")
+        self._emit("gen_finish", sid=seq.sid, reason=reason,
+                   tokens=len(seq.tokens))
+
+    def _emit_token(self, seq: _Sequence, tok: int) -> bool:
+        """Record one generated token; True when the sequence is done."""
+        now = time.monotonic()
+        if seq.t_first is None:
+            seq.t_first = now
+            self._reg.observe("ttft_ms", (now - seq.t_enq) * 1000.0)
+            monitor.stat_observe("serving.decode.ttft_ms",
+                                 (now - seq.t_enq) * 1000.0)
+        seq.tokens.append(tok)
+        seq.last_token = tok
+        seq.stream._push(tok)
+        self._c["tokens"] += 1     # monitor mirror batched by the caller
+        if seq.eos_id is not None and tok == seq.eos_id:
+            self._finish(seq, "eos")
+            return True
+        if len(seq.tokens) >= seq.max_new:
+            self._finish(seq, "length")
+            return True
+        return False
+
+    def _sample_key(self, seq: _Sequence) -> np.ndarray:
+        # raw threefry key data from (seed, position): any uint32 pair
+        # is a valid key, and this one depends only on request-local
+        # state — never on slot index or batch composition
+        return np.array([seq.seed & 0xFFFFFFFF, seq.position],
+                        np.uint32)
+
+    def _run_exec(self, kind: str, bucket: int, args) -> tuple:
+        """Call a precompiled executable with decode-retry semantics.
+
+        Pre-dispatch failures (the injected fault point) always retry —
+        the inputs are untouched.  A failure raised by the executable
+        itself is NOT replayed when the KV pool was donated: the input
+        buffers may already be invalidated, and a replay would read
+        dead arrays.  The caller recovers via :meth:`_fail_active`."""
+        ex = self._get_exec(kind, bucket)
+        last: Optional[BaseException] = None
+        for attempt in range(self._retries + 1):
+            try:
+                fault.point("serving.decode_step", kind,
+                            f"attempt={attempt}")
+            except Exception as e:      # pre-dispatch: always retryable
+                last = e
+                self._c["decode_errors"] += 1
+                monitor.stat_add("serving.decode.errors")
+                if attempt < self._retries:
+                    self._c["decode_retries"] += 1
+                    monitor.stat_add("serving.decode.retries")
+                continue
+            try:
+                return ex(*args)
+            except Exception as e:
+                last = e
+                self._c["decode_errors"] += 1
+                monitor.stat_add("serving.decode.errors")
+                if self._donate:
+                    break               # donated inputs may be dead
+                if attempt < self._retries:
+                    self._c["decode_retries"] += 1
+                    monitor.stat_add("serving.decode.retries")
+        raise GenerationError(
+            f"{kind} failed after {self._retries + 1} attempts: "
+            f"{type(last).__name__}: {last}") from last
+
+    def _fail_active(self, exc: BaseException) -> None:
+        """A compiled step failed: fail every in-flight sequence, free
+        their pages, and (under donation) rebuild the KV pool — the
+        failed call may have invalidated the donated buffers, and no
+        surviving sequence's cache can be trusted through them."""
+        for s in list(self._active()):
+            self._finish(s, "error", exc)
+        if self._donate:
+            self._pool.reset_kv()
+
+    def _prefill(self, seq: _Sequence) -> None:
+        c = self.config
+        bucket = next(b for b in self._prompt_buckets
+                      if b >= seq.prompt.size)
+        toks = np.zeros((bucket,), np.int32)
+        toks[:seq.prompt.size] = seq.prompt
+        t0 = time.perf_counter()
+        k_pool, v_pool = self._pool.kv
+        try:
+            k_pool, v_pool, tok = self._run_exec(
+                "prefill", bucket,
+                (k_pool, v_pool, jnp.asarray(toks),
+                 jnp.int32(seq.prompt.size),
+                 jnp.asarray(self._tables[seq.slot]),
+                 jnp.float32(seq.temperature),
+                 jnp.asarray(self._sample_key(seq))))
+        except GenerationError as e:
+            self._fail_active(e)
+            return
+        self._pool.kv = (k_pool, v_pool)
+        self._c["prefills"] += 1
+        self._c["prefill_tokens"] += int(seq.prompt.size)
+        monitor.stat_add("serving.decode.prefills")
+        monitor.stat_add("serving.decode.prefill_tokens",
+                         int(seq.prompt.size))
+        monitor.stat_add("serving.decode.tokens")
+        self._emit("gen_prefill", sid=seq.sid, bucket=bucket,
+                   dur_ms=(time.perf_counter() - t0) * 1000.0)
+        seq.position = int(seq.prompt.size) + 1
+        self._emit_token(seq, int(tok))
+
+    def _decode_step(self) -> None:
+        S = self._slots_n
+        tokens = np.zeros((S,), np.int32)
+        positions = np.zeros((S,), np.int32)
+        sampling = False
+        active = []
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            active.append(s)
+            tokens[i] = s.last_token
+            positions[i] = s.position - 1      # where this token's KV goes
+            sampling = sampling or s.temperature > 0
+        if not active:
+            return
+        if sampling:
+            keys = np.zeros((S, 2), np.uint32)
+            for s in active:
+                keys[s.slot] = self._sample_key(s)
+            keys = jnp.asarray(keys)
+        else:       # greedy batch: keys are dead inputs, skip the upload
+            keys = self._zero_keys
+        # narrowest context bucket covering the longest active sequence
+        page = self.config.page_size
+        p_need = -(-max(s.position for s in active) // page)
+        p_b = next(b for b in self._ctx_buckets if b >= p_need)
+        tables = self._tables_dev.get(p_b)
+        if tables is None:
+            tables = jnp.asarray(
+                np.ascontiguousarray(self._tables[:, :p_b]))
+            self._tables_dev[p_b] = tables
+        if self._temps_dev is None:
+            self._temps_dev = jnp.asarray(self._temps)
+        t0 = time.perf_counter()
+        k_pool, v_pool = self._pool.kv
+        try:
+            k_pool, v_pool, toks = self._run_exec(
+                "decode", p_b,
+                (k_pool, v_pool, jnp.asarray(tokens),
+                 jnp.asarray(positions), tables,
+                 self._temps_dev, keys))
+        except GenerationError as e:
+            self._fail_active(e)
+            return
+        self._pool.kv = (k_pool, v_pool)
+        toks = np.asarray(toks)
+        occ = len(active) / S
+        self._c["decode_steps"] += 1
+        self._occ_sum += occ
+        monitor.stat_add("serving.decode.steps")
+        monitor.stat_observe("serving.decode.ctx_pages", p_b)
+        monitor.stat_observe("serving.decode.slot_occupancy", occ)
+        monitor.stat_observe("serving.decode.page_util",
+                             self._pool.utilization())
+        self._reg.observe("step_ms", (time.perf_counter() - t0) * 1000.0)
+        emitted = 0
+        now = time.monotonic()
+        for s in active:
+            if s.deadline is not None and now > s.deadline:
+                # mid-generation expiry: evict, free pages, fail cleanly
+                self._c["deadline_expired"] += 1
+                monitor.stat_add("serving.decode.deadline_expired")
+                self._emit("gen_deadline_expired", sid=s.sid,
+                           where="decode")
+                self._finish(s, "deadline", DeadlineExceeded(
+                    f"deadline expired mid-generation after "
+                    f"{len(s.tokens)} tokens"))
+                continue
+            s.position += 1
+            self._emit_token(s, int(toks[s.slot]))
+            emitted += 1
+        if emitted:
+            monitor.stat_add("serving.decode.tokens", emitted)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                self._expire_queued_locked()
+                has_active = any(s is not None for s in self._slots)
+                if self._closing and not self._queue and not has_active:
+                    return
+                if self._paused or (not self._queue and not has_active):
+                    # idle (or paused): sleep until an enqueue/resume/
+                    # close notifies; poll only to sweep queued deadlines
+                    self._cv.wait(
+                        0.05 if (self._queued_deadlines or self._paused
+                                 or self._closing) else None)
+                    continue
+                admitted = self._admit_locked()
+                if not admitted \
+                        and not any(s is not None for s in self._slots):
+                    # queued work that cannot be admitted yet (page
+                    # starvation) with nothing decoding: don't hot-spin
+                    self._cv.wait(0.05)
+                    continue
+                self._stepping = True
+            try:
+                for seq in admitted:
+                    if seq.slot is not None:    # not already finished
+                        self._prefill(seq)
+                self._decode_step()
+            except Exception as e:      # defense in depth: the scheduler
+                # must survive anything — fail in-flight work cleanly
+                self._fail_active(GenerationError(
+                    f"scheduler error: {type(e).__name__}: {e}"))
+            finally:
+                with self._cv:
+                    self._stepping = False
+                    self._cv.notify_all()
+
+    # -- lifecycle ---------------------------------------------------------
+    def pause(self) -> None:
+        """Testing hook: hold the scheduler between steps."""
+        with self._cv:
+            self._paused = True
+            self._cv.notify_all()
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admission of new requests, finish everything accepted.
+        Returns True when fully drained within ``timeout``."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._cv:
+            self._draining = True
+            self._paused = False
+            self._cv.notify_all()
+            while (self._queue or self._stepping
+                   or any(s is not None for s in self._slots)):
+                wait = 0.05
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        return False
+                self._cv.wait(wait)
+        return True
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain, stop the scheduler, fail anything unserved, reclaim
+        every page — no stranded future, no leaked page."""
+        with self._cv:
+            if self._closed:
+                return
+            self._draining = True
+            self._closing = True
+            self._paused = False
+            self._cv.notify_all()
+        self._thread.join(timeout)
+        with self._cv:
+            self._closed = True
+            stranded = list(self._queue)
+            self._queue.clear()
+            self._queued_deadlines = 0
+            inflight = [s for s in self._slots if s is not None]
+            if not self._thread.is_alive():
+                # scheduler is gone: reclaim in-flight sequences safely
+                stranded += inflight
+                for s in stranded:
+                    self._evict_locked(s)
+            else:
+                # wedged scheduler: futures must still resolve (pages
+                # stay accounted to the wedged step — never guess)
+                stranded += inflight
+            self._cv.notify_all()
+        for s in stranded:
+            s.stream._fail(EngineClosed(
+                "engine closed before the sequence finished"), "closed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.close()
+        return False
+
+    # -- observability -----------------------------------------------------
+    @property
+    def prompt_buckets(self) -> List[int]:
+        return list(self._prompt_buckets)
+
+    @property
+    def num_slots(self) -> int:
+        return self._slots_n
+
+    @property
+    def page_pool(self) -> PagePool:
+        return self._pool
+
+    def stats(self) -> Dict[str, object]:
+        """Scheduler state + counters + token latency percentiles (the
+        ``generation`` block of the HTTP ``/metrics`` payload)."""
+        with self._cv:
+            state = ("closed" if self._closed else
+                     "draining" if self._draining else
+                     "paused" if self._paused else "running")
+            c = dict(self._c)
+            queue_depth = len(self._queue)
+            active = sum(1 for s in self._slots if s is not None)
+            occ_sum = self._occ_sum
+        steps = c.get("decode_steps", 0)
+        prefill_toks = c.get("prefill_tokens", 0)
+        decode_toks = c.get("tokens", 0)
+        return {
+            "state": state,
+            "queue_depth": queue_depth,
+            "num_slots": self._slots_n,
+            "active_slots": active,
+            "prompt_buckets": list(self._prompt_buckets),
+            "ctx_buckets": list(self._ctx_buckets),
+            "page_pool": {
+                "num_pages": self._pool.num_pages,
+                "page_size": self.config.page_size,
+                "in_use": self._pool.in_use,
+                "available": self._pool.available,
+                "utilization": self._pool.utilization(),
+            },
+            "max_context": self.config.max_context,
+            "counters": {k: c.get(k, 0) for k in (
+                "requests", "admitted", "finished", "failed", "shed",
+                "deadline_expired", "tokens", "prefills",
+                "prefill_tokens", "decode_steps", "decode_errors",
+                "decode_retries", "pages_allocated", "pages_freed")},
+            "mean_slot_occupancy": (occ_sum / steps) if steps else 0.0,
+            "prefill_decode_ratio": (prefill_toks / decode_toks
+                                     if decode_toks else 0.0),
+            "latency_ms": self._reg.histogram_summary("latency_ms"),
+            "ttft_ms": self._reg.histogram_summary("ttft_ms"),
+            "step_ms": self._reg.histogram_summary("step_ms"),
+            "compiled_variants": self._compile_count,
+            "warm_variants": self._warm_variants,
+            "recompiles_after_warmup": (
+                self._compile_count - self._warm_variants
+                if self._warm_variants is not None else None),
+        }

@@ -12,6 +12,16 @@ dispatcher coalesces into micro-batches):
   single-input models; deadline via the ``X-Deadline-Ms`` header).
   JSON responses carry ``outputs``/``names``/``dtypes``; npy requests
   get the first output back as npy bytes.
+- ``POST /generate`` — generative decode through an attached
+  :class:`~paddle_tpu.serving.generation.GenerationEngine`.  JSON body
+  ``{"prompt": [ids], "max_new_tokens": N, "eos_id": E, "temperature":
+  T, "seed": S, "deadline_ms": D, "stream": true|false}``.  With
+  ``stream`` (the default) the response is ``application/x-ndjson``
+  over chunked transfer-encoding: one ``{"token": id}`` line per
+  generated token *as the scheduler produces it*, closed by a
+  ``{"done": true, "tokens": [...], "finish_reason": ...}`` summary
+  line (errors mid-stream arrive in-band as an ``{"error": ...}``
+  line).  ``stream: false`` returns one JSON object at the end.
 - ``GET /healthz`` — 200 while serving, 503 when draining/closed.
 - ``GET /metrics`` — content-negotiated.  Default (and any JSON
   Accept): the engine's stats JSON — queue depth, batch occupancy,
@@ -27,13 +37,13 @@ Error mapping: shed -> 503 (+Retry-After), deadline -> 504, malformed
 from __future__ import annotations
 
 import concurrent.futures
+import http.client as httpclient
 import io
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional
-from urllib import error as urlerror
-from urllib import request as urlrequest
+from typing import Iterator, List, Optional
+from urllib.parse import urlsplit
 
 import numpy as np
 
@@ -49,8 +59,12 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
     @property
-    def engine(self) -> InferenceEngine:
+    def engine(self) -> Optional[InferenceEngine]:
         return self.server.engine
+
+    @property
+    def generation(self):
+        return getattr(self.server, "generation", None)
 
     def log_message(self, fmt, *args):      # quiet by default
         if getattr(self.server, "verbose", False):
@@ -69,6 +83,22 @@ class _Handler(BaseHTTPRequestHandler):
     def _reply_json(self, code: int, obj, extra_headers=()):
         self._reply(code, json.dumps(obj).encode(),
                     extra_headers=extra_headers)
+
+    # -- chunked streaming (token streams) ---------------------------------
+    def _start_chunked(self, code: int, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+    def _write_chunk(self, payload: bytes) -> None:
+        self.wfile.write(f"{len(payload):X}\r\n".encode() + payload
+                         + b"\r\n")
+
+    def _end_chunked(self) -> None:
+        # zero-length terminator: the connection stays keep-alive
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
 
     def _reply_error(self, exc: BaseException):
         kind = type(exc).__name__
@@ -91,33 +121,55 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
-            st = self.engine.stats()["state"]
+            src = self.engine if self.engine is not None else self.generation
+            st = src.stats()["state"] if src is not None else "empty"
             self._reply_json(200 if st in ("running", "paused") else 503,
                              {"status": st})
         elif path == "/metrics":
             accept = (self.headers.get("Accept") or "").lower()
+            stats = (self.engine.stats() if self.engine is not None
+                     else {"counters": {}})
+            gen = self.generation
+            if gen is not None:
+                stats["generation"] = gen.stats()
             if ("text/plain" in accept or "openmetrics" in accept
                     or "prometheus" in accept):
                 from ..observability import prometheus_text
-                stats = self.engine.stats()
                 gauges = {f"serving_engine_{k}": v
                           for k, v in stats.items()
                           if isinstance(v, (int, float))}
                 gauges.update({f"serving_engine_{k}": v
                                for k, v in stats["counters"].items()})
+                if gen is not None:
+                    gs = stats["generation"]
+                    gauges.update({f"serving_decode_{k}": v
+                                   for k, v in gs.items()
+                                   if isinstance(v, (int, float))})
+                    gauges.update({f"serving_decode_{k}": v
+                                   for k, v in gs["counters"].items()})
+                    gauges.update({f"serving_decode_pages_{k}": v
+                                   for k, v in gs["page_pool"].items()})
                 self._reply(200, prometheus_text(gauges).encode(),
                             ctype="text/plain; version=0.0.4; "
                                   "charset=utf-8")
             else:
-                self._reply_json(200, self.engine.stats())
+                self._reply_json(200, stats)
         else:
             self._reply_json(404, {"error": "NotFound", "message": self.path})
 
     def do_POST(self):
         path = self.path.split("?", 1)[0]
+        if path == "/generate":
+            self._do_generate()
+            return
         if path != "/predict":
             self._reply_json(404, {"error": "NotFound",
                                    "message": self.path})
+            return
+        if self.engine is None:
+            self._reply_json(501, {"error": "NotImplemented",
+                                   "message": "no inference engine "
+                                              "attached"})
             return
         try:
             n = int(self.headers.get("Content-Length", "0"))
@@ -151,6 +203,62 @@ class _Handler(BaseHTTPRequestHandler):
                 "dtypes": [str(o.dtype) for o in outs],
             })
 
+    def _do_generate(self):
+        import queue as _queue
+        gen = self.generation
+        if gen is None:
+            self._reply_json(501, {"error": "NotImplemented",
+                                   "message": "no generation engine "
+                                              "attached"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+            if "prompt" not in payload:
+                raise ValueError('body must carry "prompt"')
+            stream_mode = bool(payload.get("stream", True))
+            kw = {}
+            for k in ("max_new_tokens", "eos_id", "temperature", "seed",
+                      "deadline_ms"):
+                if payload.get(k) is not None:
+                    kw[k] = payload[k]
+            s = gen.generate(payload["prompt"], **kw)
+        except Exception as e:          # noqa: BLE001 - mapped to HTTP
+            self._reply_error(e)
+            return
+        timeout = self.server.request_timeout
+        if not stream_mode:
+            try:
+                toks = s.result(timeout=timeout)
+            except Exception as e:      # noqa: BLE001 - mapped to HTTP
+                self._reply_error(e)
+                return
+            self._reply_json(200, {"tokens": toks,
+                                   "finish_reason": s.finish_reason,
+                                   "sid": s.sid})
+            return
+        # admission succeeded: stream tokens as the scheduler emits
+        # them; anything that goes wrong PAST this point arrives
+        # in-band (the status line is already on the wire)
+        self._start_chunked(200, "application/x-ndjson")
+        try:
+            try:
+                for tok in s.tokens(timeout=timeout):
+                    self._write_chunk(
+                        json.dumps({"token": int(tok)}).encode() + b"\n")
+                summary = {"done": True, "tokens": s.result(0),
+                           "finish_reason": s.finish_reason,
+                           "sid": s.sid}
+                self._write_chunk(json.dumps(summary).encode() + b"\n")
+            except Exception as e:      # noqa: BLE001 - sent in-band
+                kind = ("TimeoutError" if isinstance(e, _queue.Empty)
+                        else type(e).__name__)
+                self._write_chunk(json.dumps(
+                    {"error": kind, "message": str(e)}).encode() + b"\n")
+            self._end_chunked()
+        except (BrokenPipeError, ConnectionError):
+            pass                        # client went away mid-stream
+
 
 class ServingServer:
     """Threaded HTTP server bound to one engine.
@@ -160,12 +268,17 @@ class ServingServer:
     connections but leaves the engine to its owner (``tools/serve.py``
     closes both)."""
 
-    def __init__(self, engine: InferenceEngine, host: str = "127.0.0.1",
+    def __init__(self, engine: Optional[InferenceEngine],
+                 host: str = "127.0.0.1",
                  port: int = 8000, request_timeout: float = 60.0,
-                 verbose: bool = False):
+                 verbose: bool = False, generation=None):
+        if engine is None and generation is None:
+            raise ValueError("attach an InferenceEngine, a "
+                             "GenerationEngine, or both")
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.engine = engine
+        self._httpd.generation = generation
         self._httpd.request_timeout = request_timeout
         self._httpd.verbose = verbose
         self._thread: Optional[threading.Thread] = None
@@ -219,7 +332,16 @@ def serve(engine: InferenceEngine, host: str = "127.0.0.1",
 
 
 class Client:
-    """Tiny stdlib client for the HTTP front-end.
+    """Stdlib client for the HTTP front-end, with keep-alive reuse.
+
+    Each thread holds ONE persistent ``http.client.HTTPConnection``
+    (the server speaks HTTP/1.1 with Content-Length or chunked bodies,
+    so connections survive across requests) — closed-loop bench/smoke
+    clients pay connection setup once, not per request.  A stale pooled
+    connection (server restarted, idle timeout) is dropped and the
+    request retried once on a fresh connection; ``connections_opened``
+    counts physical connects across all threads (the reuse gate's
+    observable).
 
     503/504 responses are raised as the matching engine exceptions
     (:class:`QueueFull` / :class:`DeadlineExceeded` / ...), so a caller
@@ -227,41 +349,112 @@ class Client:
 
     def __init__(self, base_url: str, timeout: float = 60.0):
         self.base_url = base_url.rstrip("/")
+        u = urlsplit(self.base_url)
+        if u.scheme not in ("http", ""):
+            raise ValueError(f"unsupported scheme {u.scheme!r}")
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port or 80
         self.timeout = timeout
+        self._local = threading.local()
+        self._count_lock = threading.Lock()
+        self.connections_opened = 0
 
-    def _raise_for(self, e: urlerror.HTTPError):
+    # -- connection pool (one per thread) ----------------------------------
+    def _conn(self) -> httpclient.HTTPConnection:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = httpclient.HTTPConnection(self._host, self._port,
+                                          timeout=self.timeout)
+            self._local.conn = c
+            with self._count_lock:
+                self.connections_opened += 1
+        return c
+
+    def _drop_conn(self) -> None:
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:
+                pass
+            self._local.conn = None
+
+    def close(self) -> None:
+        """Close this thread's pooled connection (other threads' pools
+        close when their threads die or on their own ``close()``)."""
+        self._drop_conn()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.close()
+        return False
+
+    def _request(self, method: str, path: str, body: Optional[bytes]
+                 = None, headers: Optional[dict] = None
+                 ) -> httpclient.HTTPResponse:
+        """One round trip on the pooled connection; retries once on a
+        stale keep-alive socket.  (Serving requests are idempotent —
+        inference is pure and generation is deterministic — so the
+        replay is safe.)  A *timeout* is never replayed: the server is
+        slow, not gone, and a replay would double its work while
+        masking the real condition.  The caller must fully read the
+        response."""
+        headers = dict(headers or {})
+        last: Optional[BaseException] = None
+        for attempt in (0, 1):
+            c = self._conn()
+            try:
+                c.request(method, path, body=body, headers=headers)
+                return c.getresponse()
+            except (httpclient.HTTPException, ConnectionError,
+                    BrokenPipeError, OSError) as e:
+                self._drop_conn()
+                if isinstance(e, TimeoutError):
+                    raise               # slow server: surface, don't replay
+                last = e
+        raise ServingError(f"connection to {self.base_url} failed: "
+                           f"{type(last).__name__}: {last}") from last
+
+    def _finish(self, r: httpclient.HTTPResponse) -> None:
+        """Keep the connection reusable — or drop it when the server
+        asked to close."""
+        if r.will_close:
+            self._drop_conn()
+
+    def _raise_for(self, status: int, raw: bytes):
         try:
-            payload = json.loads(e.read().decode() or "{}")
+            payload = json.loads(raw.decode() or "{}")
         except Exception:
             payload = {}
         kind = payload.get("error", "")
-        msg = payload.get("message", str(e))
+        msg = payload.get("message", "")
         for cls in (QueueFull, DeadlineExceeded, EngineClosed):
             if kind == cls.__name__:
                 raise cls(msg) from None
-        raise ServingError(f"HTTP {e.code}: {kind or ''} {msg}") from None
+        raise ServingError(f"HTTP {status}: {kind or ''} {msg}")
 
     def _post(self, path: str, body: bytes, headers: dict) -> bytes:
-        req = urlrequest.Request(self.base_url + path, data=body,
-                                 headers=headers, method="POST")
-        try:
-            with urlrequest.urlopen(req, timeout=self.timeout) as r:
-                return r.read()
-        except urlerror.HTTPError as e:
-            self._raise_for(e)
+        r = self._request("POST", path, body=body, headers=headers)
+        raw = r.read()
+        self._finish(r)
+        if r.status >= 400:
+            self._raise_for(r.status, raw)
+        return raw
 
-    def _get_json(self, path: str):
-        try:
-            with urlrequest.urlopen(self.base_url + path,
-                                    timeout=self.timeout) as r:
-                return json.loads(r.read().decode())
-        except urlerror.HTTPError as e:
+    def _get_json(self, path: str, headers: Optional[dict] = None):
+        r = self._request("GET", path, headers=headers)
+        raw = r.read()
+        self._finish(r)
+        if r.status >= 400:
             if path == "/healthz":      # 503 healthz still carries status
                 try:
-                    return json.loads(e.read().decode())
+                    return json.loads(raw.decode())
                 except Exception:
                     pass
-            self._raise_for(e)
+            self._raise_for(r.status, raw)
+        return json.loads(raw.decode())
 
     def predict(self, inputs, deadline_ms: Optional[float] = None
                 ) -> List[np.ndarray]:
@@ -305,10 +498,71 @@ class Client:
 
     def metrics_text(self) -> str:
         """Prometheus text exposition (the scraper's view of /metrics)."""
-        req = urlrequest.Request(self.base_url + "/metrics",
-                                 headers={"Accept": "text/plain"})
+        r = self._request("GET", "/metrics",
+                          headers={"Accept": "text/plain"})
+        raw = r.read()
+        self._finish(r)
+        if r.status >= 400:
+            self._raise_for(r.status, raw)
+        return raw.decode()
+
+    # -- generation --------------------------------------------------------
+    def _generate_body(self, prompt, stream: bool, kw: dict) -> bytes:
+        body = {"prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+                "stream": stream}
+        body.update({k: v for k, v in kw.items() if v is not None})
+        return json.dumps(body).encode()
+
+    def generate(self, prompt, max_new_tokens: int = 32,
+                 eos_id: Optional[int] = None, temperature: float = 0.0,
+                 seed: int = 0,
+                 deadline_ms: Optional[float] = None) -> List[int]:
+        """Blocking generation; returns the full token list."""
+        raw = self._post("/generate", self._generate_body(
+            prompt, False, {"max_new_tokens": max_new_tokens,
+                            "eos_id": eos_id, "temperature": temperature,
+                            "seed": seed, "deadline_ms": deadline_ms}),
+            {"Content-Type": "application/json"})
+        return list(json.loads(raw.decode())["tokens"])
+
+    def generate_stream(self, prompt, max_new_tokens: int = 32,
+                        eos_id: Optional[int] = None,
+                        temperature: float = 0.0, seed: int = 0,
+                        deadline_ms: Optional[float] = None
+                        ) -> Iterator[int]:
+        """Yield tokens as the server streams them (chunked NDJSON).
+
+        In-band server errors re-raise as the matching engine
+        exceptions.  Abandoning the iterator mid-stream drops the
+        pooled connection (it would otherwise carry unread chunks)."""
+        r = self._request("POST", "/generate", self._generate_body(
+            prompt, True, {"max_new_tokens": max_new_tokens,
+                           "eos_id": eos_id, "temperature": temperature,
+                           "seed": seed, "deadline_ms": deadline_ms}),
+            {"Content-Type": "application/json"})
+        if r.status >= 400:
+            raw = r.read()
+            self._finish(r)
+            self._raise_for(r.status, raw)
+        done = False
         try:
-            with urlrequest.urlopen(req, timeout=self.timeout) as r:
-                return r.read().decode()
-        except urlerror.HTTPError as e:
-            self._raise_for(e)
+            while True:
+                line = r.readline()
+                if not line:
+                    break
+                msg = json.loads(line.decode())
+                if "token" in msg:
+                    yield int(msg["token"])
+                elif "error" in msg:
+                    self._raise_for(200, line)
+                if msg.get("done"):
+                    break
+            # drain the terminating chunk so the socket is clean
+            while r.readline():
+                pass
+            done = True
+        finally:
+            if done:
+                self._finish(r)
+            else:           # abandoned/errored mid-stream: unread data
+                self._drop_conn()

@@ -1,0 +1,181 @@
+"""Paged KV cache: a preallocated page pool + per-sequence page tables.
+
+The TPU-native answer to ragged generative sequence lengths (Ragged
+Paged Attention, PAPERS.md): instead of one contiguous KV buffer per
+sequence (whose shape changes as the sequence grows, retracing XLA), the
+cache is a single preallocated pool of fixed-size pages
+
+    k_pool/v_pool: [num_layers, 1 + num_pages, page_size, heads, dim]
+
+and every sequence owns an int32 *page table* mapping its logical pages
+to physical pool slots.  All shapes are static, so one compiled decode
+step serves any mix of sequence lengths; growing a sequence means
+appending a page index to its table — data changes, shapes never do.
+
+Physical page 0 is the **scratch page**: it is never allocated, and
+idle decode slots point their whole table at it, so the static-shape
+scatter of new K/V (which always writes every slot's row) lands
+harmlessly there instead of corrupting a live sequence's pages.
+
+Allocation is host-side and O(1) amortized (a LIFO free list).  The
+:class:`~paddle_tpu.serving.generation.GenerationEngine` reserves a
+sequence's worst-case page count at admission, which makes mid-flight
+pool exhaustion impossible by construction — accounting invariants
+(``in_use + available == num_pages``, pool drained back to zero) are
+what the chaos/smoke gates assert.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["PagePool", "KVCacheConfig", "write_token", "write_prompt",
+           "pages_needed"]
+
+
+class KVCacheConfig:
+    """Static geometry of a paged KV cache."""
+
+    __slots__ = ("num_layers", "num_kv_heads", "head_dim", "page_size",
+                 "num_pages", "max_context", "dtype")
+
+    def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
+                 page_size: int = 16, num_pages: int = 256,
+                 max_context: int = 512, dtype=jnp.float32):
+        if page_size < 1 or num_pages < 1:
+            raise ValueError("page_size and num_pages must be >= 1")
+        if max_context < 1:
+            raise ValueError("max_context must be >= 1")
+        self.num_layers = int(num_layers)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.max_context = int(max_context)
+        self.dtype = dtype
+
+    @property
+    def pages_per_seq(self) -> int:
+        """Page-table width: logical pages covering ``max_context``."""
+        return -(-self.max_context // self.page_size)
+
+    def pages_for(self, tokens: int) -> int:
+        """Physical pages a sequence of ``tokens`` total tokens needs."""
+        return max(1, -(-int(tokens) // self.page_size))
+
+    def to_dict(self) -> dict:
+        return {s: (str(self.dtype) if s == "dtype" else getattr(self, s))
+                for s in self.__slots__}
+
+
+class PagePool:
+    """Device-resident K/V page pool + host-side free-list allocator.
+
+    The device arrays (``kv = (k_pool, v_pool)``) are *owned by the
+    caller's compiled step* — the pool object only hands out/reclaims
+    page indices and tracks accounting.  ``kv`` is threaded functionally
+    through jitted prefill/decode calls; :meth:`reset_kv` rebuilds the
+    zero state (tests / engine restart)."""
+
+    def __init__(self, config: KVCacheConfig):
+        self.config = config
+        c = config
+        # +1: physical page 0 is the never-allocated scratch page
+        self._shape = (c.num_layers, 1 + c.num_pages, c.page_size,
+                       c.num_kv_heads, c.head_dim)
+        # LIFO free list: hottest (most recently freed) pages reused
+        # first, which keeps the working set of a churning slot compact
+        self._free: List[int] = list(range(c.num_pages, 0, -1))
+        self._in_use = 0
+        self.kv: Tuple[jnp.ndarray, jnp.ndarray] = self.reset_kv()
+
+    def reset_kv(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        self.kv = (jnp.zeros(self._shape, self.config.dtype),
+                   jnp.zeros(self._shape, self.config.dtype))
+        return self.kv
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def num_pages(self) -> int:
+        return self.config.num_pages
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self._in_use
+
+    def utilization(self) -> float:
+        return self._in_use / self.config.num_pages
+
+    # -- allocation --------------------------------------------------------
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` pages, or None (and take nothing) if short — an
+        all-or-nothing grant so admission can never half-reserve."""
+        n = int(n)
+        if n < 0:
+            raise ValueError("alloc(n) needs n >= 0")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._in_use += n
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            p = int(p)
+            if p < 1 or p > self.config.num_pages:
+                raise ValueError(f"page {p} is not an allocatable index")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(int(p) for p in pages)
+        self._in_use -= len(pages)
+        assert self._in_use >= 0, "page accounting went negative"
+
+
+def write_token(pool, layer, vals, page_table, positions):
+    """Scatter one new K (or V) row per sequence into its page.
+
+    pool: [L, N, page, H, D]; layer: int; vals: [S, H, D]; page_table:
+    [S, P] int32; positions: [S] int32 (0-based logical position being
+    written).  Idle slots' tables point at scratch page 0, so the
+    unconditional static-shape scatter stays safe.  Returns the updated
+    pool.
+
+    The layer index rides INSIDE the scatter (one fused
+    ``pool.at[layer, pid, off]`` update of S rows) — slicing the layer
+    out and writing it back would round-trip the whole layer through
+    memory on every step, which is exactly the copy traffic the paged
+    layout exists to avoid (donated pools update in place)."""
+    page = pool.shape[2]
+    logical = positions // page                       # [S]
+    pid = jnp.take_along_axis(page_table, logical[:, None], axis=1)[:, 0]
+    off = positions % page
+    return pool.at[layer, pid, off].set(vals)
+
+
+def write_prompt(pool, layer, vals, page_table, length):
+    """Scatter a whole prompt's K (or V) rows for ONE sequence.
+
+    pool: [L, N, page, H, D]; layer: int; vals: [T, H, D] (rows past
+    ``length`` are padding); page_table: [P] int32; length: int32
+    scalar.  Padding rows are redirected to scratch page 0.  Returns
+    the updated pool (one fused scatter — see :func:`write_token`)."""
+    T = vals.shape[0]
+    page = pool.shape[2]
+    pos = jnp.arange(T, dtype=jnp.int32)
+    pid = page_table[pos // page]
+    pid = jnp.where(pos < length, pid, 0)             # pad -> scratch
+    off = pos % page
+    return pool.at[layer, pid, off].set(vals)
+
+
+def pages_needed(prompt_len: int, max_new_tokens: int,
+                 page_size: int) -> int:
+    """Worst-case pages a request can touch (admission reservation)."""
+    total = int(prompt_len) + int(max_new_tokens)
+    return max(1, math.ceil(total / int(page_size)))

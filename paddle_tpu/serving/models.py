@@ -1,0 +1,245 @@
+"""Reference generative model for the paged decode contract.
+
+:class:`PagedDecoderLM` is a minimal functional transformer decoder that
+implements the two-method contract
+:class:`~paddle_tpu.serving.generation.GenerationEngine` drives:
+
+- ``prefill(tokens, length, kv, page_table)`` — dense causal attention
+  over one (padded) prompt, writing every position's K/V into the
+  sequence's pages, returning the logits at the last valid position;
+- ``decode(tokens, positions, kv, page_tables)`` — one token per active
+  slot, K/V scattered into pages, attention via
+  :func:`paddle_tpu.ops.attention.paged_attention` over the page table.
+
+It is deliberately tiny and dependency-free (a params dict of jnp
+arrays, no Layer machinery) so bench/chaos/smoke can build it in
+milliseconds; ``dyadic=True`` rounds every weight to k/64 so float
+accumulation stays exactly reproducible across batch compositions (the
+serving chaos suite's bitwise trick).  It also exposes the
+``BeamSearchDecoder`` cell contract (:meth:`cell` /
+:meth:`init_cell_state`) over a dense padded KV cache — the per-request
+``dynamic_decode`` baseline the ISSUE benchmarks the engine against.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import attention as _attn
+from .kv_cache import write_prompt, write_token
+
+__all__ = ["PagedDecoderLM"]
+
+_NEG = -1e30
+
+
+class PagedDecoderLM:
+    """Pre-norm-free residual transformer LM over raw jnp params.
+
+    Geometry attributes (``num_layers`` / ``num_kv_heads`` /
+    ``head_dim`` / ``vocab_size``) are the engine's KV-cache contract.
+    """
+
+    def __init__(self, vocab_size: int = 64, hidden: int = 32,
+                 num_layers: int = 2, num_heads: int = 4,
+                 num_kv_heads: int = 0, ffn: int = 0, seed: int = 0,
+                 dyadic: bool = False):
+        if hidden % num_heads:
+            raise ValueError("hidden must divide by num_heads")
+        self.vocab_size = int(vocab_size)
+        self.hidden = int(hidden)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.num_kv_heads = int(num_kv_heads) or int(num_heads)
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError("num_heads must divide by num_kv_heads")
+        self.head_dim = self.hidden // self.num_heads
+        self.ffn = int(ffn) or 2 * self.hidden
+        rng = np.random.RandomState(seed)
+        E, F, V = self.hidden, self.ffn, self.vocab_size
+        kvd = self.num_kv_heads * self.head_dim
+
+        def w(shape, fan_in):
+            a = rng.standard_normal(shape).astype(np.float32)
+            a *= 1.0 / math.sqrt(fan_in)
+            if dyadic:
+                # weights on the k/64 dyadic grid: products/sums with
+                # dyadic activations are exact in f32 (chaos bitwise gate)
+                a = np.round(a * 64.0) / 64.0
+            return jnp.asarray(a)
+
+        p: Dict[str, jnp.ndarray] = {"embed": w((V, E), E)}
+        for l in range(self.num_layers):
+            p[f"wq{l}"] = w((E, E), E)
+            p[f"wk{l}"] = w((E, kvd), E)
+            p[f"wv{l}"] = w((E, kvd), E)
+            p[f"wo{l}"] = w((E, E), E)
+            p[f"w1{l}"] = w((E, F), E)
+            p[f"w2{l}"] = w((F, E), F)
+        self.params = p
+        self._scale = 1.0 / math.sqrt(self.head_dim)
+
+    # -- shared pieces -----------------------------------------------------
+    def _qkv(self, x, l):
+        """x: [..., E] -> q [..., H, D], k/v [..., Hkv, D]."""
+        p = self.params
+        lead = x.shape[:-1]
+        q = (x @ p[f"wq{l}"]).reshape(lead + (self.num_heads,
+                                              self.head_dim))
+        k = (x @ p[f"wk{l}"]).reshape(lead + (self.num_kv_heads,
+                                              self.head_dim))
+        v = (x @ p[f"wv{l}"]).reshape(lead + (self.num_kv_heads,
+                                              self.head_dim))
+        return q, k, v
+
+    def _mlp_residual(self, x, attn_out, l):
+        p = self.params
+        x = x + attn_out.reshape(x.shape) @ p[f"wo{l}"]
+        return x + jax.nn.relu(x @ p[f"w1{l}"]) @ p[f"w2{l}"]
+
+    def _group(self, kv):
+        """Broadcast KV heads over query-head groups (GQA)."""
+        if self.num_kv_heads == self.num_heads:
+            return kv
+        return jnp.repeat(kv, self.num_heads // self.num_kv_heads,
+                          axis=-2)
+
+    # -- paged contract (GenerationEngine) ---------------------------------
+    def prefill(self, tokens, length, kv, page_table
+                ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+        """tokens: [T] int32 (padded prompt); length: int32 scalar;
+        kv: (k_pool, v_pool) [L, N, page, Hkv, D]; page_table: [P] int32.
+        Returns (logits [V] at position length-1, updated kv)."""
+        k_pool, v_pool = kv
+        T = tokens.shape[0]
+        x = self.params["embed"][tokens]                    # [T, E]
+        pos = jnp.arange(T, dtype=jnp.int32)
+        # causal AND length-bounded: key j visible to query i iff
+        # j <= i and j < length
+        mask = (pos[None, :] <= pos[:, None]) & (pos[None, :] < length)
+        for l in range(self.num_layers):
+            q, k, v = self._qkv(x, l)                       # [T, H/Hkv, D]
+            k_pool = write_prompt(k_pool, l, k, page_table, length)
+            v_pool = write_prompt(v_pool, l, v, page_table, length)
+            kk, vv = self._group(k), self._group(v)         # [T, H, D]
+            s = jnp.einsum("ihd,jhd->hij", q.astype(jnp.float32),
+                           kk.astype(jnp.float32)) * self._scale
+            s = jnp.where(mask[None], s, _NEG)
+            w = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("hij,jhd->ihd", w,
+                              vv.astype(jnp.float32)).astype(x.dtype)
+            x = self._mlp_residual(x, attn, l)
+        last = jnp.take(x, length - 1, axis=0)              # [E]
+        return last @ self.params["embed"].T, (k_pool, v_pool)
+
+    def decode(self, tokens, positions, kv, page_tables
+               ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+        """tokens/positions: [S] int32; page_tables: [S, P] int32.
+        Returns (logits [S, V], updated kv)."""
+        k_pool, v_pool = kv
+        x = self.params["embed"][tokens]                    # [S, E]
+        lengths = positions + 1
+        for l in range(self.num_layers):
+            q, k, v = self._qkv(x, l)
+            k_pool = write_token(k_pool, l, k, page_tables, positions)
+            v_pool = write_token(v_pool, l, v, page_tables, positions)
+            attn = _attn.paged_attention_reference(
+                q, k_pool, v_pool, page_tables, lengths,
+                scale=self._scale, layer=l)
+            x = self._mlp_residual(x, attn, l)
+        return x @ self.params["embed"].T, (k_pool, v_pool)
+
+    # -- BeamSearchDecoder cell contract (dynamic_decode baseline) ---------
+    def init_cell_state(self, prompt, t_max: int):
+        """Dense-cache prefill for the per-request baseline.
+
+        Feeds ``prompt[:-1]`` through the network (the last prompt token
+        becomes ``dynamic_decode``'s start token), caching K/V into
+        fixed [1, L, t_max, Hkv, D] buffers.  Returns the cell-state
+        pytree (leading batch dim 1) for ``dynamic_decode(inits=...)``.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must carry at least one token")
+        n_ctx = prompt.size - 1
+        t_max = int(t_max)
+        if n_ctx > t_max:
+            raise ValueError(f"prompt needs {n_ctx} cache rows, "
+                             f"t_max={t_max}")
+        L, Hkv, D = self.num_layers, self.num_kv_heads, self.head_dim
+        k_cache = jnp.zeros((1, L, t_max, Hkv, D), jnp.float32)
+        v_cache = jnp.zeros((1, L, t_max, Hkv, D), jnp.float32)
+        if n_ctx:
+            x = self.params["embed"][jnp.asarray(prompt[:-1])]  # [n, E]
+            pos = jnp.arange(n_ctx)
+            mask = pos[None, :] <= pos[:, None]
+            for l in range(self.num_layers):
+                q, k, v = self._qkv(x, l)
+                k_cache = k_cache.at[0, l, :n_ctx].set(k)
+                v_cache = v_cache.at[0, l, :n_ctx].set(v)
+                kk, vv = self._group(k), self._group(v)
+                s = jnp.einsum("ihd,jhd->hij", q.astype(jnp.float32),
+                               kk.astype(jnp.float32)) * self._scale
+                s = jnp.where(mask[None], s, _NEG)
+                w = jax.nn.softmax(s, axis=-1)
+                attn = jnp.einsum("hij,jhd->ihd", w,
+                                  vv.astype(jnp.float32)).astype(x.dtype)
+                x = self._mlp_residual(x, attn, l)
+        return {"k": k_cache, "v": v_cache,
+                "pos": jnp.full((1,), n_ctx, jnp.int32),
+                "gen": jnp.zeros((1,), jnp.int32),
+                "limit": jnp.full((1,), 0, jnp.int32)}
+
+    def make_cell(self, eos_id: int):
+        """A ``cell(tokens, states) -> (logits, states)`` closure over a
+        dense padded KV cache — the BeamSearchDecoder contract.  When a
+        row's ``gen`` count reaches its ``limit``, logits collapse onto
+        ``eos_id`` so dynamic_decode's early exit ends the row (this is
+        how one compiled trace serves ragged per-request budgets)."""
+        from ..core.tensor import Tensor
+
+        def _arr(t):
+            return t.data if isinstance(t, Tensor) else jnp.asarray(t)
+
+        def cell(tok, states):
+            x0 = _arr(tok).astype(jnp.int32)                # [N]
+            st = {k: _arr(v) for k, v in states.items()}
+            k_cache, v_cache = st["k"], st["v"]             # [N,L,T,Hkv,D]
+            pos, gen, limit = st["pos"], st["gen"], st["limit"]
+            N, _, T = k_cache.shape[:3]
+            x = self.params["embed"][x0]                    # [N, E]
+            onehot = (jnp.arange(T)[None, :] == pos[:, None])   # [N, T]
+            visible = (jnp.arange(T)[None, :] <= pos[:, None])
+            for l in range(self.num_layers):
+                q, k, v = self._qkv(x, l)                   # [N, Hkv, D]
+                # write this token's K/V at pos (O(T) masked update —
+                # the dense baseline's inherent raggedness tax)
+                k_cache = k_cache.at[:, l].set(
+                    jnp.where(onehot[:, :, None, None],
+                              k[:, None], k_cache[:, l]))
+                v_cache = v_cache.at[:, l].set(
+                    jnp.where(onehot[:, :, None, None],
+                              v[:, None], v_cache[:, l]))
+                kk = self._group(k_cache[:, l])             # [N, T, H, D]
+                vv = self._group(v_cache[:, l])
+                s = jnp.einsum("nhd,nthd->nht", q.astype(jnp.float32),
+                               kk.astype(jnp.float32)) * self._scale
+                s = jnp.where(visible[:, None, :], s, _NEG)
+                w = jax.nn.softmax(s, axis=-1)
+                attn = jnp.einsum("nht,nthd->nhd", w,
+                                  vv.astype(jnp.float32)).astype(x.dtype)
+                x = self._mlp_residual(x, attn, l)
+            logits = x @ self.params["embed"].T             # [N, V]
+            done = gen >= limit
+            eos_row = jnp.full((self.vocab_size,), _NEG, jnp.float32)
+            eos_row = eos_row.at[eos_id].set(0.0)
+            logits = jnp.where(done[:, None], eos_row[None], logits)
+            new = {"k": k_cache, "v": v_cache, "pos": pos + 1,
+                   "gen": gen + 1, "limit": limit}
+            return logits, new
+
+        return cell

@@ -246,6 +246,21 @@ def _op_flops(node, in_avals, param_avals, out_avals
             scores = _numel(q) // max(int(q.shape[-1]), 1) * lk
             return 4 * _numel(q) * lk + 5 * scores, "attention"
         return None, "unmodeled"
+    if name == "paged_attention":
+        # serving decode attention over a page-table-indexed KV pool:
+        # q [S, H, D], pool [(L,) N, page, Hkv, D], page_table [S, P].
+        # Logical context T = P * page; QK^T + PV cost 4*numel(q)*T,
+        # softmax ~5 per score.  (Input bytes are corrected to the
+        # page GATHER volume in _node_costs — the op reads S*T rows of
+        # K and V, not the whole physical pool.)
+        if len(in_avals) >= 4 and len(in_avals[0].shape) == 3 \
+                and len(in_avals[3].shape) == 2:
+            q, kp, pt = in_avals[0], in_avals[1], in_avals[3]
+            page = int(kp.shape[-3])
+            T = int(pt.shape[1]) * page
+            scores = _numel(q) // max(int(q.shape[-1]), 1) * T
+            return 4 * _numel(q) * T + 5 * scores, "attention"
+        return None, "unmodeled"
     if name in _NORMALIZE:
         return _NORMALIZE[name] * max(in_n, out_n), "normalize"
     if name in _LOSS:
@@ -294,6 +309,17 @@ def _node_costs(graph: DefUseGraph,
         out_avals = [aval_of(v) for v in node.out_vars]
         out_bytes = sum(aval_bytes(a) for a in out_avals)
         flops, rule = _op_flops(node, in_avals, param_avals, out_avals)
+        if node.op_name == "paged_attention" and len(in_avals) >= 5 \
+                and len(in_avals[3].shape) == 2:
+            # traffic = the page GATHER (K and V rows the table names),
+            # not the whole physical pool the aval describes
+            q, kp, pt = in_avals[0], in_avals[1], in_avals[3]
+            page, hkv, d = (int(s) for s in kp.shape[-3:])
+            S, P = (int(s) for s in pt.shape)
+            item = np.dtype(kp.dtype).itemsize
+            gather = 2 * S * P * page * hkv * d * item      # K + V
+            in_bytes = (aval_bytes(q) + gather
+                        + aval_bytes(pt) + aval_bytes(in_avals[4]))
         out.append(OpCost(i, node.op_name, rule,
                           flops if flops is not None else 0,
                           in_bytes, out_bytes, param_bytes,

@@ -376,3 +376,183 @@ def serving_main(requests=40, clients=4, verbose=False):
           "shed cleanly, deadlines expired in-queue, every served "
           "response bitwise-correct")
     return 0
+
+
+# ---------------------------------------------------------------------------
+# Generation chaos (ISSUE 7): decode flakes + mid-generation deadlines
+# ---------------------------------------------------------------------------
+
+# Decode-step flakes: the scheduler retries a flaked step (the step is
+# functional over the KV pool, and injected faults fire before
+# dispatch), and with decode_retries=3 a rule capped at count=3 can
+# never exhaust a step's 4 attempts — every admitted sequence must
+# stream to a clean finish.
+GENERATION_CHAOS_SPEC = "serving.decode_step:p=0.3,count=3"
+
+
+def make_dyadic_lm(**kw):
+    """A tiny PagedDecoderLM with k/64 dyadic weights (see
+    make_dyadic_model): per-row decode math reproduces bitwise in any
+    slot/batch/page placement, which is what makes the admission-order
+    parity gate below exact instead of tolerance-based."""
+    from paddle_tpu.serving import PagedDecoderLM
+
+    kw.setdefault("vocab_size", 32)
+    kw.setdefault("hidden", 16)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("seed", 3)
+    return PagedDecoderLM(dyadic=True, **kw)
+
+
+def generation_main(requests=18, clients=3, verbose=False):
+    """Generative serving chaos gate; returns 0 on success, 1 on failure.
+
+    Asserts, under injected decode flakes:
+      * every admitted sequence streams to a clean finish with tokens
+        BITWISE-identical to a fault-free serial run in a different
+        admission order (continuous batching must not change results);
+      * a mid-generation deadline expiry evicts its sequence with
+        DeadlineExceeded after streaming some tokens;
+      * page-pool accounting returns to zero (no leaked pages) and the
+        decode hot path never recompiles.
+    """
+    import threading
+    import time
+
+    from paddle_tpu import serving
+    from paddle_tpu.testing import fault
+    from paddle_tpu.utils import monitor
+
+    model = make_dyadic_lm()
+    mk_engine = lambda: serving.GenerationEngine(  # noqa: E731
+        model, num_slots=4, page_size=4, max_context=64,
+        max_queue=4 * requests, decode_retries=3)
+
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, 32, rng.randint(1, 9)).tolist()
+               for _ in range(requests)]
+    budgets = [int(rng.randint(3, 9)) for _ in range(requests)]
+
+    problems = []
+    monitor.stat_reset()
+    engine = mk_engine()
+    engine.warmup()
+    fault.arm(GENERATION_CHAOS_SPEC, seed=1)
+    try:
+        # -- concurrent ragged traffic under decode flakes ---------------
+        outcomes = [None] * requests
+
+        def client(idx):
+            for i in range(idx, requests, clients):
+                try:
+                    got = []
+                    stream = engine.generate(prompts[i],
+                                             max_new_tokens=budgets[i],
+                                             temperature=0.7, seed=i)
+                    for tok in stream.tokens(timeout=60):
+                        got.append(tok)      # exercise streaming
+                    if got != stream.result(0):
+                        raise AssertionError(
+                            "streamed tokens != final result")
+                    outcomes[i] = got
+                except Exception as e:  # noqa: BLE001 - gated below
+                    outcomes[i] = e
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        for i, out in enumerate(outcomes):
+            if isinstance(out, Exception):
+                problems.append(
+                    f"admitted sequence {i} failed under chaos: "
+                    f"{type(out).__name__}: {out}")
+            elif out is None or len(out) != budgets[i]:
+                problems.append(
+                    f"sequence {i}: {0 if out is None else len(out)} "
+                    f"tokens, budget {budgets[i]}")
+    finally:
+        fault.disarm()
+
+    # -- admission-order parity: serial fault-free run, reversed order --
+    ref_engine = mk_engine()
+    ref_engine.warmup()
+    refs = [None] * requests
+    for i in reversed(range(requests)):
+        refs[i] = ref_engine.generate_sync(
+            prompts[i], timeout=60, max_new_tokens=budgets[i],
+            temperature=0.7, seed=i)
+    for i, (out, ref) in enumerate(zip(outcomes, refs)):
+        if isinstance(out, list) and out != ref:
+            problems.append(
+                f"sequence {i}: tokens differ from serial run "
+                f"(admission order leaked into results): {out} != {ref}")
+    ref_engine.close()
+
+    # -- mid-generation deadline expiry (deterministic via pause; the
+    # deadline is generous so even a loaded runner streams two tokens
+    # before the pause lets it lapse) ------------------------------------
+    doomed = engine.generate(prompts[0], max_new_tokens=40,
+                             deadline_ms=2000.0)
+    it = doomed.tokens(timeout=30)
+    first = []
+    try:
+        first.append(next(it))          # decoding has demonstrably begun
+        first.append(next(it))
+        engine.pause()
+        time.sleep(2.2)                 # deadline lapses mid-generation
+        engine.resume()
+        for _ in it:
+            pass
+        problems.append("mid-generation deadline did not expire")
+    except serving.DeadlineExceeded:
+        if len(first) < 2:
+            problems.append("deadline expired before decoding began "
+                            "(not a MID-generation expiry)")
+    except Exception as e:  # noqa: BLE001
+        problems.append(f"deadline sequence died oddly: "
+                        f"{type(e).__name__}: {e}")
+    finally:
+        engine.resume()                 # never leave the engine paused
+
+    engine.drain(timeout=60)
+    stats = engine.stats()
+    engine.close()
+
+    fired = monitor.get_stat("fault.fired.serving.decode_step")
+    if fired < 1:
+        problems.append("chaos spec never fired a decode fault "
+                        "(nothing was actually tested)")
+    if stats["counters"]["decode_retries"] < fired:
+        problems.append(
+            f"decode fired {fired} faults but only "
+            f"{stats['counters']['decode_retries']} retries ran")
+    if stats["recompiles_after_warmup"] != 0:
+        problems.append(f"decode hot path recompiled "
+                        f"{stats['recompiles_after_warmup']}x under chaos")
+    if stats["page_pool"]["in_use"] != 0:
+        problems.append(f"page pool leaked "
+                        f"{stats['page_pool']['in_use']} pages")
+    if stats["counters"]["pages_allocated"] \
+            != stats["counters"]["pages_freed"]:
+        problems.append(
+            f"page accounting: {stats['counters']['pages_allocated']} "
+            f"allocated vs {stats['counters']['pages_freed']} freed")
+    if verbose:
+        print(f"generation chaos stats: faults={fired} "
+              f"retries={stats['counters']['decode_retries']} "
+              f"expired={stats['counters']['deadline_expired']} "
+              f"steps={stats['counters']['decode_steps']} "
+              f"occupancy={stats['mean_slot_occupancy']:.2f}")
+    if problems:
+        for p in problems:
+            print(f"FAIL: {p}", file=sys.stderr)
+        return 1
+    print("generation chaos OK: decode flakes retried, tokens bitwise-"
+          "identical to serial admission, mid-generation deadline "
+          "evicted cleanly, page pool fully reclaimed")
+    return 0

@@ -115,3 +115,113 @@ def test_early_exit_preserves_distinct_beams():
     np.testing.assert_array_equal(s[0, :2], [2, EOS])
     np.testing.assert_array_equal(s[1, :2], [3, EOS])  # distinct beam!
     assert np.all(s[:, 2:] == EOS)  # padding is end_token
+
+
+def test_gather_tree_hand_computed_trellis():
+    """gather_tree against a fully hand-backtracked B=2, K=3, T=4
+    trellis (satellite: direct coverage of the backtracking rule
+    beams[t-1] = parents[t][beams[t]])."""
+    import paddle_tpu.nn as pnn
+    # ids[t, b, k], parents[t, b, k]
+    ids = np.array(
+        [[[10, 11, 12], [20, 21, 22]],
+         [[13, 14, 15], [23, 24, 25]],
+         [[16, 17, 18], [26, 27, 28]],
+         [[19, 30, 31], [29, 32, 33]]], np.int32)
+    parents = np.array(
+        [[[0, 0, 0], [0, 0, 0]],
+         [[2, 0, 1], [1, 2, 0]],
+         [[1, 2, 0], [0, 1, 2]],
+         [[2, 0, 1], [2, 0, 1]]], np.int32)
+    out = pnn.gather_tree(paddle.to_tensor(ids),
+                          paddle.to_tensor(parents))
+    got = np.asarray(out.numpy())
+    # batch 0, beam 0: t=3 token 19 parent 2 -> t=2 token 18 parent 0
+    #   -> t=1 token 13 parent 2 -> t=0 token 12
+    np.testing.assert_array_equal(got[:, 0, 0], [12, 13, 18, 19])
+    # batch 0, beam 1: t=3 token 30 parent 0 -> t=2 token 16 parent 1
+    #   -> t=1 token 14 parent 0 -> t=0 token 10
+    np.testing.assert_array_equal(got[:, 0, 1], [10, 14, 16, 30])
+    # batch 1, beam 2: t=3 token 33 parent 1 -> t=2 token 27 parent 1
+    #   -> t=1 token 24 parent 2 -> t=0 token 22
+    np.testing.assert_array_equal(got[:, 1, 2], [22, 24, 27, 33])
+
+
+def test_early_exit_matches_exact_horizon():
+    """All beams finish at step 2; decoding with a generous T_max must
+    early-exit to the SAME tokens/scores/lengths as the exact-horizon
+    run (the loop predicate, not the step budget, ends the loop)."""
+    V, EOS = 6, 5
+    tbl = np.full((V, V), -9.0, np.float32)
+    tbl[1, 2], tbl[1, 3] = 2.0, 1.0
+    tbl[2, EOS] = 9.0
+    tbl[3, EOS] = 9.0
+    cell = ToyCell(tbl)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=EOS,
+                               beam_size=2)
+
+    def run(t_max):
+        seq, sc, lens = nn.dynamic_decode(
+            dec, paddle.zeros([2], dtype="int32"), max_step_num=t_max,
+            return_length=True)
+        return (np.asarray(seq.numpy()), np.asarray(sc.numpy()),
+                np.asarray(lens.numpy()))
+
+    s_big, sc_big, l_big = run(40)
+    s_exact, sc_exact, l_exact = run(2)
+    np.testing.assert_array_equal(s_big[:, :, :2], s_exact)
+    np.testing.assert_allclose(sc_big, sc_exact, rtol=0, atol=0)
+    np.testing.assert_array_equal(l_big, l_exact)
+    assert np.all(s_big[:, :, 2:] == EOS)   # padding past the exit
+
+
+def test_cell_step_single_step_api():
+    """nn.cell_step is one step of the cell contract: log-softmaxed
+    logits + raw-array states (what a token-level scheduler drives)."""
+    V = 5
+    tbl = np.arange(V * V, dtype=np.float32).reshape(V, V) / 10.0
+    dec = nn.BeamSearchDecoder(ToyCell(tbl), start_token=1, end_token=0,
+                               beam_size=2)
+    states = paddle.zeros([3], dtype="int32")
+    toks = np.array([1, 4, 2], np.int32)
+    logp, new_states = nn.cell_step(dec, toks, states)
+    logp = np.asarray(logp)
+    assert logp.shape == (3, V)
+    ref = np.asarray(tbl[toks])
+    ref = ref - np.log(np.exp(ref - ref.max(-1, keepdims=True)).sum(
+        -1, keepdims=True)) - ref.max(-1, keepdims=True)
+    np.testing.assert_allclose(logp, ref, rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(new_states), [1, 1, 1])
+
+
+def test_dynamic_decode_cache_replays_one_compile():
+    """cache=True: same decoder/shapes reuse one compiled loop; a
+    different start token rides the SAME executable (traced input) and
+    still decodes its own chain."""
+    from paddle_tpu.nn import decode as decode_mod
+    V = 5
+    tbl = np.full((V, V), -5.0, np.float32)
+    for i in range(V):
+        tbl[i, (i + 1) % V] = 5.0
+    cell = ToyCell(tbl)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                               beam_size=2)
+    inits = paddle.zeros([2], dtype="int32")
+    before = len(decode_mod._DECODE_CACHE)
+    s1, _ = nn.dynamic_decode(dec, inits, max_step_num=6, cache=True)
+    after_first = len(decode_mod._DECODE_CACHE)
+    s1b, _ = nn.dynamic_decode(dec, inits, max_step_num=6, cache=True)
+    dec.start_token = 2                     # traced: same executable
+    s2, _ = nn.dynamic_decode(dec, inits, max_step_num=6, cache=True)
+    assert after_first == before + 1
+    assert len(decode_mod._DECODE_CACHE) == after_first
+    np.testing.assert_array_equal(np.asarray(s1.numpy()),
+                                  np.asarray(s1b.numpy()))
+    # start=2 follows its own chain: 3, 4, 0(EOS)
+    np.testing.assert_array_equal(
+        np.asarray(s2.numpy())[0, 0, :3], [3, 4, 0])
+    dec.start_token = 1
+    # uncached path agrees with cached
+    s_ref, _ = nn.dynamic_decode(dec, inits, max_step_num=6)
+    np.testing.assert_array_equal(np.asarray(s1.numpy()),
+                                  np.asarray(s_ref.numpy()))

@@ -1,0 +1,510 @@
+"""Continuous batching with a paged KV cache (ISSUE 7): page pool
+accounting, paged-attention op (reference tier vs dense oracle + the
+Pallas shape gate), GenerationEngine scheduling (admission-order
+bitwise parity, streaming, deadlines, shedding, page reclamation, zero
+steady-state recompiles), HTTP streaming + keep-alive client, the cost
+rule, and the chaos/smoke gates in-process."""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops, serving
+from paddle_tpu.ops import attention as attention_mod
+from paddle_tpu.serving import kv_cache
+from paddle_tpu.serving.generation import GenerationError
+from paddle_tpu.testing import fault
+from paddle_tpu.testing.chaos import make_dyadic_lm
+
+import jax
+import jax.numpy as jnp
+
+
+# ----------------------------------------------------------- fixtures --
+@pytest.fixture(scope="module")
+def lm():
+    return make_dyadic_lm()
+
+
+@pytest.fixture(scope="module")
+def engine(lm):
+    """One warmed engine shared by read-only traffic tests."""
+    eng = serving.GenerationEngine(lm, num_slots=4, page_size=4,
+                                   max_context=32, max_queue=128)
+    eng.warmup()
+    yield eng
+    eng.close()
+
+
+def _prompts(n, seed=0, vocab=32, lo=1, hi=9):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, rng.randint(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+# ----------------------------------------------------- page pool ------
+def test_page_pool_accounting_and_scratch_page():
+    cfg = kv_cache.KVCacheConfig(num_layers=2, num_kv_heads=2,
+                                 head_dim=4, page_size=4, num_pages=6,
+                                 max_context=16)
+    pool = kv_cache.PagePool(cfg)
+    assert pool.kv[0].shape == (2, 7, 4, 2, 4)   # +1 scratch page
+    a = pool.alloc(4)
+    assert len(a) == 4 and 0 not in a            # scratch never granted
+    assert pool.in_use == 4 and pool.available == 2
+    assert pool.alloc(3) is None                 # all-or-nothing
+    assert pool.in_use == 4                      # nothing half-taken
+    pool.free(a[:2])
+    assert pool.in_use == 2 and pool.available == 4
+    with pytest.raises(ValueError):
+        pool.free(a[:1])                         # double free
+    with pytest.raises(ValueError):
+        pool.free([0])                           # scratch is unfreeable
+    pool.free(a[2:])
+    assert pool.in_use == 0 and pool.available == 6
+
+
+def test_pages_needed_and_config_geometry():
+    assert kv_cache.pages_needed(5, 3, 4) == 2
+    assert kv_cache.pages_needed(1, 1, 4) == 1
+    assert kv_cache.pages_needed(8, 8, 4) == 4
+    cfg = kv_cache.KVCacheConfig(1, 1, 4, page_size=4, num_pages=4,
+                                 max_context=10)
+    assert cfg.pages_per_seq == 3
+
+
+def test_write_token_and_prompt_scatter():
+    pool = jnp.zeros((1, 4, 2, 1, 3))            # L=1, scratch+3 pages
+    vals = jnp.arange(6, dtype=jnp.float32).reshape(2, 1, 3)
+    table = jnp.asarray([[2, 3], [1, 3]], jnp.int32)
+    pos = jnp.asarray([0, 3], jnp.int32)         # page 0/off 0, page 1/off 1
+    out = kv_cache.write_token(pool, 0, vals, table, pos)
+    np.testing.assert_array_equal(np.asarray(out[0, 2, 0, 0]), [0, 1, 2])
+    np.testing.assert_array_equal(np.asarray(out[0, 3, 1, 0]), [3, 4, 5])
+    # prompt write: rows past length land on the scratch page
+    pvals = jnp.arange(12, dtype=jnp.float32).reshape(4, 1, 3)
+    out2 = kv_cache.write_prompt(pool, 0, pvals,
+                                 jnp.asarray([2, 1], jnp.int32),
+                                 jnp.int32(3))
+    np.testing.assert_array_equal(np.asarray(out2[0, 2, 0, 0]), [0, 1, 2])
+    np.testing.assert_array_equal(np.asarray(out2[0, 2, 1, 0]), [3, 4, 5])
+    np.testing.assert_array_equal(np.asarray(out2[0, 1, 0, 0]), [6, 7, 8])
+    assert np.all(np.asarray(out2[0, 1, 1]) == 0)    # pad went to scratch
+    np.testing.assert_array_equal(np.asarray(out2[0, 0, 3, 0]),
+                                  [9, 10, 11])
+
+
+# ----------------------------------------------- paged attention ------
+def _dense_oracle(q, k, v, scale):
+    s = np.einsum("shd,sthd->sht", q, k) * scale
+    w = np.exp(s - s.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    return np.einsum("sht,sthd->shd", w, v)
+
+
+def test_paged_attention_matches_dense_oracle():
+    rng = np.random.RandomState(0)
+    S, H, D, page, P, N = 3, 2, 4, 4, 3, 8
+    lens = np.array([5, 9, 1], np.int32)
+    table = np.array([[3, 5, 0], [7, 2, 6], [1, 0, 0]], np.int32)
+    kp = np.zeros((N + 1, page, H, D), np.float32)
+    vp = np.zeros((N + 1, page, H, D), np.float32)
+    dense_k = np.zeros((S, P * page, H, D), np.float32)
+    dense_v = np.zeros((S, P * page, H, D), np.float32)
+    for s in range(S):
+        for t in range(lens[s]):
+            kk = rng.randn(H, D).astype(np.float32)
+            vv = rng.randn(H, D).astype(np.float32)
+            kp[table[s, t // page], t % page] = kk
+            vp[table[s, t // page], t % page] = vv
+            dense_k[s, t] = kk
+            dense_v[s, t] = vv
+    q = rng.randn(S, H, D).astype(np.float32)
+    scale = 1.0 / np.sqrt(D)
+    got = np.asarray(ops.paged_attention(q, kp, vp, table, lens).numpy())
+    ref = np.stack([
+        _dense_oracle(q[s:s + 1], dense_k[s:s + 1, :lens[s]],
+                      dense_v[s:s + 1, :lens[s]], scale)[0]
+        for s in range(S)])
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_attention_gqa_and_layer_indexing():
+    rng = np.random.RandomState(1)
+    S, H, Hkv, D, page, P = 2, 4, 2, 4, 2, 2
+    L = 3
+    kp = rng.randn(L, 5, page, Hkv, D).astype(np.float32)
+    vp = rng.randn(L, 5, page, Hkv, D).astype(np.float32)
+    table = np.array([[1, 2], [3, 4]], np.int32)
+    lens = np.array([3, 4], np.int32)
+    q = rng.randn(S, H, D).astype(np.float32)
+    for layer in range(L):
+        got = attention_mod.paged_attention_reference(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(table), jnp.asarray(lens), layer=layer)
+        ref = attention_mod.paged_attention_reference(
+            jnp.asarray(q), jnp.asarray(kp[layer]),
+            jnp.asarray(vp[layer]), jnp.asarray(table),
+            jnp.asarray(lens))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-6)
+
+
+def test_pallas_tier_shape_gate(monkeypatch):
+    """The hook dispatches to a registered kernel ONLY on TPU with
+    aligned shapes; no kernel or wrong shapes -> reference tier."""
+    q_shape, pool_shape = (4, 2, 128), (9, 8, 2, 128)
+    assert not attention_mod.paged_attention_supported(
+        q_shape, pool_shape, jnp.float32, 8)         # no kernel yet
+    called = []
+
+    def kernel(q, kp, vp, pt, lens, scale=None):
+        called.append(True)
+        return jnp.zeros(q.shape, q.dtype)
+
+    attention_mod.register_paged_attention_kernel(kernel)
+    try:
+        assert not attention_mod.paged_attention_supported(
+            q_shape, pool_shape, jnp.float32, 8)     # cpu backend
+        monkeypatch.setattr(attention_mod.jax, "default_backend",
+                            lambda: "tpu")
+        assert attention_mod.paged_attention_supported(
+            q_shape, pool_shape, jnp.float32, 8)
+        # misaligned head dim / page size stay on the reference tier
+        assert not attention_mod.paged_attention_supported(
+            (4, 2, 64), (9, 8, 2, 64), jnp.float32, 8)
+        assert not attention_mod.paged_attention_supported(
+            q_shape, pool_shape, jnp.float32, 6)
+        assert not attention_mod.paged_attention_supported(
+            q_shape, pool_shape, jnp.int32, 8)
+        # dispatch actually reroutes under the gate
+        q = jnp.zeros(q_shape, jnp.float32)
+        kp = jnp.zeros(pool_shape, jnp.float32)
+        pt = jnp.zeros((4, 1), jnp.int32)
+        lens = jnp.ones((4,), jnp.int32)
+        ops.paged_attention(q, kp, kp, pt, lens)
+        assert called
+    finally:
+        attention_mod.register_paged_attention_kernel(None)
+
+
+# ------------------------------------------------ engine: tokens ------
+def test_generate_sync_and_streaming_agree(engine):
+    prompts = _prompts(5, seed=3)
+    streams = [engine.generate(p, max_new_tokens=4 + i % 3)
+               for i, p in enumerate(prompts)]
+    for i, s in enumerate(streams):
+        streamed = list(s.tokens(timeout=60))
+        assert streamed == s.result(0)
+        assert len(streamed) == 4 + i % 3
+        assert s.finish_reason == "length"
+
+
+def test_admission_order_parity_bitwise(lm, engine):
+    """Tokens must be identical whether sequences run concurrently (any
+    admission order) or strictly one at a time — the dyadic-model
+    bitwise gate on the continuous batcher."""
+    prompts = _prompts(8, seed=5)
+    budgets = [3 + i % 4 for i in range(8)]
+    streams = [engine.generate(p, max_new_tokens=b, temperature=0.6,
+                               seed=100 + i)
+               for i, (p, b) in enumerate(zip(prompts, budgets))]
+    conc = [s.result(60) for s in streams]
+    # serial runs on a FRESH engine, reversed submission order
+    eng2 = serving.GenerationEngine(lm, num_slots=4, page_size=4,
+                                    max_context=32)
+    serial = [None] * 8
+    for i in reversed(range(8)):
+        serial[i] = eng2.generate_sync(prompts[i], timeout=60,
+                                       max_new_tokens=budgets[i],
+                                       temperature=0.6, seed=100 + i)
+    eng2.close()
+    assert conc == serial
+
+
+def test_sampling_determinism_and_temperature_variety(engine):
+    p = [7, 3, 1]
+    a = engine.generate_sync(p, timeout=60, max_new_tokens=6,
+                             temperature=0.9, seed=11)
+    b = engine.generate_sync(p, timeout=60, max_new_tokens=6,
+                             temperature=0.9, seed=11)
+    c = engine.generate_sync(p, timeout=60, max_new_tokens=6,
+                             temperature=0.9, seed=12)
+    assert a == b                       # same seed -> bitwise identical
+    assert a != c or len(set(a)) > 1    # different seed decodes freely
+
+
+def test_eos_finishes_early(engine):
+    p = [2, 9, 4]
+    kw = dict(max_new_tokens=6, temperature=0.8, seed=21)
+    free = engine.generate_sync(p, timeout=60, **kw)
+    assert len(free) == 6
+    eos = free[2]
+    cut = free.index(eos)               # first time eos would appear
+    s = engine.generate(p, eos_id=eos, **kw)
+    toks = s.result(60)
+    assert toks == free[:cut + 1] and toks[-1] == eos
+    assert s.finish_reason == "eos"
+
+
+def test_zero_recompiles_and_page_reclaim_after_traffic(engine):
+    stats = engine.stats()
+    assert stats["recompiles_after_warmup"] == 0
+    assert stats["page_pool"]["in_use"] == 0
+    c = stats["counters"]
+    assert c["pages_allocated"] == c["pages_freed"]
+    assert c["finished"] > 0
+
+
+# ------------------------------------------- engine: lifecycle --------
+def test_queue_deadline_shed_and_validation(lm):
+    eng = serving.GenerationEngine(lm, num_slots=1, page_size=4,
+                                   max_context=16, max_queue=2,
+                                   prompt_buckets=[8])
+    eng.pause()
+    try:
+        # in-queue deadline expiry
+        doomed = eng.generate([1, 2], max_new_tokens=2, deadline_ms=1.0)
+        time.sleep(0.03)
+        with pytest.raises(serving.DeadlineExceeded):
+            doomed.result(30)
+        # queue-full shedding (expired entries swept first)
+        eng.generate([1], max_new_tokens=2)
+        eng.generate([2], max_new_tokens=2)
+        with pytest.raises(serving.QueueFull):
+            eng.generate([3], max_new_tokens=2)
+        # malformed requests fail synchronously
+        with pytest.raises(ValueError):
+            eng.generate([], max_new_tokens=2)
+        with pytest.raises(ValueError):
+            eng.generate([1], max_new_tokens=0)
+        with pytest.raises(ValueError):
+            eng.generate([1] * 9, max_new_tokens=2)   # > largest bucket
+        with pytest.raises(ValueError):
+            eng.generate([1, 2], max_new_tokens=200)  # > max_context
+    finally:
+        eng.resume()
+        eng.close()
+    assert eng.page_pool.in_use == 0
+
+
+def test_mid_generation_deadline_evicts_and_frees(lm):
+    eng = serving.GenerationEngine(lm, num_slots=2, page_size=4,
+                                   max_context=32, prompt_buckets=[8])
+    try:
+        s = eng.generate([5, 1], max_new_tokens=24, deadline_ms=1500.0)
+        it = s.tokens(timeout=30)
+        got = [next(it)]                # generation demonstrably began
+        eng.pause()
+        time.sleep(1.7)                 # deadline lapses mid-generation
+        eng.resume()
+        with pytest.raises(serving.DeadlineExceeded):
+            for t in it:
+                got.append(t)
+        assert s.finish_reason == "deadline"
+        assert len(got) >= 1
+    finally:
+        eng.close()
+    assert eng.page_pool.in_use == 0
+    assert eng.stats()["counters"]["pages_allocated"] \
+        == eng.stats()["counters"]["pages_freed"]
+
+
+def test_page_starved_admissions_serialize(lm):
+    """A pool with room for only one sequence at a time must serialize
+    admissions instead of deadlocking or leaking."""
+    eng = serving.GenerationEngine(lm, num_slots=2, page_size=4,
+                                   max_context=16, num_pages=3,
+                                   prompt_buckets=[8])
+    try:
+        streams = [eng.generate([i + 1, 2], max_new_tokens=6)
+                   for i in range(3)]   # each needs 2 pages of the 3
+        outs = [s.result(60) for s in streams]
+        assert all(len(o) == 6 for o in outs)
+        st = eng.stats()
+        assert st["counters"]["finished"] == 3
+    finally:
+        eng.close()
+    assert eng.page_pool.in_use == 0
+
+
+def test_close_drains_accepted_work_and_rejects_new(lm):
+    eng = serving.GenerationEngine(lm, num_slots=1, page_size=4,
+                                   max_context=16, prompt_buckets=[8])
+    eng.pause()
+    pend = [eng.generate([1], max_new_tokens=2) for _ in range(3)]
+    eng.close()                 # close = drain: accepted work finishes
+    for s in pend:
+        assert s.future.done()
+        assert len(s.result(0)) == 2
+    with pytest.raises(serving.EngineClosed):
+        eng.generate([1], max_new_tokens=1)
+    assert eng.page_pool.in_use == 0
+
+
+def test_drain_completes_accepted_work(lm):
+    eng = serving.GenerationEngine(lm, num_slots=2, page_size=4,
+                                   max_context=16, prompt_buckets=[8])
+    try:
+        streams = [eng.generate([i + 1], max_new_tokens=4)
+                   for i in range(4)]
+        assert eng.drain(timeout=60)
+        assert all(s.future.done() for s in streams)
+        assert all(len(s.result(0)) == 4 for s in streams)
+        with pytest.raises(serving.EngineClosed):
+            eng.generate([1], max_new_tokens=1)
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------ fault injection -----
+def test_decode_flake_is_retried(lm):
+    eng = serving.GenerationEngine(lm, num_slots=1, page_size=4,
+                                   max_context=16, decode_retries=2,
+                                   prompt_buckets=[8])
+    fault.arm("serving.decode_step:count=2", seed=0)
+    try:
+        out = eng.generate_sync([3, 1], timeout=60, max_new_tokens=5)
+        assert len(out) == 5
+        st = eng.stats()
+        assert st["counters"]["decode_retries"] >= 1
+        assert st["counters"]["failed"] == 0
+    finally:
+        fault.disarm()
+        eng.close()
+
+
+def test_decode_retry_exhaustion_fails_cleanly(lm):
+    eng = serving.GenerationEngine(lm, num_slots=1, page_size=4,
+                                   max_context=16, decode_retries=1,
+                                   prompt_buckets=[8])
+    fault.arm("serving.decode_step:p=1.0", seed=0)
+    try:
+        s = eng.generate([3, 1], max_new_tokens=5)
+        with pytest.raises(GenerationError):
+            s.result(60)
+    finally:
+        fault.disarm()
+    eng.close()
+    assert eng.page_pool.in_use == 0
+
+
+# ------------------------------------------------------- HTTP ---------
+def test_http_generate_stream_and_keepalive(lm):
+    from paddle_tpu.serving.http import Client, ServingServer
+    eng = serving.GenerationEngine(lm, num_slots=2, page_size=4,
+                                   max_context=32, prompt_buckets=[8])
+    srv = ServingServer(None, port=0, generation=eng).start()
+    c = Client(srv.url, timeout=30)
+    try:
+        blocking = c.generate([1, 2, 3], max_new_tokens=5)
+        streamed = list(c.generate_stream([1, 2, 3], max_new_tokens=5))
+        assert streamed == blocking and len(blocking) == 5
+        sampled = c.generate([4], max_new_tokens=4, temperature=0.8,
+                             seed=9)
+        assert sampled == eng.generate_sync([4], timeout=30,
+                                            max_new_tokens=4,
+                                            temperature=0.8, seed=9)
+        # error mapping: malformed body -> ServingError(HTTP 400)
+        with pytest.raises(serving.ServingError):
+            c.generate([], max_new_tokens=2)
+        # /metrics carries the generation block, both encodings
+        m = c.metrics()
+        assert m["generation"]["counters"]["finished"] >= 2
+        assert "serving_decode_" in c.metrics_text()
+        assert c.healthz()["status"] == "running"
+        # keep-alive: the whole conversation rode ONE connection
+        assert c.connections_opened == 1
+    finally:
+        c.close()
+        srv.close()
+        eng.close()
+
+
+def test_http_stream_deadline_error_inband(lm):
+    from paddle_tpu.serving.http import Client, ServingServer
+    eng = serving.GenerationEngine(lm, num_slots=1, page_size=4,
+                                   max_context=32, prompt_buckets=[8])
+    srv = ServingServer(None, port=0, generation=eng).start()
+    c = Client(srv.url, timeout=30)
+    try:
+        eng.pause()
+        gen = c.generate_stream([1], max_new_tokens=4, deadline_ms=1.0)
+        time.sleep(0.03)
+        eng.resume()
+        with pytest.raises(serving.DeadlineExceeded):
+            list(gen)
+        # connection was dropped mid-stream; next request reconnects
+        assert c.generate([2], max_new_tokens=2)
+        assert c.connections_opened == 2
+    finally:
+        c.close()
+        srv.close()
+        eng.close()
+
+
+def test_http_predict_501_without_inference_engine(lm):
+    from paddle_tpu.serving.http import Client, ServingServer
+    eng = serving.GenerationEngine(lm, num_slots=1, page_size=4,
+                                   max_context=16, prompt_buckets=[8])
+    srv = ServingServer(None, port=0, generation=eng).start()
+    c = Client(srv.url, timeout=10)
+    try:
+        with pytest.raises(serving.ServingError, match="501"):
+            c.predict([np.zeros((1, 8), np.float32)])
+    finally:
+        c.close()
+        srv.close()
+        eng.close()
+
+
+# -------------------------------------------------- cost model --------
+def test_paged_attention_cost_rule():
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        S, H, D, P, page, N = 4, 2, 8, 4, 4, 16
+        with paddle.static.program_guard(main):
+            q = paddle.static.data("q", [S, H, D], "float32")
+            kp = paddle.static.data("kp", [N, page, H, D], "float32")
+            vp = paddle.static.data("vp", [N, page, H, D], "float32")
+            pt = paddle.static.data("pt", [S, P], "int32")
+            ln = paddle.static.data("ln", [S], "int32")
+            out = ops.paged_attention(q, kp, vp, pt, ln)
+        rep = main.analyze(fetch_list=[out])
+        row = [c for c in rep.per_op
+               if c.op_name == "paged_attention"][0]
+        T = P * page
+        assert row.modeled and row.rule == "attention"
+        assert row.flops == 4 * S * H * D * T + 5 * S * H * T
+        # input bytes = q + page GATHER (K+V) + table + lengths, NOT
+        # the whole physical pool
+        gather = 2 * S * P * page * H * D * 4
+        assert row.in_bytes == gather + S * H * D * 4 + S * P * 4 + S * 4
+        assert rep.totals["unmodeled"]["count"] == 0
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+# ------------------------------------------------ gates in-process ----
+def test_serve_smoke_decode_gate_in_process():
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        smoke = importlib.import_module("serve_smoke")
+        failures = smoke.run_decode_checks(requests=10, clients=3)
+        assert failures == []
+    finally:
+        sys.path.pop(0)
+
+
+def test_generation_chaos_in_process(capsys):
+    from paddle_tpu.testing import chaos
+    assert chaos.generation_main(requests=8, clients=2) == 0

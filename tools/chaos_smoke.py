@@ -13,10 +13,16 @@ Scenarios (``--scenario``, default ``all``):
   shedding, and in-queue deadline expiry; fails unless every accepted
   request gets a bitwise-correct response or a clean shed/deadline
   error — never a hang or a wrong answer.
+- ``generation`` — :func:`paddle_tpu.testing.chaos.generation_main`:
+  the continuous-batching GenerationEngine under injected decode-step
+  flakes and a mid-generation deadline expiry; fails unless every
+  admitted sequence streams to a clean finish with tokens bitwise-
+  identical to a fault-free serial run (admission order must not leak
+  into results) or errors cleanly, with the page pool fully reclaimed.
 
 Usage::
 
-    python tools/chaos_smoke.py [--scenario all|training|serving]
+    python tools/chaos_smoke.py [--scenario all|training|serving|generation]
                                 [--epochs 4] [--verbose]
 
 CI treats a non-zero exit as a robustness regression.  The same flows
@@ -37,7 +43,7 @@ if REPO not in sys.path:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     ap.add_argument("--scenario", default="all",
-                    choices=["all", "training", "serving"])
+                    choices=["all", "training", "serving", "generation"])
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
@@ -47,6 +53,8 @@ def main(argv=None) -> int:
         rc |= chaos.main(epochs=args.epochs, verbose=args.verbose)
     if args.scenario in ("all", "serving"):
         rc |= chaos.serving_main(verbose=args.verbose)
+    if args.scenario in ("all", "generation"):
+        rc |= chaos.generation_main(verbose=args.verbose)
     return rc
 
 

@@ -20,6 +20,18 @@ regresses:
    float accumulation exact, so batching/padding cannot hide behind
    tolerance).
 
+The **decode gate** (``run_decode_checks``) covers the generative path
+(continuous batching over the paged KV cache) the same way:
+
+5. **steady-state decode recompiles** — after ``warmup()`` every
+   prefill bucket and the decode step are AOT-compiled; a ragged burst
+   must finish with ``recompiles_after_warmup == 0``.
+6. **slot occupancy** — continuous batching must actually fill the
+   decode batch: mean slot occupancy >= 0.5 under the burst.
+7. **page reclamation** — after drain the page pool must be fully
+   reclaimed (``in_use == 0``, allocated == freed): a leaked page is a
+   capacity regression a long-lived server would die from.
+
 Usage:  python tools/serve_smoke.py [--requests N] [--clients C]
 """
 from __future__ import annotations
@@ -127,6 +139,97 @@ def run_checks(requests: int = 64, clients: int = 8,
     return failures
 
 
+def run_decode_checks(requests: int = 20, clients: int = 5,
+                      verbose: bool = False) -> list:
+    """Generative decode gate; returns failure strings (empty = healthy)."""
+    import threading
+
+    import numpy as np
+
+    from paddle_tpu import serving
+    from paddle_tpu.testing.chaos import make_dyadic_lm
+
+    failures = []
+    model = make_dyadic_lm()
+    engine = serving.GenerationEngine(model, num_slots=4, page_size=4,
+                                      max_context=64,
+                                      max_queue=4 * requests)
+    warm = engine.warmup()
+    if verbose:
+        print(f"decode warmup: {warm} variants "
+              f"(buckets {engine.prompt_buckets} + decode)")
+
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(0, 32, rng.randint(1, 9)).tolist()
+               for _ in range(requests)]
+    budgets = [int(rng.randint(4, 10)) for _ in range(requests)]
+
+    # ragged burst: every client enqueues its whole share before
+    # waiting, so the scheduler always has queued work to backfill
+    # freed slots with — the occupancy gate's precondition
+    streams = [None] * requests
+
+    def client(idx):
+        for i in range(idx, requests, clients):
+            streams[i] = engine.generate(prompts[i],
+                                         max_new_tokens=budgets[i])
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = []
+    for s in streams:
+        try:
+            results.append(s.result(timeout=60))
+        except Exception as e:      # noqa: BLE001 - recorded, gated below
+            results.append(e)
+
+    engine.drain(timeout=60)
+    stats = engine.stats()
+    engine.close()
+
+    for i, res in enumerate(results):
+        if isinstance(res, Exception):
+            failures.append(f"sequence {i} failed: "
+                            f"{type(res).__name__}: {res}")
+        elif len(res) != budgets[i]:
+            failures.append(f"sequence {i}: {len(res)} tokens, "
+                            f"budget {budgets[i]}")
+    if stats["recompiles_after_warmup"] != 0:
+        failures.append(
+            f"steady-state decode recompiled "
+            f"{stats['recompiles_after_warmup']}x after warmup (bucketed "
+            f"prefill + static decode shapes must keep the cache hot)")
+    if stats["mean_slot_occupancy"] < OCCUPANCY_FLOOR:
+        failures.append(
+            f"slot occupancy {stats['mean_slot_occupancy']:.2f} below "
+            f"floor {OCCUPANCY_FLOOR} under a ragged burst (continuous "
+            f"batching is not backfilling freed slots)")
+    pool = stats["page_pool"]
+    if pool["in_use"] != 0:
+        failures.append(f"page pool not reclaimed after drain: "
+                        f"{pool['in_use']} pages still held")
+    if stats["counters"]["pages_allocated"] \
+            != stats["counters"]["pages_freed"]:
+        failures.append(
+            f"page accounting: {stats['counters']['pages_allocated']} "
+            f"allocated vs {stats['counters']['pages_freed']} freed")
+    unresolved = [i for i, s in enumerate(streams)
+                  if not s.future.done()]
+    if unresolved:
+        failures.append(f"stuck generation futures after close(): "
+                        f"{unresolved}")
+    if verbose:
+        print(f"decode: occupancy={stats['mean_slot_occupancy']:.2f} "
+              f"steps={stats['counters']['decode_steps']} "
+              f"tokens={stats['counters']['tokens']} "
+              f"prefill/decode={stats['prefill_decode_ratio']:.2f} "
+              f"ttft_p95={stats['ttft_ms']['p95']:.1f}ms")
+    return failures
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     ap.add_argument("--requests", type=int, default=64)
@@ -136,12 +239,16 @@ def main(argv=None) -> int:
 
     failures = run_checks(requests=args.requests, clients=args.clients,
                           verbose=args.verbose)
+    failures += [f"decode: {f}" for f in run_decode_checks(
+        verbose=args.verbose)]
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)
         return 1
     print("serve_smoke: engine healthy (0 hot-path recompiles, coalesced "
-          "batches, bitwise-correct responses, no stuck futures)")
+          "batches, bitwise-correct responses, no stuck futures; decode: "
+          "0 steady-state recompiles, slots backfilled, page pool "
+          "reclaimed)")
     return 0
 
 
