@@ -145,6 +145,12 @@ define_flag("dataloader_timeout", 120,
 define_flag("dataloader_batch_retries", 3,
             "Times one batch may be re-enqueued after DataLoader worker "
             "deaths before the epoch fails for good.")
+define_flag("mesh_replace_warn_only", False,
+            "Downgrade the error raised when init_mesh/set_mesh would "
+            "replace a live mesh that compiled programs still hold "
+            "shardings against (distributed/mesh.py) to a warning.  The "
+            "stale executables keep the OLD device placement — only set "
+            "this when you know every holder is about to be rebuilt.")
 define_flag("checkpoint_keep_max", 2,
             "Snapshots retained per checkpoint dir (keep_checkpoint_max; "
             ">=2 keeps a fallback for corrupt-latest recovery).")

@@ -12,8 +12,17 @@ from .env import (ParallelEnv, get_rank, get_world_size,  # noqa: F401
                   early_init, init_parallel_env, is_initialized)
 from .fleet import Fleet, fleet  # noqa: F401
 from .mesh import (DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS, axis_size,  # noqa
-                   ensure_mesh, get_mesh, init_mesh, set_mesh, sharding)
+                   ensure_mesh, get_mesh, init_mesh, mesh_users,
+                   named_sharding, register_mesh_user,
+                   release_mesh_user, set_mesh)
 from .strategy import DistributedStrategy  # noqa: F401
+# `paddle_tpu.distributed.sharding` is the GSPMD sharding subsystem
+# (rule engine, plans, reshardable checkpoint state)
+from . import sharding  # noqa: F401
+from .sharding import (ShardedState, ShardingPlan,  # noqa: F401
+                       SpecLayout, gather_tree, match_partition_rules,
+                       plan_for_params, shard_tree, spec_divisor,
+                       specs_for_state, with_constraint)
 
 
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,

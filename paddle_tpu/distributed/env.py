@@ -46,10 +46,25 @@ def init_parallel_env(mesh_shape=None):
     ``jax.distributed.initialize`` first so jax.devices() spans all hosts.
     """
     global _initialized
+    from .mesh import get_mesh
+    cur = get_mesh()
     if _initialized:
-        return ensure_mesh()
+        if mesh_shape is None or (
+                cur is not None and dict(cur.shape) == dict(mesh_shape)):
+            return ensure_mesh()
+        # an explicit, different shape re-derives the mesh (the guard in
+        # init_mesh rejects it while compiled programs hold shardings)
+        return init_mesh(mesh_shape)
     early_init()
-    mesh = init_mesh(mesh_shape)
+    if cur is not None and (mesh_shape is None
+                            or dict(cur.shape) == dict(mesh_shape)):
+        # a pre-pinned live mesh (possibly over a custom device subset)
+        # that already has the requested shape stays installed AS-IS —
+        # init_mesh would rebuild it over the default device prefix and
+        # silently move the pin
+        mesh = cur
+    else:
+        mesh = init_mesh(mesh_shape)
     _initialized = True
     return mesh
 

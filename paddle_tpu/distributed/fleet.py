@@ -58,6 +58,25 @@ class Fleet:
         self._role_maker = role_maker or _RoleMaker(is_collective)
         self._strategy = strategy or DistributedStrategy()
         import jax
+        cur = get_mesh()
+        if cur is not None:
+            # respect a user-pinned live mesh when it satisfies the
+            # strategy's model-parallel degrees (a subset mesh on a
+            # bigger host is a legitimate pin — re-deriving over ALL
+            # devices here would fight init_mesh and trip the
+            # replace guard against live compiled programs)
+            try:
+                want = self._strategy.infer_mesh_shape(
+                    int(cur.devices.size))
+            except Exception:  # degrees don't fit the pinned mesh
+                want = None
+            from .mesh import MP_AXIS, PP_AXIS, SP_AXIS
+            cur_shape = dict(cur.shape)
+            if want is not None and all(
+                    cur_shape.get(a, 1) == want.get(a, 1)
+                    for a in (MP_AXIS, PP_AXIS, SP_AXIS)):
+                init_parallel_env(cur_shape)
+                return self
         n = len(jax.devices())
         mesh_shape = self._strategy.infer_mesh_shape(n)
         init_parallel_env(mesh_shape)
@@ -109,9 +128,14 @@ class Fleet:
         opt = optimizer
         s = self._strategy or DistributedStrategy()
         # fail loudly on strategies this build deliberately re-architects
-        # away (VERDICT r3: silent no-op toggles are worse than missing)
+        # away (VERDICT r3: silent no-op toggles are worse than missing),
+        # and on parallel degrees that don't divide the device count
+        import jax
         from .strategy import validate_toggles
-        validate_toggles(s)
+        mesh = get_mesh()
+        validate_toggles(s, n_devices=(int(mesh.devices.size)
+                                       if mesh is not None
+                                       else len(jax.devices())))
         if s.lamb:
             from ..optimizer import Lamb
             if not isinstance(opt, Lamb):
@@ -128,6 +152,11 @@ class Fleet:
                     lars_weight_decay=s.lars_configs.lars_weight_decay,
                     parameters=opt._parameter_list,
                     grad_clip=opt._grad_clip)
+        # the static Executor reads the strategy off the optimizer when
+        # minimize() attaches it to a Program, and lowers the donated
+        # _ExecState through jit-with-shardings on the strategy's mesh
+        # (distributed/sharding.py ShardingPlan)
+        opt._dist_strategy = s
         self._optimizer = opt
         return opt
 

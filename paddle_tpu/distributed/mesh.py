@@ -11,19 +11,89 @@ whose *named axes* play the role of rings: 'dp' (data), 'mp' (tensor/model),
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Sequence
+import warnings
+import weakref
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-_lock = threading.Lock()
+# reentrant: install paths hold it across the equal-mesh short-circuit
+# + replace-guard check + write, and _check_replace reads the user
+# table (mesh_users) under the same lock
+_lock = threading.RLock()
 _global_mesh: Optional[Mesh] = None
+# live holders of shardings against the current mesh: id(owner) ->
+# (weakref(owner), mesh, note).  Owners are compiled executables /
+# sharded states; entries die with their owner (see register_mesh_user).
+_mesh_users: Dict[int, tuple] = {}
 
 DP_AXIS = "dp"
 MP_AXIS = "mp"
 PP_AXIS = "pp"
 SP_AXIS = "sp"
+
+
+def register_mesh_user(owner, mesh: Mesh, note: str = "") -> None:
+    """Record that ``owner`` (a compiled program / sharded state) holds
+    shardings built against ``mesh``.  Replacing that mesh while the
+    owner is alive raises (or warns under
+    ``FLAGS_mesh_replace_warn_only``) — stale shardings silently
+    misplace every subsequent dispatch."""
+    key = id(owner)
+
+    def _drop(_ref, _key=key):
+        _mesh_users.pop(_key, None)
+
+    with _lock:
+        _mesh_users[key] = (weakref.ref(owner, _drop), mesh, note)
+
+
+def release_mesh_user(owner) -> None:
+    with _lock:
+        _mesh_users.pop(id(owner), None)
+
+
+def mesh_users(mesh: Optional[Mesh] = None) -> List[str]:
+    """Notes of live owners holding shardings against ``mesh`` (default:
+    any mesh)."""
+    out = []
+    with _lock:
+        for key, (ref, m, note) in list(_mesh_users.items()):
+            if ref() is None:
+                _mesh_users.pop(key, None)
+            elif mesh is None or m is mesh:
+                out.append(note or f"owner#{key}")
+    return out
+
+
+def _same_mesh(a: Mesh, b: Mesh) -> bool:
+    return (a.axis_names == b.axis_names
+            and dict(a.shape) == dict(b.shape)
+            and list(a.devices.flat) == list(b.devices.flat))
+
+
+def _check_replace(new_mesh: Mesh) -> None:
+    old = _global_mesh
+    if old is None or _same_mesh(old, new_mesh):
+        return
+    users = mesh_users(old)
+    if not users:
+        return
+    from ..core.enforce import PreconditionNotMetError
+    from ..core.flags import get_flag
+    msg = (
+        f"replacing live mesh {dict(old.shape)} with "
+        f"{dict(new_mesh.shape)} while {len(users)} compiled program(s) "
+        f"still hold shardings against it: {users[:4]} — their "
+        f"executables would silently keep the old placement.  Close the "
+        f"Executor / drop the train step first (or set "
+        f"FLAGS_mesh_replace_warn_only=1 to proceed at your own risk).")
+    if get_flag("mesh_replace_warn_only"):
+        warnings.warn(msg)
+    else:
+        raise PreconditionNotMetError(msg)
 
 
 def init_mesh(shape: Optional[Dict[str, int]] = None,
@@ -38,11 +108,26 @@ def init_mesh(shape: Optional[Dict[str, int]] = None,
         shape = {DP_AXIS: len(devices)}
     sizes = list(shape.values())
     n = int(np.prod(sizes))
-    assert n <= len(devices), (
-        f"mesh needs {n} devices, only {len(devices)} available")
+    if n > len(devices):
+        from ..core.enforce import ResourceExhaustedError, enforce
+        enforce(False, (
+            f"mesh shape {dict(shape)} needs {n} devices but only "
+            f"{len(devices)} are available — shrink an axis (product of "
+            f"sizes must be <= device count), or raise the virtual "
+            f"device count on CPU via "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n}"),
+            exc=ResourceExhaustedError)
     arr = np.asarray(devices[:n]).reshape(sizes)
     mesh = Mesh(arr, tuple(shape.keys()))
     with _lock:
+        old = _global_mesh
+        if old is not None and _same_mesh(old, mesh):
+            # keep the installed object: registered users (and plan
+            # caches keyed on mesh identity) stay bound to the live
+            # mesh — an equal-but-new object would silently disarm the
+            # replace guard
+            return old
+        _check_replace(mesh)
         _global_mesh = mesh
     return mesh
 
@@ -54,6 +139,9 @@ def get_mesh() -> Optional[Mesh]:
 def set_mesh(mesh: Mesh):
     global _global_mesh
     with _lock:
+        if _global_mesh is not None and _same_mesh(_global_mesh, mesh):
+            return  # equal re-install: keep the object mesh users hold
+        _check_replace(mesh)
         _global_mesh = mesh
 
 
@@ -71,8 +159,15 @@ def axis_size(name: str) -> int:
 
 
 def sharding(*spec) -> NamedSharding:
-    """NamedSharding over the global mesh with the given PartitionSpec."""
+    """NamedSharding over the global mesh with the given PartitionSpec.
+
+    Also exported as :func:`named_sharding` — the package-level name
+    ``paddle_tpu.distributed.sharding`` now refers to the GSPMD
+    subsystem MODULE, which shadows this helper there."""
     return NamedSharding(ensure_mesh(), PartitionSpec(*spec))
+
+
+named_sharding = sharding
 
 
 def replicated() -> NamedSharding:

@@ -1,0 +1,770 @@
+"""First-class GSPMD sharding: partition rules, layouts, sharded trees.
+
+The reference framework distributes through fleet meta-optimizers that
+rewrite ProgramDescs around NCCL rings; on TPU the whole capability
+collapses into ONE mechanism — a named-axis ``jax.sharding.Mesh`` plus a
+``PartitionSpec`` per tensor, with GSPMD inserting every collective.
+This module is the subsystem that owns that mapping:
+
+- **Partition-rule engine** — :func:`match_partition_rules` walks a
+  named tree (``state_dict``-style nested dicts, or ``[(name, leaf)]``)
+  and assigns each leaf the spec of the first ``(regex, PartitionSpec)``
+  rule matching its ``/``-joined name.  Scalar leaves are always
+  replicated; a non-scalar leaf no rule matches is a hard ``enforce``
+  error carrying the nearest-rule hint (a silent default placement is
+  how fleets end up replicating their embedding table).
+- **Canonical layouts** — :class:`SpecLayout` is the one table naming
+  how each parameter family shards over the dp / fsdp / tp / pp axes
+  (the SpecLayout pattern; axes default to this repo's mesh names).
+- **Tree helpers** — :func:`shard_tree` / :func:`gather_tree` /
+  :func:`with_constraint` move whole named trees on and off a mesh.
+- **Plans** — :class:`ShardingPlan` binds (mesh, per-param specs, batch
+  axes) for one parameter list; the static Executor lowers its donated
+  ``_ExecState`` through ``jit(in_shardings=..., out_shardings=...)``
+  built from a plan (see ``static/executor.py``), and the cost model
+  prices per-shard memory through :meth:`ShardingPlan.divisor`.
+- **Reshardable checkpoints** — :class:`ShardedState` adapts a named
+  tree of (possibly sharded) arrays to ``SnapshotStore``'s sharded
+  protocol: one payload per unique shard, each digest-verified, and
+  restore onto a *different* mesh shape reshards — gather-free when the
+  stored layout already matches the target, assemble-then-``device_put``
+  when it doesn't.
+"""
+from __future__ import annotations
+
+import difflib
+import re
+import warnings
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.enforce import InvalidArgumentError, enforce
+from .mesh import DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS, ensure_mesh, get_mesh
+
+__all__ = [
+    "SpecLayout", "ShardingPlan", "ShardedState", "match_partition_rules",
+    "named_tree_flatten", "named_tree_unflatten", "shard_tree",
+    "gather_tree", "with_constraint", "spec_divisor", "spec_to_json",
+    "spec_from_json", "specs_for_state", "plan_for_params",
+]
+
+SEP = "/"
+
+
+# ---------------------------------------------------------------------------
+# named trees
+# ---------------------------------------------------------------------------
+
+def _leaf_array(leaf):
+    """The array behind a leaf (unwraps Tensor/Parameter) or None when
+    the leaf is not array-like."""
+    from ..core.tensor import Tensor
+    if isinstance(leaf, Tensor):
+        return leaf.data
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        return leaf
+    return None
+
+
+def named_tree_flatten(tree, sep: str = SEP) -> List[Tuple[str, object]]:
+    """Flatten nested dicts / lists / tuples / [(name, leaf)] pairs to
+    ``[(name, leaf)]`` with ``sep``-joined path names."""
+    out: List[Tuple[str, object]] = []
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}{sep}{k}" if prefix else str(k), v)
+        elif isinstance(node, (list, tuple)) and not _is_pair_list(node):
+            for i, v in enumerate(node):
+                walk(f"{prefix}{sep}{i}" if prefix else str(i), v)
+        else:
+            out.append((prefix, node))
+
+    if _is_pair_list(tree):
+        for name, leaf in tree:
+            walk(str(name), leaf)
+    else:
+        walk("", tree)
+    return out
+
+
+def _is_pair_list(node) -> bool:
+    return (isinstance(node, (list, tuple)) and len(node) > 0
+            and all(isinstance(e, tuple) and len(e) == 2
+                    and isinstance(e[0], str) for e in node))
+
+
+def named_tree_unflatten(items: Sequence[Tuple[str, object]],
+                         sep: str = SEP) -> dict:
+    """Rebuild the nested-dict skeleton from ``[(name, leaf)]``."""
+    root: dict = {}
+    for name, leaf in items:
+        parts = name.split(sep)
+        d = root
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = leaf
+    return root
+
+
+# ---------------------------------------------------------------------------
+# partition-rule engine
+# ---------------------------------------------------------------------------
+
+def _as_spec(s) -> PartitionSpec:
+    if isinstance(s, PartitionSpec):
+        return s
+    if s is None:
+        return PartitionSpec()
+    if isinstance(s, (tuple, list)):
+        return PartitionSpec(*s)
+    return PartitionSpec(s)
+
+
+def _is_scalar(arr) -> bool:
+    shape = tuple(getattr(arr, "shape", ()))
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return len(shape) == 0 or n == 1
+
+
+def _nearest_rule(name: str, rules) -> Optional[str]:
+    """The rule pattern most similar to ``name`` (regex metachars
+    stripped before comparing) — the hint for an unmatched leaf."""
+    if not rules:
+        return None
+    plain = {p: re.sub(r"[\\^$.|?*+()\[\]{}]", "", p) for p, _ in rules}
+    best = max(plain, key=lambda p: difflib.SequenceMatcher(
+        None, plain[p], name).ratio())
+    return best
+
+
+def match_partition_rules(rules, tree, sep: str = SEP,
+                          strict: bool = True):
+    """Assign a ``PartitionSpec`` to every leaf of a named tree.
+
+    ``rules`` is an ORDERED sequence of ``(regex, spec)``; the first
+    pattern ``re.search``-matching the leaf's ``sep``-joined name wins.
+    Scalar leaves (0-dim or one element) are replicated regardless of
+    rules.  A non-scalar leaf with no matching rule raises
+    :class:`InvalidArgumentError` naming the leaf and the nearest rule
+    (``strict=False`` downgrades to replicated, for exploratory use).
+
+    Returns ``[(name, spec)]`` pairs for a pair-list input, or the
+    nested-dict skeleton of specs for a nested input.
+    """
+    rules = [(p, _as_spec(s)) for p, s in (rules or [])]
+    items = named_tree_flatten(tree, sep=sep)
+    out: List[Tuple[str, PartitionSpec]] = []
+    for name, leaf in items:
+        arr = _leaf_array(leaf)
+        if arr is not None and _is_scalar(arr):
+            out.append((name, PartitionSpec()))
+            continue
+        for pat, spec in rules:
+            if re.search(pat, name) is not None:
+                out.append((name, spec))
+                break
+        else:
+            if strict:
+                hint = _nearest_rule(name, rules)
+                enforce(False, (
+                    f"no partition rule matches parameter '{name}' "
+                    f"({len(rules)} rule(s) tried)"
+                    + (f"; nearest rule: r'{hint}'" if hint else "")
+                    + " — add an explicit (regex, PartitionSpec) rule "
+                    "for it (use r'.*' -> PartitionSpec() as a final "
+                    "catch-all to replicate everything unmatched)"),
+                    exc=InvalidArgumentError)
+            out.append((name, PartitionSpec()))
+    if _is_pair_list(tree):
+        return out
+    return named_tree_unflatten(out, sep=sep)
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Canonical PartitionSpecs per parameter family over named axes.
+
+    Axis defaults follow this repo's mesh names (``mesh.py``): data
+    parallel 'dp' (which doubles as the fsdp/ZeRO axis), tensor
+    parallel 'mp', pipeline 'pp', sequence 'sp'.  Use the methods as
+    the right-hand sides of partition rules."""
+
+    data_axis: str = DP_AXIS
+    fsdp_axis: str = DP_AXIS
+    tp_axis: str = MP_AXIS
+    pp_axis: str = PP_AXIS
+    sp_axis: str = SP_AXIS
+
+    def replicated(self) -> PartitionSpec:
+        return PartitionSpec()
+
+    def embedding(self) -> PartitionSpec:
+        """[vocab, hidden] row-sharded over tp (VocabParallelEmbedding)."""
+        return PartitionSpec(self.tp_axis, None)
+
+    def column_parallel(self) -> PartitionSpec:
+        """[in, out] matmul weight split on the output dim."""
+        return PartitionSpec(None, self.tp_axis)
+
+    def row_parallel(self) -> PartitionSpec:
+        """[in, out] matmul weight split on the input dim."""
+        return PartitionSpec(self.tp_axis, None)
+
+    def fsdp(self) -> PartitionSpec:
+        """Dim-0 (ZeRO-3 style) shard over the fsdp axis."""
+        return PartitionSpec(self.fsdp_axis)
+
+    def norm(self) -> PartitionSpec:
+        return PartitionSpec()
+
+    def activations(self) -> PartitionSpec:
+        """Batch-major runtime tensors shard over data."""
+        return PartitionSpec(self.data_axis)
+
+    def rules(self) -> List[Tuple[str, PartitionSpec]]:
+        """A reasonable transformer default: embeddings vocab-sharded,
+        norms/biases replicated, 2-D weights fsdp-sharded on dim 0,
+        everything else replicated.  Order matters — first match wins."""
+        return [
+            (r"embedding", self.embedding()),
+            (r"(^|/)(ln|norm|layer_norm|bn)[^/]*", self.norm()),
+            (r"\.b_\d+$|(^|/)bias$", PartitionSpec()),
+            (r"\.w_\d+$|(^|/)weight$", self.fsdp()),
+            (r".*", PartitionSpec()),
+        ]
+
+
+# ---------------------------------------------------------------------------
+# spec utilities
+# ---------------------------------------------------------------------------
+
+def spec_axes(spec: PartitionSpec) -> List[str]:
+    axes: List[str] = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.extend(entry)
+        else:
+            axes.append(entry)
+    return axes
+
+
+def spec_divisor(spec: PartitionSpec, mesh_shape: Dict[str, int]) -> int:
+    """How many ways this spec splits a tensor on the given mesh: the
+    product of the sizes of every mesh axis the spec shards over."""
+    n = 1
+    for a in spec_axes(spec):
+        n *= int(mesh_shape.get(a, 1))
+    return n
+
+
+def spec_to_json(spec: PartitionSpec) -> list:
+    out = []
+    for entry in tuple(spec):
+        if isinstance(entry, (tuple, list)):
+            out.append(list(entry))
+        else:
+            out.append(entry)
+    return out
+
+
+def spec_from_json(data) -> PartitionSpec:
+    entries = []
+    for entry in (data or []):
+        if isinstance(entry, list):
+            entries.append(tuple(entry))
+        else:
+            entries.append(entry)
+    return PartitionSpec(*entries)
+
+
+def _fit_spec_to_mesh(spec: PartitionSpec, shape, mesh: Mesh,
+                      name: str = "") -> PartitionSpec:
+    """Drop spec axes the mesh doesn't carry, and axes whose assigned
+    dim isn't divisible by the axis size — the portability rule that
+    lets one rule set run unchanged on mesh sizes {1, 8}."""
+    entries = []
+    changed = False
+    for d, entry in enumerate(tuple(spec)):
+        axes = ([entry] if isinstance(entry, str)
+                else list(entry) if isinstance(entry, (tuple, list))
+                else [])
+        kept = []
+        for a in axes:
+            size = mesh.shape.get(a)
+            if size is None:
+                changed = True
+                continue
+            dim = int(shape[d]) if d < len(shape) else 0
+            if size > 1 and dim % size != 0:
+                changed = True
+                warnings.warn(
+                    f"sharding: '{name}' dim {d} ({dim}) is not divisible "
+                    f"by mesh axis '{a}' (size {size}); replicating that "
+                    f"dim instead")
+                continue
+            kept.append(a)
+        if not kept:
+            entries.append(None)
+        elif len(kept) == 1:
+            entries.append(kept[0])
+        else:
+            entries.append(tuple(kept))
+    if len(tuple(spec)) > len(shape):
+        entries = entries[:len(shape)]
+        changed = True
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries) if changed else spec
+
+
+def specs_for_state(param_specs, state, param_shapes=None):
+    """Optimizer-state specs inheriting from the params they belong to.
+
+    ``param_specs`` is a per-param list of PartitionSpec aligned with
+    ``state`` — the Optimizer's functional state, a per-param list of
+    ``{slot_name: array}``.  A slot whose shape equals the param's
+    stored shape inherits the param's spec (Adam m/v shard exactly like
+    their param under ZeRO); anything else (scalar betas, step counts,
+    factored moments) is replicated.  Pass ``param_shapes`` (per-param
+    shape tuples) to enforce the shape check exactly; without it any
+    non-scalar slot inherits."""
+    out = []
+    for i, (spec, slots) in enumerate(zip(param_specs, state)):
+        entry = {}
+        p_shape = (tuple(param_shapes[i]) if param_shapes is not None
+                   else None)
+        for k, v in (slots or {}).items():
+            arr = _leaf_array(v)
+            inherits = (arr is not None and not _is_scalar(arr)
+                        and len(spec_axes(spec)) > 0)
+            if inherits and p_shape is not None \
+                    and tuple(arr.shape) != p_shape:
+                inherits = False
+            entry[k] = spec if inherits else PartitionSpec()
+        out.append(entry)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# tree placement
+# ---------------------------------------------------------------------------
+
+def _rewrap(leaf, arr):
+    from ..core.tensor import Tensor
+    if isinstance(leaf, Tensor):
+        t = Tensor(arr, stop_gradient=leaf.stop_gradient, name=leaf.name)
+        return t
+    return arr
+
+
+def shard_tree(tree, specs=None, mesh: Optional[Mesh] = None,
+               rules=None, sep: str = SEP):
+    """``device_put`` every leaf of a named tree onto ``mesh`` with its
+    PartitionSpec.  ``specs`` may be a matching tree / pair-list / dict
+    of name->spec; or pass ``rules`` to derive specs through
+    :func:`match_partition_rules`.  With neither, leaves replicate.
+    Tensor leaves come back as Tensors holding sharded arrays."""
+    mesh = mesh or ensure_mesh()
+    items = named_tree_flatten(tree, sep=sep)
+    if rules is not None:
+        spec_of = dict(match_partition_rules(
+            rules, [(n, l) for n, l in items], sep=sep))
+    elif specs is not None:
+        if isinstance(specs, dict) and not any(
+                isinstance(v, dict) for v in specs.values()):
+            spec_of = {n: _as_spec(s) for n, s in specs.items()}
+        else:
+            spec_of = {n: _as_spec(s)
+                       for n, s in named_tree_flatten(specs, sep=sep)}
+    else:
+        spec_of = {}
+    out = []
+    for name, leaf in items:
+        arr = _leaf_array(leaf)
+        if arr is None:
+            out.append((name, leaf))
+            continue
+        spec = spec_of.get(name, PartitionSpec())
+        spec = _fit_spec_to_mesh(spec, tuple(arr.shape), mesh, name)
+        placed = jax.device_put(arr, NamedSharding(mesh, spec))
+        out.append((name, _rewrap(leaf, placed)))
+    if _is_pair_list(tree):
+        return out
+    return named_tree_unflatten(out, sep=sep)
+
+
+def gather_tree(tree, sep: str = SEP):
+    """Materialise every leaf as a full host ``np.ndarray`` (the
+    all-gather read side of :func:`shard_tree`)."""
+    items = named_tree_flatten(tree, sep=sep)
+    out = [(n, np.asarray(_leaf_array(l)) if _leaf_array(l) is not None
+            else l) for n, l in items]
+    if _is_pair_list(tree):
+        return out
+    return named_tree_unflatten(out, sep=sep)
+
+
+def with_constraint(x, *spec, mesh: Optional[Mesh] = None):
+    """``lax.with_sharding_constraint`` over the (global) mesh — usable
+    inside jit-traced code to pin an activation's layout.  Accepts and
+    returns Tensors transparently."""
+    from ..core.tensor import Tensor
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return x
+    arr = x.data if isinstance(x, Tensor) else x
+    sp = spec[0] if len(spec) == 1 and isinstance(
+        spec[0], PartitionSpec) else PartitionSpec(*spec)
+    out = jax.lax.with_sharding_constraint(arr, NamedSharding(mesh, sp))
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+# ---------------------------------------------------------------------------
+# plans
+# ---------------------------------------------------------------------------
+
+class ShardingPlan:
+    """(mesh, per-param specs, batch axes) for one ordered param list.
+
+    The static Executor compiles its donated state through
+    ``jit(in_shardings=..., out_shardings=...)`` built from a plan; the
+    cost model divides tensor bytes through :meth:`divisor` to price a
+    program per-chip."""
+
+    __slots__ = ("mesh", "param_names", "param_specs", "batch_axes",
+                 "label", "_fp")
+
+    def __init__(self, mesh: Mesh, param_names: Sequence[str],
+                 param_specs: Sequence[PartitionSpec],
+                 batch_axes: Sequence[str] = (DP_AXIS,), label: str = ""):
+        self.mesh = mesh
+        self.param_names = list(param_names)
+        self.param_specs = [_as_spec(s) for s in param_specs]
+        self.batch_axes = tuple(a for a in batch_axes
+                                if a in mesh.shape)
+        self.label = label
+        self._fp = None
+
+    # -- identity ----------------------------------------------------------
+    def fingerprint(self) -> tuple:
+        """Hashable identity for compile caching: a mesh change or a
+        spec change must recompile (and names the cause in the
+        attribution record).  A plan is immutable, so the tuple is
+        computed once — the Executor folds it into the cache key on
+        EVERY run."""
+        if self._fp is None:
+            self._fp = (tuple(self.mesh.shape.items()),
+                        tuple(d.id for d in self.mesh.devices.flat),
+                        tuple(str(s) for s in self.param_specs),
+                        self.batch_axes)
+        return self._fp
+
+    @property
+    def n_devices(self) -> int:
+        return int(self.mesh.devices.size)
+
+    # -- shardings ---------------------------------------------------------
+    def _ns(self, spec) -> NamedSharding:
+        return NamedSharding(self.mesh, _as_spec(spec))
+
+    def param_spec(self, i: int) -> PartitionSpec:
+        return self.param_specs[i]
+
+    def param_sharding(self, i: int) -> NamedSharding:
+        return self._ns(self.param_specs[i])
+
+    def replicated(self) -> NamedSharding:
+        return self._ns(PartitionSpec())
+
+    def feed_spec(self, shape) -> PartitionSpec:
+        """Batch feeds shard dim 0 over the batch axes when divisible;
+        anything else replicates (correct, just not parallel)."""
+        if not self.batch_axes or not shape:
+            return PartitionSpec()
+        n = 1
+        for a in self.batch_axes:
+            n *= self.mesh.shape[a]
+        if int(shape[0]) % n != 0:
+            return PartitionSpec()
+        entry = (self.batch_axes[0] if len(self.batch_axes) == 1
+                 else tuple(self.batch_axes))
+        return PartitionSpec(entry)
+
+    def feed_sharding(self, shape) -> NamedSharding:
+        return self._ns(self.feed_spec(shape))
+
+    def divisor(self, spec) -> int:
+        return spec_divisor(_as_spec(spec), dict(self.mesh.shape))
+
+    def batch_divisor(self) -> int:
+        n = 1
+        for a in self.batch_axes:
+            n *= self.mesh.shape[a]
+        return n
+
+    def spec_by_name(self, name: str) -> Optional[PartitionSpec]:
+        try:
+            return self.param_specs[self.param_names.index(name)]
+        except ValueError:
+            return None
+
+    def __repr__(self):
+        sharded = sum(1 for s in self.param_specs if spec_axes(s))
+        return (f"ShardingPlan(mesh={dict(self.mesh.shape)}, "
+                f"params={len(self.param_specs)} ({sharded} sharded), "
+                f"batch_axes={self.batch_axes})")
+
+
+def plan_for_params(named_params, strategy=None, mesh: Optional[Mesh] = None,
+                    rules=None, label: str = "") -> ShardingPlan:
+    """Build a :class:`ShardingPlan` for ``[(name, param)]``.
+
+    Per-param resolution order:
+
+    1. explicit ``placement`` metadata (tensor-parallel layers);
+    2. ``rules`` (or ``strategy.sharding_rules``) through the full
+       rule engine — unmatched non-scalar names are a hard error;
+    3. default policy: replicated, except ZeRO-3
+       (``strategy.sharding`` stage >= 3) dim-0 shards params of at
+       least ``min_shard_numel`` elements over 'dp' when divisible.
+
+    Specs are then fitted to the mesh (axes the mesh doesn't carry, or
+    non-divisible dims, replicate) so one config runs on mesh sizes
+    {1, 8} unchanged."""
+    from ..parallel.tp_layers import get_placement
+    mesh = mesh or ensure_mesh()
+    if rules is None and strategy is not None:
+        rules = getattr(strategy, "sharding_rules", None)
+    names = [n for n, _ in named_params]
+    arrays = []
+    for _, p in named_params:
+        arr = _leaf_array(p)
+        arrays.append(arr)
+
+    rule_specs: Dict[str, PartitionSpec] = {}
+    if rules is not None:
+        unplaced = [(n, p) for n, p in named_params
+                    if get_placement(p) is None]
+        rule_specs = dict(match_partition_rules(rules, unplaced))
+
+    z3 = (strategy is not None and getattr(strategy, "sharding", False)
+          and strategy.sharding_configs.stage >= 3
+          and DP_AXIS in mesh.shape)
+    min_numel = (strategy.sharding_configs.min_shard_numel
+                 if z3 else 0)
+    dp = mesh.shape.get(DP_AXIS, 1)
+
+    specs: List[PartitionSpec] = []
+    for (name, p), arr in zip(named_params, arrays):
+        pl = get_placement(p)
+        if pl is not None:
+            spec = pl
+        elif name in rule_specs:
+            spec = rule_specs[name]
+        elif (z3 and arr is not None and not _is_scalar(arr)
+              and int(np.prod(arr.shape)) >= min_numel
+              and int(arr.shape[0]) % dp == 0):
+            spec = PartitionSpec(DP_AXIS)
+        else:
+            spec = PartitionSpec()
+        shape = tuple(arr.shape) if arr is not None else ()
+        specs.append(_fit_spec_to_mesh(spec, shape, mesh, name))
+    return ShardingPlan(mesh, names, specs, label=label)
+
+
+# ---------------------------------------------------------------------------
+# reshardable checkpoint state (SnapshotStore sharded protocol)
+# ---------------------------------------------------------------------------
+
+def _shard_index_json(index, shape) -> list:
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(int(dim))
+        out.append([int(start), int(stop)])
+    return out
+
+
+def _index_key(index, shape) -> tuple:
+    return tuple((int(sl.indices(int(d))[0]), int(sl.indices(int(d))[1]))
+                 for sl, d in zip(index, shape))
+
+
+class ShardedState:
+    """Named tree of (possibly sharded) arrays as a SnapshotStore
+    object with per-shard payloads.
+
+    ``SnapshotStore.save`` calls :meth:`shard_state` — one payload per
+    *unique* shard (replicas deduped by index) plus a JSON manifest
+    recording global shape/dtype, the PartitionSpec, and the mesh shape
+    it was saved under; every payload gets its own sha256 digest in the
+    snapshot meta.  ``restore`` calls :meth:`load_shard_state` under
+    whatever mesh is then live:
+
+    - layouts agree (same mesh shape, same spec) → **gather-free**: each
+      payload is placed directly on its device via
+      ``jax.make_array_from_single_device_arrays``;
+    - layouts differ (different mesh size, or a spec the new mesh can't
+      carry) → shards are assembled into the global array on host, then
+      ``jax.device_put`` with the target ``NamedSharding`` reshards.
+
+    Construct over a live ``tree`` (nested dicts / flat dict of arrays
+    or Tensors), or with ``getter``/``setter`` callables for state that
+    must be snapshotted/applied at save/restore time (the Executor's
+    device-resident state).  ``specs`` optionally pins restore
+    placement by name; default is the saved spec fitted to the current
+    mesh."""
+
+    def __init__(self, tree=None, *, getter: Optional[Callable] = None,
+                 setter: Optional[Callable] = None, specs=None,
+                 mesh: Optional[Mesh] = None, sep: str = SEP):
+        self.tree = tree
+        self._getter = getter
+        self._setter = setter
+        self._specs = specs
+        self._mesh = mesh
+        self._sep = sep
+
+    # -- save side ---------------------------------------------------------
+    def _current_tree(self):
+        return self._getter() if self._getter is not None else self.tree
+
+    def shard_state(self):
+        """-> (manifest dict, {fname: payload bytes})."""
+        from ..framework_io import dumps
+        items = named_tree_flatten(self._current_tree(), sep=self._sep)
+        manifest = {"version": 1, "sep": self._sep, "leaves": []}
+        payloads: Dict[str, bytes] = {}
+        for li, (name, leaf) in enumerate(items):
+            arr = _leaf_array(leaf)
+            if arr is None:
+                arr = np.asarray(leaf)
+            shape = tuple(int(d) for d in arr.shape)
+            entry = {"name": name, "shape": list(shape),
+                     "dtype": str(np.dtype(arr.dtype)),
+                     "spec": spec_to_json(PartitionSpec()),
+                     "mesh": {}, "shards": []}
+            shards = []
+            if isinstance(arr, jax.Array) and isinstance(
+                    getattr(arr, "sharding", None), NamedSharding):
+                entry["spec"] = spec_to_json(arr.sharding.spec)
+                entry["mesh"] = {str(k): int(v) for k, v in
+                                 arr.sharding.mesh.shape.items()}
+                seen = set()
+                for sh in arr.addressable_shards:
+                    key = _index_key(sh.index, shape)
+                    if key in seen:
+                        continue  # replicas: one payload per unique shard
+                    seen.add(key)
+                    shards.append((sh.index, np.asarray(sh.data)))
+            else:
+                full = (slice(None),) * len(shape)
+                shards.append((full, np.asarray(arr)))
+            for k, (index, data) in enumerate(shards):
+                fname = f"{li:04d}_{k:04d}.shard"
+                payloads[fname] = dumps({"data": data})
+                entry["shards"].append({
+                    "file": fname,
+                    "index": _shard_index_json(index, shape)})
+            manifest["leaves"].append(entry)
+        return manifest, payloads
+
+    # -- restore side ------------------------------------------------------
+    def _target_spec(self, name, saved_spec, shape, mesh):
+        if self._specs is not None:
+            sp = (self._specs(name) if callable(self._specs)
+                  else self._specs.get(name))
+            if sp is not None:
+                return _fit_spec_to_mesh(_as_spec(sp), shape, mesh, name)
+        return _fit_spec_to_mesh(saved_spec, shape, mesh, name)
+
+    def load_shard_state(self, manifest: dict, payloads: Dict[str, bytes]):
+        """Rebuild the tree on the CURRENT mesh and apply it (via
+        ``setter`` when given, else replacing ``self.tree``).  Payload
+        values may be raw bytes or already-decoded payload dicts (the
+        SnapshotStore decodes everything up front so a corrupt payload
+        can't part-load).  Returns the rebuilt tree."""
+        from ..framework_io import loads
+        from ..utils import monitor
+
+        def data_of(fname):
+            p = payloads[fname]
+            if isinstance(p, (bytes, bytearray)):
+                p = loads(bytes(p), source=fname)
+            return p["data"]
+
+        sep = manifest.get("sep", self._sep)
+        mesh = self._mesh or get_mesh()
+        items: List[Tuple[str, object]] = []
+        for entry in manifest["leaves"]:
+            name = entry["name"]
+            shape = tuple(int(d) for d in entry["shape"])
+            dtype = np.dtype(entry["dtype"])
+            saved_spec = spec_from_json(entry["spec"])
+            saved_mesh = {k: int(v) for k, v in entry["mesh"].items()}
+            shards = [(tuple(slice(a, b) for a, b in sh["index"]),
+                       data_of(sh["file"]))
+                      for sh in entry["shards"]]
+            if mesh is None:
+                items.append((name, _assemble(shape, dtype, shards)))
+                continue
+            target = self._target_spec(name, saved_spec, shape, mesh)
+            sharding = NamedSharding(mesh, target)
+            if (saved_mesh == {str(k): int(v)
+                               for k, v in mesh.shape.items()}
+                    and tuple(target) == tuple(saved_spec)
+                    and _gather_free_possible(sharding, shape, shards)):
+                arr = _place_gather_free(sharding, shape, dtype, shards)
+                monitor.stat_add("sharding.restore.gather_free")
+            else:
+                arr = jax.device_put(_assemble(shape, dtype, shards),
+                                     sharding)
+                monitor.stat_add("sharding.restore.resharded")
+            items.append((name, arr))
+        tree = named_tree_unflatten(items, sep=sep)
+        if self._setter is not None:
+            self._setter(tree)
+        else:
+            self.tree = tree
+        return tree
+
+
+def _assemble(shape, dtype, shards) -> np.ndarray:
+    if len(shards) == 1 and tuple(shards[0][1].shape) == tuple(shape):
+        return np.asarray(shards[0][1], dtype=dtype)
+    out = np.empty(shape, dtype)
+    for index, data in shards:
+        out[index] = data
+    return out
+
+
+def _gather_free_possible(sharding: NamedSharding, shape, shards) -> bool:
+    """Every device's required shard must exist among the saved unique
+    shards (it does whenever the layouts truly agree)."""
+    have = {_index_key(i, shape) for i, _ in shards}
+    try:
+        index_map = sharding.devices_indices_map(shape)
+    except Exception:  # pragma: no cover - jax internals moved
+        return False
+    return all(_index_key(idx, shape) in have
+               for idx in index_map.values())
+
+
+def _place_gather_free(sharding: NamedSharding, shape, dtype, shards):
+    by_key = {_index_key(i, shape): np.asarray(d, dtype=dtype)
+              for i, d in shards}
+    index_map = sharding.devices_indices_map(shape)
+    bufs = [jax.device_put(by_key[_index_key(idx, shape)], dev)
+            for dev, idx in index_map.items()]
+    return jax.make_array_from_single_device_arrays(
+        shape, sharding, bufs)

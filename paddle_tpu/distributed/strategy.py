@@ -173,6 +173,10 @@ class DistributedStrategy:
         self.hierarchical_allreduce = False  # topology handled by XLA
         self.elastic = False
         self.auto = False
+        # ordered (regex, PartitionSpec) partition rules for the GSPMD
+        # sharding engine (distributed/sharding.py); None = default
+        # policy (placements + ZeRO-3 dim-0 sharding, else replicated)
+        self.sharding_rules = None
         for name, cls in self._CONFIGS.items():
             object.__setattr__(self, "_" + name, cls())
 
@@ -196,7 +200,11 @@ class DistributedStrategy:
 
     # -- mesh inference ---------------------------------------------------
     def infer_mesh_shape(self, n_devices: int) -> Dict[str, int]:
-        """Derive the mesh {axis: size} this strategy implies."""
+        """Derive the mesh {axis: size} this strategy implies.
+
+        The model-parallel degrees must divide the device count exactly
+        — flooring ``dp`` would silently idle the remainder devices
+        (e.g. mp=3 on 8 chips would "work" on 6 and waste 2)."""
         from .mesh import DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS
         shape: Dict[str, int] = {}
         mp = (self.tensor_parallel_configs.tensor_parallel_degree
@@ -204,7 +212,19 @@ class DistributedStrategy:
         pp = (self.pipeline_configs.pp_degree if self.pipeline else 1)
         sp = (self.sequence_parallel_configs.sp_degree
               if self.sequence_parallel else 1)
-        dp = max(n_devices // (mp * pp * sp), 1)
+        model = mp * pp * sp
+        if n_devices % model != 0:
+            from ..core.enforce import InvalidArgumentError
+            degrees = (f"tensor_parallel_degree={mp} x pp_degree={pp} "
+                       f"x sp_degree={sp} = {model}")
+            raise InvalidArgumentError(
+                f"DistributedStrategy: the model-parallel degrees "
+                f"({degrees}) do not divide the device count "
+                f"({n_devices}) — {n_devices % model} device(s) would "
+                f"be silently dropped.  Pick degrees whose product "
+                f"divides {n_devices}, or run on "
+                f"{(n_devices // model) * model} devices.")
+        dp = max(n_devices // model, 1)
         if pp > 1:
             shape[PP_AXIS] = pp
         shape[DP_AXIS] = dp
@@ -220,10 +240,15 @@ class DistributedStrategy:
         return f"DistributedStrategy(enabled={on})"
 
 
-def validate_toggles(strategy: "DistributedStrategy") -> None:
+def validate_toggles(strategy: "DistributedStrategy",
+                     n_devices: Optional[int] = None) -> None:
     """Raise loudly on toggles this build deliberately re-architects away
     (VERDICT r3: silent no-op toggles are worse than missing).  Called by
-    both fleet.distributed_optimizer and the step constructors."""
+    both fleet.distributed_optimizer and the step constructors.  Pass
+    ``n_devices`` to also reject parallel degrees that do not divide the
+    device count (the check :meth:`infer_mesh_shape` enforces)."""
+    if n_devices is not None:
+        strategy.infer_mesh_shape(int(n_devices))  # raises on non-divisible
     if strategy.dgc:
         raise NotImplementedError(
             "strategy.dgc: deep gradient compression (dgc_optimizer.py, "

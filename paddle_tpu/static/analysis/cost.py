@@ -502,8 +502,8 @@ class ProgramReport:
     """Everything :func:`analyze` learned about one Program."""
 
     __slots__ = ("program_serial", "n_ops", "fetch_names", "per_op",
-                 "totals", "memory", "roofline", "fusion_candidates",
-                 "hazards", "batch_hint")
+                 "totals", "memory", "memory_per_shard", "roofline",
+                 "fusion_candidates", "hazards", "batch_hint")
 
     def to_dict(self) -> dict:
         return {
@@ -514,6 +514,8 @@ class ProgramReport:
             "per_op": [c.to_dict() for c in self.per_op],
             "totals": self.totals,
             "memory": self.memory.to_dict(),
+            "memory_per_shard": (None if self.memory_per_shard is None
+                                 else self.memory_per_shard.to_dict()),
             "roofline": self.roofline,
             "fusion_candidates": self.fusion_candidates,
             "hazards": [d.to_dict() for d in self.hazards],
@@ -544,6 +546,15 @@ class ProgramReport:
             f"(params {_fmt_bytes(m.param_bytes)}, slots "
             f"{_fmt_bytes(m.slot_bytes)}, grads {_fmt_bytes(m.grad_bytes)}, "
             f"activations {_fmt_bytes(m.retained_activation_bytes if m.training else m.activation_peak_bytes)})")
+        ms = self.memory_per_shard
+        if ms is not None:
+            lines.append(
+                f"  per-shard ({self.totals.get('mesh_devices', '?')} "
+                f"devices): peak {_fmt_bytes(ms.peak_bytes_donated)} "
+                f"donated / {_fmt_bytes(ms.peak_bytes_no_donation)} "
+                f"no-donation (params {_fmt_bytes(ms.param_bytes)}, "
+                f"slots {_fmt_bytes(ms.slot_bytes)}, grads "
+                f"{_fmt_bytes(ms.grad_bytes)})")
         if self.roofline:
             lines.append("  roofline (predicted):")
             for name, r in self.roofline.items():
@@ -591,7 +602,8 @@ def analyze(program: Program, fetch_list: Optional[Sequence] = None,
             feed_shapes: Optional[Dict[str, Sequence[int]]] = None,
             batch_size: Optional[int] = None,
             chip: Optional[str] = None, top_k: Optional[int] = 5,
-            include_hazards: bool = True) -> ProgramReport:
+            include_hazards: bool = True,
+            sharding=None) -> ProgramReport:
     """Quantitative analysis of one recorded Program.
 
     ``fetch_list`` (Variables or names) roots the liveness analysis;
@@ -600,7 +612,11 @@ def analyze(program: Program, fetch_list: Optional[Sequence] = None,
     and re-derives all avals; ``feed_shapes`` overrides specific feeds
     exactly.  ``chip`` selects one roofline spec from
     :data:`CHIP_SPECS` (default: the whole table).  ``top_k`` bounds
-    the ranked fusion candidates (0 = none, None = all)."""
+    the ranked fusion candidates (0 = none, None = all).  ``sharding``
+    (a :class:`~paddle_tpu.distributed.sharding.ShardingPlan`) adds
+    ``memory_per_shard``: each tensor's bytes divided by the mesh-axis
+    sizes its PartitionSpec shards over — the report then prices the
+    program per-chip, not per-fleet."""
     graph = DefUseGraph(program)
 
     shapes = dict(feed_shapes or {})
@@ -624,6 +640,35 @@ def analyze(program: Program, fetch_list: Optional[Sequence] = None,
 
     costs = _node_costs(graph, avals)
     memory = estimate_memory(graph, fetch_vars, avals)
+    memory_per_shard = None
+    if sharding is not None:
+        # per-shard accounting: params (and their grads + slots) divide
+        # by their spec's axis-size product, activations/feeds by the
+        # batch-axis product — but only when the plan actually shards
+        # every feed (a non-divisible feed replicates: each chip holds
+        # the FULL array, so dividing would underreport per-chip peak)
+        seen_p: Dict[int, int] = {}
+        all_params = graph.program.parameters()
+        spec_of = dict(zip(sharding.param_names, sharding.param_specs))
+        for pos, p in enumerate(all_params):
+            spec = spec_of.get(p.name)
+            if spec is None and pos < len(sharding.param_specs):
+                spec = sharding.param_specs[pos]
+            seen_p[id(p)] = sharding.divisor(spec) if spec is not None \
+                else 1
+
+        from ...distributed.sharding import spec_axes
+
+        def _feed_shape(v):
+            a = avals.get(id(v), v.data)
+            return tuple(a.shape)
+
+        feeds_sharded = all(
+            len(spec_axes(sharding.feed_spec(_feed_shape(v)))) > 0
+            for v in graph.feeds.values()) if graph.feeds else True
+        memory_per_shard = estimate_memory(
+            graph, fetch_vars, avals, param_div=seen_p,
+            act_div=sharding.batch_divisor() if feeds_sharded else 1)
 
     flops_fwd = sum(c.flops for c in costs)
     unmodeled = [c for c in costs if not c.modeled]
@@ -692,7 +737,10 @@ def analyze(program: Program, fetch_list: Optional[Sequence] = None,
     rep.fetch_names = fetch_names
     rep.batch_hint = batch_size
     rep.per_op = costs
+    rep.memory_per_shard = memory_per_shard
     rep.totals = {
+        **({"mesh_devices": sharding.n_devices}
+           if sharding is not None else {}),
         "flops_fwd": flops_fwd,
         "flops_train": flops_train,
         "optimizer_flops": opt_flops if training else 0,
@@ -716,21 +764,23 @@ def analyze(program: Program, fetch_list: Optional[Sequence] = None,
     return rep
 
 
-def compile_summary(program: Program, donate: bool = True
-                    ) -> Optional[dict]:
+def compile_summary(program: Program, donate: bool = True,
+                    sharding=None) -> Optional[dict]:
     """The light, always-on slice the Executor records per compile:
     predicted FLOPs per step + peak bytes from the recorded avals (no
-    re-derivation, no hazard passes).  Returns None instead of raising
-    — a cost-model gap must never break a compile."""
+    re-derivation, no hazard passes).  With a ``sharding`` plan the
+    summary also carries ``peak_bytes_per_shard`` — what one chip
+    actually holds.  Returns None instead of raising — a cost-model
+    gap must never break a compile."""
     try:
         rep = analyze(program, include_hazards=False, chip="cpu",
-                      top_k=0)
+                      top_k=0, sharding=sharding)
     except Exception:  # noqa: BLE001 - prediction is best-effort
         return None
     t = rep.totals
     peak = (rep.memory.peak_bytes_donated if donate
             else rep.memory.peak_bytes_no_donation)
-    return {
+    out = {
         "flops": (t["flops_train"] if t["flops_train"] is not None
                   else t["flops_fwd"]),
         "flops_fwd": t["flops_fwd"],
@@ -739,3 +789,10 @@ def compile_summary(program: Program, donate: bool = True
         "unmodeled_ops": t["unmodeled"]["count"],
         "unmodeled_bytes": t["unmodeled"]["bytes"],
     }
+    if rep.memory_per_shard is not None:
+        ms = rep.memory_per_shard
+        out["peak_bytes_per_shard"] = (
+            ms.peak_bytes_donated if donate
+            else ms.peak_bytes_no_donation)
+        out["mesh_devices"] = t.get("mesh_devices")
+    return out

@@ -74,17 +74,30 @@ def _slot_bytes(opt, trainable) -> Optional[int]:
     """Optimizer slot bytes via an abstract ``functional_init`` trace
     (jax.eval_shape allocates nothing); None when the optimizer cannot
     be traced abstractly."""
+    per = _slot_bytes_list(opt, trainable)
+    return None if per is None else sum(per)
+
+
+def _slot_bytes_list(opt, trainable) -> Optional[List[int]]:
+    """Per-trainable-param slot bytes (the functional state is a
+    per-param list of slot dicts); None when untraceable."""
     import jax
 
     if opt is None or not trainable:
-        return 0
+        return []
     try:
         avals = [jax.ShapeDtypeStruct(tuple(param_array(p).shape),
                                       np.dtype(param_array(p).dtype))
                  for p in trainable]
         state = jax.eval_shape(opt.functional_init, avals)
-        return sum(aval_bytes(leaf)
-                   for leaf in jax.tree_util.tree_leaves(state))
+        if isinstance(state, (list, tuple)) and len(state) == len(trainable):
+            return [sum(aval_bytes(leaf)
+                        for leaf in jax.tree_util.tree_leaves(s))
+                    for s in state]
+        total = sum(aval_bytes(leaf)
+                    for leaf in jax.tree_util.tree_leaves(state))
+        # unknown structure: charge everything to the first param
+        return [total] + [0] * (len(trainable) - 1)
     except Exception:  # noqa: BLE001 - estimation must not raise
         return None
 
@@ -109,18 +122,31 @@ class MemoryEstimate:
 
 def estimate_memory(graph: DefUseGraph,
                     fetch_vars: Sequence[Variable] = (),
-                    avals: Optional[Dict[int, object]] = None
-                    ) -> MemoryEstimate:
+                    avals: Optional[Dict[int, object]] = None,
+                    param_div: Optional[Dict[int, int]] = None,
+                    act_div: int = 1) -> MemoryEstimate:
     """Interval liveness over the recorded (topologically ordered) op
     list.  ``avals`` optionally overrides recorded abstract values
     (id(var) -> aval), e.g. after re-deriving with a concrete batch
-    size; ``fetch_vars`` stay live to the end of the program."""
+    size; ``fetch_vars`` stay live to the end of the program.
+
+    ``param_div`` (``id(param) -> n``) and ``act_div`` switch the
+    estimate to *per-shard* accounting for a GSPMD-sharded program:
+    each parameter's bytes (and its gradient and optimizer slots —
+    they inherit the param's PartitionSpec) are divided by the product
+    of the mesh-axis sizes its spec shards over, and activation/feed
+    bytes by the batch-axis product.  Divisions round up — a per-shard
+    report never undercounts the ragged last shard."""
     avals = avals or {}
     nodes = graph.nodes
     n = len(nodes)
+    param_div = param_div or {}
+
+    def _ceil_div(b: int, d: int) -> int:
+        return -(-int(b) // max(int(d), 1))
 
     def bytes_of(v: Variable) -> int:
-        return aval_bytes(avals.get(id(v), v.data))
+        return _ceil_div(aval_bytes(avals.get(id(v), v.data)), act_div)
 
     fetched = {id(v) for v in fetch_vars}
 
@@ -180,22 +206,27 @@ def estimate_memory(graph: DefUseGraph,
             if id(p) not in seen:
                 seen.add(id(p))
                 params.append(p)
-    est.param_bytes = sum(param_array(p).size
-                          * np.dtype(param_array(p).dtype).itemsize
-                          for p in params)
+    def p_bytes(p) -> int:
+        raw = (param_array(p).size
+               * np.dtype(param_array(p).dtype).itemsize)
+        return _ceil_div(raw, param_div.get(id(p), 1))
+
+    est.param_bytes = sum(p_bytes(p) for p in params)
 
     opt, trainable = _opt_unpack(graph.program)
     est.training = opt is not None
-    est.trainable_param_bytes = sum(
-        param_array(p).size * np.dtype(param_array(p).dtype).itemsize
-        for p in trainable)
+    est.trainable_param_bytes = sum(p_bytes(p) for p in trainable)
     est.grad_bytes = est.trainable_param_bytes if est.training else 0
-    slots = _slot_bytes(opt, trainable)
-    if slots is None:  # untraceable optimizer: assume Adam-like 2 slots
+    slots_list = _slot_bytes_list(opt, trainable)
+    if slots_list is None:  # untraceable optimizer: assume Adam-like 2 slots
         est.slot_bytes = 2 * est.trainable_param_bytes
         est.slots_estimated = True
     else:
-        est.slot_bytes = slots
+        # slots inherit their param's PartitionSpec (same shape), so
+        # the param's divisor prices them per-shard too
+        est.slot_bytes = sum(
+            _ceil_div(b, param_div.get(id(p), 1))
+            for b, p in zip(slots_list, trainable))
         est.slots_estimated = False
 
     if est.training:

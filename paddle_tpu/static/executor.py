@@ -35,6 +35,7 @@ lazy ``Parameter.data`` resolution above).
 """
 from __future__ import annotations
 
+import threading
 import weakref
 from typing import Dict, List, Optional, Sequence
 
@@ -226,6 +227,40 @@ class _ExecState:
         return out
 
 
+# serializes first-call compiles of sharded executables: the config
+# flip below is process-global, so concurrent flips could restore the
+# flag mid-compile of the other thread and let a sharded executable
+# reach the poisoned persistent cache after all
+_CACHE_FLIP_LOCK = threading.Lock()
+
+
+def _no_persistent_cache_first_call(jitted):
+    """jaxlib 0.4.37's persistent compilation cache corrupts the heap
+    when it RELOADS an executable that was compiled with explicit
+    NamedShardings (repro: two processes running the same sharded
+    program with jax_compilation_cache_dir set — the second dies with
+    'corrupted double-linked list').  Sharded executables therefore
+    compile with the persistent cache disabled: only the first call
+    (the one that compiles, and would otherwise serialize/deserialize)
+    pays the config flip + lock; steady-state dispatch is untouched."""
+    warmed = []
+
+    def compiled(*args):
+        if warmed:
+            return jitted(*args)
+        with _CACHE_FLIP_LOCK:
+            prev = jax.config.jax_enable_compilation_cache
+            jax.config.update("jax_enable_compilation_cache", False)
+            try:
+                out = jitted(*args)
+            finally:
+                jax.config.update("jax_enable_compilation_cache", prev)
+            warmed.append(True)
+        return out
+
+    return compiled
+
+
 class Executor:
     """reference: fluid/executor.py:916.  ``place`` is accepted for parity;
     XLA owns device placement."""
@@ -244,6 +279,10 @@ class Executor:
         # should call close() between trials).
         self._states: Dict[int, _ExecState] = {}
         self._run_counts: Dict[int, int] = {}
+        # GSPMD sharding plans per program serial (fleet-marked
+        # optimizers / explicit program rules); revalidated against the
+        # live mesh + strategy identity each run — O(1) steady state
+        self._plans: Dict[int, tuple] = {}
         self._verified: set = set()  # (serial, version) already checked
         self._tracked: set = set()   # serials with a finalizer attached
         # legacy (pre-change) path bookkeeping — see _run_legacy
@@ -273,13 +312,15 @@ class Executor:
         self._tracked.add(serial)
         # the closure references the containers, NOT self: the finalizer
         # must not keep the Executor alive
-        states, opt, runs, ver = (self._states, self._opt_states,
-                                  self._run_counts, self._verified)
+        states, opt, runs, ver, plans = (
+            self._states, self._opt_states, self._run_counts,
+            self._verified, self._plans)
 
         def _evict():
             states.pop(serial, None)
             opt.pop(serial, None)
             runs.pop(serial, None)
+            plans.pop(serial, None)
             for k in [k for k in ver if k[0] == serial]:
                 ver.discard(k)
 
@@ -300,6 +341,198 @@ class Executor:
         self._opt_states.clear()
         self._run_counts.clear()
         self._verified.clear()
+        self._plans.clear()
+
+    # -- sharding ----------------------------------------------------------
+    def _plan_for(self, program, params):
+        """ShardingPlan for this program, or None.  A plan exists when
+        the attached optimizer went through fleet.distributed_optimizer
+        (it carries the DistributedStrategy) or the program carries
+        explicit ``_sharding_rules``; the mesh is the global one (fleet
+        .init derives it from the strategy).  Cached per serial and
+        revalidated against (version, mesh, strategy, rules) identity."""
+        pack = program._optimizer
+        opt = pack[0] if pack is not None else None
+        strategy = getattr(opt, "_dist_strategy", None) \
+            if opt is not None else None
+        rules = getattr(program, "_sharding_rules", None)
+        if strategy is None and rules is None:
+            return None
+        from ..distributed.mesh import get_mesh, init_mesh
+        mesh = get_mesh()
+        if mesh is None:
+            if strategy is None:
+                return None
+            mesh = init_mesh(
+                strategy.infer_mesh_shape(len(jax.devices())))
+        cached = self._plans.get(program._serial)
+        if cached is not None:
+            ver, cmesh, cstrat, crules, plan = cached
+            if (ver == program._version and cmesh is mesh
+                    and cstrat is strategy and crules is rules):
+                return plan
+        from ..distributed import sharding as _sh
+        plan = _sh.plan_for_params(
+            [(p.name, p) for p in params], strategy=strategy, mesh=mesh,
+            rules=rules, label=f"program#{program._serial}")
+        self._plans[program._serial] = (program._version, mesh, strategy,
+                                        rules, plan)
+        return plan
+
+    def sharded_state(self, program=None):
+        """The program's live execution state (params + optimizer slots
+        + step counters) as a :class:`~paddle_tpu.distributed.sharding.
+        ShardedState` — registrable with ``SnapshotStore`` for
+        per-shard, digest-verified, *reshardable* checkpoints.  Save
+        under one mesh, restore under another: the adapter reshards on
+        load (gather-free when the layouts agree), writes arrays back
+        into the donated state when it is live, and stages them on the
+        Parameters / optimizer otherwise (a fresh process restores
+        before its first compile)."""
+        from ..distributed.sharding import ShardedState
+        if program is None:
+            program = default_main_program()
+
+        # params are keyed by their POSITION in program.parameters()
+        # (zero-padded so the tree round-trips in order) — the identity
+        # the optimizer's pending-slot protocol already uses.  Names
+        # from `unique_name` drift when the same model code is rebuilt
+        # in one process (counters keep counting), while positions are
+        # stable for an identical rebuild; restore validates shapes so
+        # a structurally different program can't silently misbind.
+        def _key(i):
+            return f"{i:04d}"
+
+        def getter():
+            from .analysis.liveness import param_array
+            params = program.parameters()
+            state = self._states.get(program._serial)
+            out = {"params": {}, "slots": {}, "aux": {}}
+            if state is not None and state.version == program._version:
+                for i, a in enumerate(state.p_arrays):
+                    out["params"][_key(i)] = a
+                if state.opt_state is not None:
+                    for pos, i in enumerate(state.t_idx):
+                        slots = state.opt_state[pos]
+                        if slots:
+                            out["slots"][_key(i)] = dict(slots)
+                else:
+                    # set_state_dict nulled the live opt_state and
+                    # staged the checkpoint's slots on the optimizer —
+                    # a save between that and the next run must still
+                    # carry them
+                    pack = program._optimizer
+                    pending = (getattr(pack[0], "_static_pending_slots",
+                                       None) if pack is not None
+                               else None)
+                    for k, sl in (pending or {}).items():
+                        out["slots"][_key(int(k))] = {
+                            sk: np.asarray(v) for sk, v in sl.items()}
+                if state.aux is not None:
+                    out["aux"] = {
+                        "run": np.asarray(state.aux["run"]),
+                        "step": np.asarray(state.aux["step"])}
+            else:
+                for i, p in enumerate(params):
+                    out["params"][_key(i)] = param_array(p)
+                pack = program._optimizer
+                if pack is not None:
+                    # slots a restore staged before the first compile
+                    # (setter below) must survive a save from this
+                    # not-yet-live state — dropping them would silently
+                    # reset Adam moments on the next restore
+                    pending = getattr(pack[0], "_static_pending_slots",
+                                      None)
+                    for k, sl in (pending or {}).items():
+                        out["slots"][_key(int(k))] = {
+                            sk: np.asarray(v) for sk, v in sl.items()}
+                    out["aux"] = {"run": np.asarray(
+                        self._run_counts.get(program._serial, 0),
+                        np.int32),
+                        "step": np.asarray(pack[0]._step_count,
+                                           np.int32)}
+            return {k: v for k, v in out.items() if v}
+
+        def setter(tree):
+            params = program.parameters()
+            ptree = tree.get("params", {})
+            slots = tree.get("slots", {})
+            aux = tree.get("aux", {})
+            pack = program._optimizer
+            opt = pack[0] if pack is not None else None
+            for k, arr in ptree.items():
+                i = int(k)
+                if i >= len(params):
+                    raise ValueError(
+                        f"sharded checkpoint restore: saved param slot "
+                        f"{i} but the program has {len(params)} params "
+                        f"— the model structure changed since save")
+                want = tuple(params[i].shape_tuple)
+                got = tuple(arr.shape)
+                if want != got:
+                    raise ValueError(
+                        f"sharded checkpoint restore: param {i} "
+                        f"('{params[i].name}') has shape {want} but the "
+                        f"snapshot holds {got} — the model structure "
+                        f"changed since save")
+            state = self._states.get(program._serial)
+            if state is not None and state.version == program._version:
+                for k, arr in ptree.items():
+                    i = int(k)
+                    state.p_arrays[i] = jnp.asarray(arr)
+                    state.escaped.discard(i)
+                if slots:
+                    if state.opt_state is not None:
+                        for pos, i in enumerate(state.t_idx):
+                            if _key(i) in slots:
+                                state.opt_state[pos] = {
+                                    k: jnp.asarray(v)
+                                    for k, v in slots[_key(i)].items()}
+                    elif opt is not None:
+                        # live state whose opt_state a set_state_dict
+                        # nulled: stage the restored slots so the next
+                        # run's functional_init reload picks them up
+                        # instead of the stale pre-restore pending ones
+                        opt._static_pending_slots = {
+                            str(int(k)): {sk: np.asarray(v)
+                                          for sk, v in sl.items()}
+                            for k, sl in slots.items()}
+                if aux and state.aux is not None:
+                    step = int(np.asarray(aux["step"]))
+                    run = int(np.asarray(aux.get(
+                        "run", state.aux["run"])))
+                    state.aux = {"run": jnp.asarray(run, jnp.int32),
+                                 "step": jnp.asarray(step, jnp.int32)}
+                    self._run_counts[program._serial] = run
+                    if opt is not None:
+                        opt._step_count = step
+                        state.synced_step = step
+            else:
+                for k, arr in ptree.items():
+                    params[int(k)].data = arr
+                if opt is not None and slots:
+                    opt._static_pending_slots = {
+                        str(int(k)): {sk: np.asarray(v)
+                                      for sk, v in sl.items()}
+                        for k, sl in slots.items()}
+                if opt is not None and aux:
+                    opt._step_count = int(np.asarray(aux["step"]))
+                    self._run_counts[program._serial] = int(
+                        np.asarray(aux.get("run", 0)))
+
+        def specs(name):
+            parts = name.split("/")
+            if parts[0] not in ("params", "slots") or len(parts) < 2:
+                return None
+            plan = self._plan_for(program, program.parameters())
+            if plan is None:
+                return None
+            try:
+                return plan.param_spec(int(parts[1]))
+            except (ValueError, IndexError):
+                return None
+
+        return ShardedState(getter=getter, setter=setter, specs=specs)
 
     # -- feeds -------------------------------------------------------------
     def _feed_array(self, a):
@@ -392,9 +625,11 @@ class Executor:
         if trc is not None:
             trc.set_step(run_i)
 
+        plan = self._plan_for(program, params)
         key = (program._serial, program._version, feed_names,
                tuple((a.shape, str(a.dtype)) for a in feed_arrays),
-               tuple(fetch_names), program._optimizer is not None, donate)
+               tuple(fetch_names), program._optimizer is not None, donate,
+               None if plan is None else plan.fingerprint())
         compiled = self._cache.get(key)
         if compiled is None:
             # recompile for a NEW version: executables for older
@@ -411,8 +646,17 @@ class Executor:
                     program.verify(fetch_list=fetch_list)
                     self._verified.add(vkey)
             compiled = self._build(program, params, feed_names, fetch_names,
-                                   donate)
+                                   donate, plan=plan,
+                                   feed_arrays=feed_arrays)
             self._cache[key] = compiled
+            if plan is not None:
+                # replacing the mesh while this executable lives would
+                # silently keep the old placement — register the hold
+                from ..distributed.mesh import register_mesh_user
+                register_mesh_user(
+                    compiled, plan.mesh,
+                    f"Executor program#{program._serial} "
+                    f"(mesh {dict(plan.mesh.shape)})")
             self._compile_count += 1
             # static cost model: predicted FLOPs / peak bytes ride the
             # attribution record (and monitor gauges) so
@@ -421,7 +665,8 @@ class Executor:
             # Best-effort by contract: compile_summary returns None
             # rather than raising on a cost-model gap.
             from .analysis.cost import compile_summary
-            predicted = compile_summary(program, donate=donate)
+            predicted = compile_summary(program, donate=donate,
+                                        sharding=plan)
             if predicted is not None:
                 from ..utils import monitor
                 monitor.stat_set("predicted.executor.flops",
@@ -433,6 +678,8 @@ class Executor:
             from ..observability import record_compile
             record_compile("executor", program._serial, {
                 "program_version": program._version,
+                "sharding": (None if plan is None
+                             else plan.fingerprint()),
                 "feed_signature": tuple(
                     (tuple(a.shape), str(a.dtype)) for a in feed_arrays),
                 "feed_names": feed_names,
@@ -515,8 +762,38 @@ class Executor:
         return [Tensor(f) for f in fetches]
 
     # -- compilation -------------------------------------------------------
+    def _shardings(self, plan, params, t_idx, opt, feed_arrays,
+                   fetch_names):
+        """(in, out) sharding pytrees of the compiled train step under a
+        plan: params/slots by their PartitionSpec, batch feeds over the
+        data axes, counters/lr/key replicated, fetches replicated (they
+        are leaving for the host anyway)."""
+        from ..distributed.sharding import specs_for_state
+        from .analysis.liveness import param_array
+        rep = plan.replicated()
+        p_sh = [plan.param_sharding(i) for i in range(len(params))]
+        feed_sh = [plan.feed_sharding(a.shape) for a in feed_arrays]
+        fetch_sh = [rep] * len(fetch_names)
+        s_sh = rep  # pytree prefix: replicate all slots (fallback)
+        if opt is not None:
+            try:
+                avals = [jax.ShapeDtypeStruct(
+                    tuple(param_array(params[i]).shape),
+                    np.dtype(param_array(params[i]).dtype))
+                    for i in t_idx]
+                state_shape = jax.eval_shape(opt.functional_init, avals)
+                s_specs = specs_for_state(
+                    [plan.param_spec(i) for i in t_idx], state_shape,
+                    param_shapes=[a.shape for a in avals])
+                s_sh = [{k: plan._ns(v) for k, v in e.items()}
+                        for e in s_specs]
+            except Exception:  # noqa: BLE001 - fall back to replicated
+                pass
+        aux_sh = {"run": rep, "step": rep}
+        return (p_sh, s_sh, aux_sh, rep, feed_sh, fetch_sh)
+
     def _build(self, program: Program, params, feed_names, fetch_names,
-               donate):
+               donate, plan=None, feed_arrays=()):
         nodes = list(program.nodes)
         opt_pack = program._optimizer
 
@@ -530,13 +807,25 @@ class Executor:
         from ..core import rng as _rng
 
         if opt_pack is None:
-            @jax.jit
             def run_fn(p_arrays, rng_key, *feed_arrays):
                 # random ops (dropout) draw from the per-run key
                 with _rng.seed_scope(rng_key):
                     env = forward_env(p_arrays, feed_arrays)
                 return [env[n] for n in fetch_names]
-            return run_fn
+
+            if plan is None:
+                jitted = jax.jit(run_fn)
+
+                def compiled(*args):
+                    return jitted(*args)
+
+                return compiled
+            p_sh, _, _, rep, feed_sh, fetch_sh = self._shardings(
+                plan, params, [], None, feed_arrays, fetch_names)
+            jitted = jax.jit(run_fn,
+                             in_shardings=(p_sh, rep, *feed_sh),
+                             out_shardings=fetch_sh)
+            return _no_persistent_cache_first_call(jitted)
 
         opt, loss_var, param_filter, no_grad_set = (opt_pack + (None,
                                                                 None))[:4]
@@ -588,11 +877,26 @@ class Executor:
         # donate params, optimizer slots and the aux carry — NOT lr /
         # base_key / seed args (cached and reused across runs) and NOT
         # the feeds (users legitimately feed the same arrays every step)
-        jitted = (jax.jit(train_fn, donate_argnums=(0, 1, 2)) if donate
-                  else jax.jit(train_fn))
+        jit_kw = dict(donate_argnums=(0, 1, 2)) if donate else {}
+        if plan is not None:
+            # GSPMD lowering: the donated state carries explicit
+            # in/out shardings over the plan's mesh — outputs come back
+            # with the same placement as the inputs, so the state is
+            # layout-stable run to run (no per-step resharding) and the
+            # dp gradient psum / ZeRO collectives fall out of the
+            # compiler
+            p_sh, s_sh, aux_sh, rep, feed_sh, fetch_sh = self._shardings(
+                plan, params, t_idx, opt, feed_arrays, fetch_names)
+            jit_kw["in_shardings"] = (p_sh, s_sh, aux_sh, rep, rep, rep,
+                                      rep, *feed_sh)
+            jit_kw["out_shardings"] = (fetch_sh, p_sh, s_sh, aux_sh)
+        jitted = jax.jit(train_fn, **jit_kw)
 
-        def compiled(*args):
-            return jitted(*args)
+        if plan is not None:
+            compiled = _no_persistent_cache_first_call(jitted)
+        else:
+            def compiled(*args):
+                return jitted(*args)
 
         compiled._t_idx = t_idx
         return compiled

@@ -224,7 +224,7 @@ class Program:
                        raise_on_error=raise_on_error)
 
     def analyze(self, fetch_list=None, feed_shapes=None, batch_size=None,
-                chip=None, top_k=5):
+                chip=None, top_k=5, sharding=None):
         """Quantitative static analysis (static/analysis/cost.py):
         per-op FLOPs and byte volumes with an explicit ``unmodeled``
         bucket, donation-aware peak-memory bounds, a roofline summary
@@ -235,11 +235,13 @@ class Program:
         exactly.  Returns a :class:`ProgramReport` (``.render()`` for
         text, ``.to_dict()``/``.to_json()`` for machines).  Enable
         ``FLAGS_static_anchors`` before building the program for
-        ``file:line`` anchors in the report."""
+        ``file:line`` anchors in the report.  ``sharding`` (a
+        ``distributed.sharding.ShardingPlan``) adds per-shard memory
+        accounting — peak bytes per chip, not per fleet."""
         from .analysis import analyze as _analyze
         return _analyze(self, fetch_list=fetch_list,
                         feed_shapes=feed_shapes, batch_size=batch_size,
-                        chip=chip, top_k=top_k)
+                        chip=chip, top_k=top_k, sharding=sharding)
 
     # -- introspection -----------------------------------------------------
     def parameters(self) -> List[Parameter]:

@@ -556,3 +556,172 @@ def generation_main(requests=18, clients=3, verbose=False):
           "identical to serial admission, mid-generation deadline "
           "evicted cleanly, page pool fully reclaimed")
     return 0
+
+
+# ---------------------------------------------------------------------------
+# Reshard chaos (ISSUE 8): kill mid-run, restore onto a DIFFERENT mesh
+# ---------------------------------------------------------------------------
+
+def _reshard_build(lr=0.05):
+    """One fleet-sharded static training program (the 'unchanged user
+    code' both mesh sizes run)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import distributed as dist, optimizer
+
+    paddle.seed(1234)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, D], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        pred = paddle.static.nn.fc(x, 8)
+        pred = paddle.static.nn.fc(F.relu(pred), 1)
+        loss = F.mse_loss(pred, y)
+        f = dist.fleet
+        f.init(is_collective=True, strategy=dist.DistributedStrategy())
+        opt = f.distributed_optimizer(optimizer.Adam(learning_rate=lr))
+        opt.minimize(loss)
+    return main, loss, paddle.static.Executor()
+
+
+def reshard_main(steps=12, save_every=4, kill_after=6, verbose=False,
+                 workdir=None):
+    """Mid-run mesh-size change via sharded checkpoint restore.
+
+    Reference run: the training program on mesh ``{dp: 8}``,
+    uninterrupted, recording the per-step loss trajectory.  Chaos run:
+    same program, sharded SnapshotStore saves every ``save_every``
+    steps, a fault injected at ``executor.run`` kills step
+    ``kill_after`` — then the program is REBUILT on mesh ``{dp: 2}``,
+    restored from the (digest-verified, per-shard) snapshot, resharded
+    onto the smaller mesh, and trained to completion.  Gates:
+
+    - the restore itself is bitwise (gathered params == params at the
+      save point on the old mesh);
+    - the post-restore loss trajectory matches the uninterrupted run's
+      same steps (rtol 1e-5 — reduction order differs across dp
+      degrees);
+    - the injected kill actually fired (the run was really interrupted).
+    """
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.testing import fault
+    from paddle_tpu.utils.checkpoint import SnapshotStore
+
+    import jax
+    if len(jax.devices()) < 8:
+        print("FAIL: reshard scenario needs 8 devices "
+              "(XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+              file=sys.stderr)
+        return 1
+
+    own_tmp = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_reshard_")
+    rng = np.random.RandomState(7)
+    xs = rng.standard_normal((64, D)).astype(np.float32)
+    ys = xs @ rng.standard_normal((D, 1)).astype(np.float32)
+    feed = {"x": xs, "y": ys}
+
+    was_static = paddle.in_static_mode() \
+        if hasattr(paddle, "in_static_mode") else False
+    paddle.enable_static()
+    try:
+        # -- reference: uninterrupted on mesh {dp: 8} ----------------------
+        init_mesh({"dp": 8})
+        main, loss, exe = _reshard_build()
+        init_mesh({"dp": 8})
+        ref_losses = [float(exe.run(main, feed=feed,
+                                    fetch_list=[loss])[0])
+                      for _ in range(steps)]
+        exe.close()
+        paddle.static.reset_default_programs()
+        if verbose:
+            print(f"reference (mesh dp=8): {ref_losses}")
+
+        # -- chaos: save every N, injected kill, reshard to {dp: 2} --------
+        store = SnapshotStore(f"{workdir}/ckpt")
+        init_mesh({"dp": 8})
+        main, loss, exe = _reshard_build()
+        init_mesh({"dp": 8})
+        saved_at = -1
+        saved_params = None
+        killed = False
+        fault.arm(f"executor.run:count=1,after={kill_after}")
+        try:
+            for step in range(steps):
+                try:
+                    exe.run(main, feed=feed, fetch_list=[loss])
+                except fault.FaultInjected:
+                    killed = True
+                    break
+                if (step + 1) % save_every == 0:
+                    store.save(step, {"train": exe.sharded_state(main)})
+                    saved_at = step
+                    saved_params = {
+                        k: np.asarray(v).copy() for k, v in
+                        exe.sharded_state(main)._getter()
+                        ["params"].items()}
+        finally:
+            fault.disarm()
+        exe.close()
+        paddle.static.reset_default_programs()
+        if not killed:
+            print("FAIL: injected executor.run fault never fired",
+                  file=sys.stderr)
+            return 1
+        if saved_at < 0:
+            print("FAIL: kill arrived before the first snapshot "
+                  "(raise kill_after or lower save_every)",
+                  file=sys.stderr)
+            return 1
+        if verbose:
+            print(f"killed at step {kill_after}, last snapshot at "
+                  f"step {saved_at}")
+
+        init_mesh({"dp": 2})  # the replacement pod is a different size
+        main2, loss2, exe2 = _reshard_build()
+        init_mesh({"dp": 2})
+        ss = exe2.sharded_state(main2)
+        store.restore({"train": ss})
+        restored = {k: np.asarray(v) for k, v in
+                    ss._getter()["params"].items()}
+        problems = []
+        for k in saved_params:
+            if not np.array_equal(restored[k], saved_params[k]):
+                problems.append(
+                    f"restored param {k} not bitwise-identical across "
+                    f"the mesh-8 -> mesh-2 reshard")
+        cont = [float(exe2.run(main2, feed=feed,
+                               fetch_list=[loss2])[0])
+                for _ in range(steps - saved_at - 1)]
+        exe2.close()
+        paddle.static.reset_default_programs()
+        if verbose:
+            print(f"resumed (mesh dp=2):  {cont}")
+
+        expect = ref_losses[saved_at + 1:]
+        try:
+            np.testing.assert_allclose(cont, expect, rtol=1e-5)
+        except AssertionError as e:
+            problems.append(
+                f"post-restore loss trajectory diverged from the "
+                f"uninterrupted run: {e}")
+        if problems:
+            for p in problems:
+                print(f"FAIL: {p}", file=sys.stderr)
+            return 1
+        print("chaos reshard OK: killed mid-run on mesh dp=8, restored "
+              f"the step-{saved_at} sharded snapshot onto mesh dp=2 "
+              "(bitwise params), loss trajectory matches the "
+              "uninterrupted run")
+        return 0
+    finally:
+        if not was_static:
+            paddle.disable_static()
+        import paddle_tpu.static as _st
+        _st.reset_default_programs()
+        if own_tmp:
+            shutil.rmtree(workdir, ignore_errors=True)

@@ -127,6 +127,20 @@ class SnapshotStore:
         self._fs.mkdir(sdir)
         digests = {}
         for name, obj in objects.items():
+            if hasattr(obj, "shard_state"):
+                # sharded protocol (distributed/sharding.ShardedState):
+                # one payload per unique shard + a manifest naming them
+                # — every file gets its own digest, so a single corrupt
+                # shard is caught without touching the others
+                manifest, payloads = obj.shard_state()
+                files = {f"{name}.manifest.json": json.dumps(
+                    manifest).encode("utf-8")}
+                for fname, data in payloads.items():
+                    files[f"{name}.{fname}"] = data
+                for fname, data in files.items():
+                    digests[fname] = hashlib.sha256(data).hexdigest()
+                    _fsmod.write_atomic(f"{sdir}/{fname}", data)
+                continue
             payload = _dumps(obj.state_dict())
             digests[f"{name}.pdparams"] = hashlib.sha256(
                 payload).hexdigest()
@@ -155,35 +169,73 @@ class SnapshotStore:
                     pass  # prune is best-effort (shared dirs, perms)
 
     # -- restore -----------------------------------------------------------
+    def _read_file_verified(self, snap: dict, fname: str,
+                            digests: Optional[dict]) -> Optional[bytes]:
+        path = self._join(snap["dir"], fname)
+        try:
+            with self._fs.open_read(path) as f:
+                payload = f.read()
+        except (OSError, RuntimeError) as e:
+            warnings.warn(f"checkpoint {snap['dir']}: cannot read "
+                          f"'{fname}': {e}")
+            return None
+        if self.verify and digests is not None:
+            got = hashlib.sha256(payload).hexdigest()
+            if got != digests[fname]:
+                warnings.warn(
+                    f"checkpoint {snap['dir']}: sha256 mismatch for "
+                    f"'{fname}' (stored {digests[fname][:12]}…, "
+                    f"recomputed {got[:12]}…)")
+                return None
+        return payload
+
     def _read_verified(self, snap: dict,
                        objects: Dict[str, object]) -> Optional[dict]:
         """All payloads of one snapshot, digest-checked — or None with a
-        warning naming what failed (missing file, bad hash)."""
+        warning naming what failed (missing file, bad hash).  Sharded
+        objects (saved through the ``shard_state`` protocol) come back
+        as ``("__sharded__", manifest, {fname: bytes})``; every shard
+        file is verified against its own digest."""
         digests = snap.get("digests")
         payloads = {}
         for name in objects:
+            mf = f"{name}.manifest.json"
+            if digests is not None and mf in digests:
+                raw = self._read_file_verified(snap, mf, digests)
+                if raw is None:
+                    return None
+                try:
+                    manifest = json.loads(raw.decode("utf-8"))
+                except ValueError as e:
+                    warnings.warn(f"checkpoint {snap['dir']}: corrupt "
+                                  f"manifest '{mf}': {e}")
+                    return None
+                shard_files = [sh["file"]
+                               for leaf in manifest.get("leaves", [])
+                               for sh in leaf.get("shards", [])]
+                blobs = {}
+                for fname in shard_files:
+                    full = f"{name}.{fname}"
+                    if full not in digests:
+                        warnings.warn(
+                            f"checkpoint {snap['dir']}: manifest names "
+                            f"'{full}' but it carries no digest")
+                        return None
+                    data = self._read_file_verified(snap, full, digests)
+                    if data is None:
+                        return None
+                    blobs[fname] = data
+                payloads[name] = ("__sharded__", manifest, blobs)
+                continue
             fname = f"{name}.pdparams"
-            path = self._join(snap["dir"], fname)
             if digests is not None and fname not in digests:
                 warnings.warn(
                     f"checkpoint {snap['dir']}: registered object "
                     f"'{name}' was never saved in this snapshot")
                 return None
-            try:
-                with self._fs.open_read(path) as f:
-                    payload = f.read()
-            except (OSError, RuntimeError) as e:
-                warnings.warn(f"checkpoint {snap['dir']}: cannot read "
-                              f"'{fname}': {e}")
+            payload = self._read_file_verified(snap, fname, digests)
+            if payload is None:
                 return None
-            if self.verify and digests is not None:
-                got = hashlib.sha256(payload).hexdigest()
-                if got != digests[fname]:
-                    warnings.warn(
-                        f"checkpoint {snap['dir']}: sha256 mismatch for "
-                        f"'{fname}' (stored {digests[fname][:12]}…, "
-                        f"recomputed {got[:12]}…)")
-                    return None
             payloads[name] = payload
         return payloads
 
@@ -210,10 +262,25 @@ class SnapshotStore:
                 continue
             # decode everything BEFORE applying anything: a corrupt
             # payload that slipped past hashing still can't part-load
-            states = {name: _loads(p, source=f"{snap['dir']}/{name}")
-                      for name, p in payloads.items()}
+            states = {}
+            for name, p in payloads.items():
+                if isinstance(p, tuple) and p[0] == "__sharded__":
+                    _, manifest, blobs = p
+                    decoded = {f: _loads(
+                        b, source=f"{snap['dir']}/{name}.{f}")
+                        for f, b in blobs.items()}
+                    states[name] = ("__sharded__", manifest, decoded)
+                else:
+                    states[name] = _loads(
+                        p, source=f"{snap['dir']}/{name}")
             for name, obj in objects.items():
-                obj.set_state_dict(states[name])
+                st = states[name]
+                if isinstance(st, tuple) and st[0] == "__sharded__":
+                    # reshard onto whatever mesh is live NOW (gather-
+                    # free when the stored layout already matches)
+                    obj.load_shard_state(st[1], st[2])
+                else:
+                    obj.set_state_dict(st)
             if attempts:
                 warnings.warn(
                     f"checkpoint: snapshot(s) {attempts} failed "

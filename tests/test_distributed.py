@@ -453,3 +453,473 @@ def test_zero3_param_sharding_parity():
     local = TrainStep(net, loss_fn, opt2)
     local_losses = [float(local(x, y)) for _ in range(3)]
     np.testing.assert_allclose(z3_losses, local_losses, rtol=2e-4)
+
+
+# ------------- GSPMD sharding subsystem (ISSUE 8) --------------------------
+# paddle_tpu.distributed.sharding: partition-rule engine, sharded static
+# Executor state, reshardable SnapshotStore checkpoints.
+
+from paddle_tpu.distributed import sharding as shx
+
+
+def test_partition_rules_order_wins():
+    """First matching rule wins — ordering IS the priority mechanism."""
+    tree = {"block": {"weight": np.ones((8, 4), np.float32)}}
+    specs = shx.match_partition_rules(
+        [(r"block/weight", P("dp")), (r"weight", P(None, "dp"))], tree)
+    assert specs["block"]["weight"] == P("dp")
+    # reversed order: the generic rule shadows the specific one
+    specs = shx.match_partition_rules(
+        [(r"weight", P(None, "dp")), (r"block/weight", P("dp"))], tree)
+    assert specs["block"]["weight"] == P(None, "dp")
+
+
+def test_partition_rules_scalar_leaves_replicated():
+    """Scalars (and one-element leaves) never shard, rules or not."""
+    tree = {"w": np.ones((8, 2), np.float32),
+            "step": np.float32(3.0),
+            "one": np.ones((1,), np.float32)}
+    specs = shx.match_partition_rules([(r".*", P("dp"))], tree)
+    assert specs["w"] == P("dp")
+    assert specs["step"] == P()
+    assert specs["one"] == P()
+
+
+def test_partition_rules_unmatched_raises_with_hint():
+    from paddle_tpu.core.enforce import InvalidArgumentError
+    tree = {"encoder": {"attn_weight": np.ones((8, 8), np.float32)}}
+    with pytest.raises(InvalidArgumentError) as ei:
+        shx.match_partition_rules(
+            [(r"atn_weight$", P("dp")), (r"bias$", P())], tree)
+    msg = str(ei.value)
+    assert "encoder/attn_weight" in msg
+    assert "atn_weight" in msg          # nearest-rule hint
+    assert "catch-all" in msg           # actionable fix
+    # non-strict mode replicates instead
+    specs = shx.match_partition_rules([(r"bias$", P())], tree,
+                                      strict=False)
+    assert specs["encoder"]["attn_weight"] == P()
+
+
+def test_optimizer_state_tree_inherits_param_specs():
+    """Adam m/v slots shard exactly like their param; scalar slots
+    replicate."""
+    p_specs = [P("dp"), P(None, "mp")]
+    state = [{"m": np.ones((8, 4), np.float32),
+              "v": np.ones((8, 4), np.float32),
+              "beta1_pow": np.float32(0.9)},
+             {"m": np.ones((4, 8), np.float32),
+              "v": np.ones((4, 8), np.float32)}]
+    s_specs = shx.specs_for_state(p_specs, state)
+    assert s_specs[0]["m"] == P("dp") and s_specs[0]["v"] == P("dp")
+    assert s_specs[0]["beta1_pow"] == P()
+    assert s_specs[1]["m"] == P(None, "mp")
+
+
+def test_spec_layout_and_divisor():
+    lay = shx.SpecLayout()
+    assert lay.column_parallel() == P(None, "mp")
+    assert lay.row_parallel() == P("mp", None)
+    assert lay.fsdp() == P("dp")
+    assert shx.spec_divisor(P("dp"), {"dp": 8}) == 8
+    assert shx.spec_divisor(P(("dp", "mp"), None), {"dp": 2, "mp": 4}) == 8
+    assert shx.spec_divisor(P(None, "mp"), {"dp": 8}) == 1  # absent axis
+    # a full rule table matches a transformer-ish tree end to end
+    tree = {"embedding_0": {"w_0": np.ones((64, 8), np.float32)},
+            "linear_0": {"w_0": np.ones((8, 8), np.float32),
+                         "b_0": np.ones((8,), np.float32)}}
+    specs = shx.match_partition_rules(lay.rules(), tree)
+    assert specs["embedding_0"]["w_0"] == lay.embedding()
+    assert specs["linear_0"]["b_0"] == P()
+
+
+def test_shard_and_gather_tree_roundtrip():
+    mesh = init_mesh({"dp": 8})
+    tree = {"w": np.arange(32, dtype=np.float32).reshape(16, 2),
+            "b": np.arange(3, dtype=np.float32)}
+    placed = shx.shard_tree(tree, rules=[(r"w$", P("dp")), (r".*", P())],
+                            mesh=mesh)
+    assert placed["w"].sharding.spec == P("dp")
+    back = shx.gather_tree(placed)
+    np.testing.assert_array_equal(back["w"], tree["w"])
+    np.testing.assert_array_equal(back["b"], tree["b"])
+
+
+def test_init_mesh_overflow_is_structured_error():
+    from paddle_tpu.core.enforce import ResourceExhaustedError
+    with pytest.raises(ResourceExhaustedError,
+                       match="xla_force_host_platform_device_count"):
+        init_mesh({"dp": 64})
+
+
+def test_mesh_replace_guard():
+    from paddle_tpu.core.enforce import PreconditionNotMetError
+    mesh = init_mesh({"dp": 8})
+
+    class Holder:
+        pass
+
+    h = Holder()
+    dist.register_mesh_user(h, mesh, "test executable")
+    try:
+        with pytest.raises(PreconditionNotMetError,
+                           match="test executable"):
+            init_mesh({"dp": 4})
+        # warn-only flag downgrades
+        paddle.set_flags({"mesh_replace_warn_only": True})
+        try:
+            with pytest.warns(UserWarning, match="replacing live mesh"):
+                init_mesh({"dp": 4})
+        finally:
+            paddle.set_flags({"mesh_replace_warn_only": False})
+            init_mesh({"dp": 8})
+    finally:
+        dist.release_mesh_user(h)
+    # released: replacing is clean again
+    init_mesh({"dp": 4})
+    assert dist.mesh_users() == []
+
+
+def test_strategy_rejects_non_divisible_degrees():
+    from paddle_tpu.core.enforce import InvalidArgumentError
+    s = dist.DistributedStrategy()
+    s.tensor_parallel = True
+    s.tensor_parallel_configs = {"tensor_parallel_degree": 3}
+    with pytest.raises(InvalidArgumentError, match="divide"):
+        s.infer_mesh_shape(8)
+    with pytest.raises(InvalidArgumentError, match="divide"):
+        dist.strategy.validate_toggles(s, n_devices=8)
+    # divisible config passes and wastes nothing
+    assert s.infer_mesh_shape(6) == {"dp": 2, "mp": 3}
+
+
+def _static_fc_program(lr=0.05, use_fleet=False):
+    import paddle_tpu.nn.functional as F
+    paddle.seed(0)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        pred = paddle.static.nn.fc(x, 1)
+        loss = F.mse_loss(pred, y)
+        opt = optimizer.Adam(learning_rate=lr)
+        if use_fleet:
+            f = dist.fleet
+            f.init(is_collective=True,
+                   strategy=dist.DistributedStrategy())
+            opt = f.distributed_optimizer(opt)
+        opt.minimize(loss)
+    return main, loss
+
+
+def _fc_data():
+    rng = np.random.RandomState(1)
+    xs = rng.standard_normal((64, 8)).astype(np.float32)
+    ys = xs @ rng.standard_normal((8, 1)).astype(np.float32)
+    return xs, ys
+
+
+def test_sharded_executor_matches_plain_and_never_recompiles():
+    """fleet.distributed_optimizer lowers the donated _ExecState
+    through jit-with-shardings on the mesh; unchanged user code, same
+    losses as the unsharded executor, one compile total."""
+    paddle.enable_static()
+    try:
+        xs, ys = _fc_data()
+        init_mesh({"dp": 8})
+        main, loss = _static_fc_program(use_fleet=True)
+        init_mesh({"dp": 8})  # fleet.init re-derived it; keep dp=8
+        exe = paddle.static.Executor()
+        sharded = [float(exe.run(main, feed={"x": xs, "y": ys},
+                                 fetch_list=[loss])[0])
+                   for _ in range(5)]
+        assert exe.compile_count == 1  # 0 recompiles after warmup
+        state = exe._states[main._serial]
+        sh0 = state.p_arrays[0].sharding
+        assert dict(sh0.mesh.shape) == {"dp": 8}
+        exe.close()
+        paddle.static.reset_default_programs()
+
+        main2, loss2 = _static_fc_program(use_fleet=False)
+        exe2 = paddle.static.Executor()
+        plain = [float(exe2.run(main2, feed={"x": xs, "y": ys},
+                                fetch_list=[loss2])[0])
+                 for _ in range(5)]
+        exe2.close()
+        np.testing.assert_allclose(sharded, plain, rtol=1e-5)
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_snapshot_store_reshard_roundtrip(tmp_path):
+    """Save on mesh-8, restore on mesh-1, restore on mesh-8: per-shard
+    digests verified, gathered params bitwise-identical each time."""
+    from paddle_tpu.utils.checkpoint import SnapshotStore
+    paddle.enable_static()
+    try:
+        xs, ys = _fc_data()
+        init_mesh({"dp": 8})
+        main, loss = _static_fc_program(use_fleet=True)
+        init_mesh({"dp": 8})
+        exe = paddle.static.Executor()
+        for _ in range(3):
+            exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        store = SnapshotStore(str(tmp_path / "ckpt"))
+        store.save(0, {"train": exe.sharded_state(main)})
+        ref = {k: np.asarray(v).copy() for k, v in
+               exe.sharded_state(main)._getter()["params"].items()}
+        cont8 = [float(exe.run(main, feed={"x": xs, "y": ys},
+                               fetch_list=[loss])[0]) for _ in range(3)]
+        exe.close()
+        paddle.static.reset_default_programs()
+
+        # every saved file carries its own digest in the meta
+        meta = store.load_meta()
+        digests = meta["snapshots"][-1]["digests"]
+        assert "train.manifest.json" in digests
+        assert sum(1 for k in digests if k.endswith(".shard")) >= 8
+
+        from paddle_tpu.utils import monitor
+        for shape, expect_stat in (({"dp": 1},
+                                    "sharding.restore.resharded"),
+                                   ({"dp": 8},
+                                    "sharding.restore.gather_free")):
+            monitor.stat_reset()
+            init_mesh(shape)
+            main_r, loss_r = _static_fc_program(use_fleet=True)
+            init_mesh(shape)
+            exe_r = paddle.static.Executor()
+            ss = exe_r.sharded_state(main_r)
+            store.restore({"train": ss})
+            got = {k: np.asarray(v) for k, v in
+                   ss._getter()["params"].items()}
+            for k in ref:
+                np.testing.assert_array_equal(got[k], ref[k])
+            assert monitor.get_stat(expect_stat) > 0
+            cont = [float(exe_r.run(main_r, feed={"x": xs, "y": ys},
+                                    fetch_list=[loss_r])[0])
+                    for _ in range(3)]
+            # loss trajectory continues identically after resharding
+            np.testing.assert_allclose(cont, cont8, rtol=1e-5)
+            exe_r.close()
+            paddle.static.reset_default_programs()
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_snapshot_store_corrupt_shard_is_caught(tmp_path):
+    """A flipped byte in ONE shard payload fails that shard's digest
+    and the restore refuses to part-load."""
+    import os
+    from paddle_tpu.utils.checkpoint import CheckpointError, SnapshotStore
+    init_mesh({"dp": 8})
+    tree = {"w": shx.shard_tree({"x": np.arange(16, dtype=np.float32)},
+                                rules=[(r".*", P("dp"))])["x"]}
+    store = SnapshotStore(str(tmp_path / "ckpt"))
+    store.save(0, {"state": shx.ShardedState(tree)})
+    sdir = tmp_path / "ckpt" / "epoch_0"
+    victim = sorted(p for p in os.listdir(sdir)
+                    if p.endswith(".shard"))[0]
+    path = sdir / victim
+    data = bytearray(path.read_bytes())
+    data[-1] ^= 0xFF
+    path.write_bytes(bytes(data))
+    fresh = shx.ShardedState()
+    with pytest.warns(UserWarning, match="sha256 mismatch"):
+        with pytest.raises(CheckpointError):
+            store.restore({"state": fresh})
+
+
+def test_mesh_same_shape_reinstall_keeps_guard_armed():
+    """An equal re-install (the repo's own 'pin it' pattern) must keep
+    the SAME mesh object — a new equal object would strand registered
+    users on the old one and silently disarm the replace guard."""
+    from paddle_tpu.core.enforce import PreconditionNotMetError
+    mesh = init_mesh({"dp": 8})
+    assert init_mesh({"dp": 8}) is mesh
+
+    class Holder:
+        pass
+
+    h = Holder()
+    dist.register_mesh_user(h, mesh, "held executable")
+    try:
+        init_mesh({"dp": 8})  # idempotent re-pin: no replace, no error
+        with pytest.raises(PreconditionNotMetError,
+                           match="held executable"):
+            init_mesh({"dp": 4})
+    finally:
+        dist.release_mesh_user(h)
+
+
+def test_fleet_init_respects_pinned_subset_mesh():
+    """fleet.init must not re-derive the mesh over ALL devices when a
+    compatible mesh is already pinned (a subset mesh on a bigger host
+    is a legitimate pin)."""
+    from paddle_tpu.distributed.mesh import get_mesh
+    pinned = init_mesh({"dp": 2})
+    dist.fleet.init(is_collective=True,
+                    strategy=dist.DistributedStrategy())
+    assert get_mesh() is pinned
+    # incompatible model degrees still re-derive over all devices
+    s = dist.DistributedStrategy()
+    s.tensor_parallel = True
+    s.tensor_parallel_configs = {"tensor_parallel_degree": 4}
+    dist.fleet.init(is_collective=True, strategy=s)
+    assert dict(get_mesh().shape) == {"dp": 2, "mp": 4}
+
+
+def test_fleet_init_respects_custom_device_subset_pin():
+    """A mesh pinned over a NON-prefix device subset must survive
+    fleet.init untouched (rebuilding over devices[:n] would silently
+    move the pin)."""
+    from paddle_tpu.distributed.mesh import get_mesh
+    pinned = init_mesh({"dp": 4}, devices=jax.devices()[4:])
+    dist.fleet.init(is_collective=True,
+                    strategy=dist.DistributedStrategy())
+    assert get_mesh() is pinned
+
+
+def test_sharded_state_survives_set_state_dict_interleaving(tmp_path):
+    """optimizer.set_state_dict on the static path nulls the live
+    opt_state and stages slots on the optimizer — sharded saves AND
+    restores interleaved with it must not lose the moments."""
+    from paddle_tpu.utils.checkpoint import SnapshotStore
+    paddle.enable_static()
+    try:
+        xs, ys = _fc_data()
+        init_mesh({"dp": 8})
+        main, loss = _static_fc_program(use_fleet=True)
+        init_mesh({"dp": 8})
+        exe = paddle.static.Executor()
+        run = lambda n: [float(exe.run(main, feed={"x": xs, "y": ys},
+                                       fetch_list=[loss])[0])
+                         for _ in range(n)]
+        run(3)
+        opt = main._optimizer[0]
+        ckpt = opt.state_dict()
+        assert ckpt["slots"]
+        store = SnapshotStore(str(tmp_path / "ck"))
+        store.save(0, {"train": exe.sharded_state(main)})
+        ref_cont = run(3)  # uninterrupted continuation, steps 4-6
+
+        # getter between set_state_dict and the next run still sees
+        # the staged slots (the live opt_state is nulled)
+        opt.set_state_dict(ckpt)
+        got = exe.sharded_state(main)._getter()
+        assert set(got.get("slots", {})) == {f"{int(k):04d}"
+                                             for k in ckpt["slots"]}
+
+        # restoring INTO that nulled live state stages the snapshot's
+        # slots — continuation must replay the uninterrupted steps
+        store.restore({"train": exe.sharded_state(main)})
+        np.testing.assert_allclose(run(3), ref_cont, rtol=1e-6)
+        exe.close()
+        paddle.static.reset_default_programs()
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_restore_then_save_before_first_compile_keeps_slots(tmp_path):
+    """A fresh process that restores a sharded snapshot and re-saves it
+    BEFORE its first compile must not drop the optimizer slots (they
+    are staged on the optimizer, not yet in a live _ExecState)."""
+    from paddle_tpu.utils.checkpoint import SnapshotStore
+    paddle.enable_static()
+    try:
+        xs, ys = _fc_data()
+        init_mesh({"dp": 8})
+        main, loss = _static_fc_program(use_fleet=True)
+        init_mesh({"dp": 8})
+        exe = paddle.static.Executor()
+        for _ in range(3):
+            exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        ref_slots = {k: {sk: np.asarray(sv).copy()
+                         for sk, sv in v.items()}
+                     for k, v in exe.sharded_state(main)._getter()
+                     ["slots"].items()}
+        assert ref_slots  # Adam: m/v exist after 3 steps
+        store1 = SnapshotStore(str(tmp_path / "ck1"))
+        store1.save(0, {"train": exe.sharded_state(main)})
+        exe.close()
+        paddle.static.reset_default_programs()
+
+        # fresh 'process': restore, then immediately re-publish
+        init_mesh({"dp": 2})
+        main2, _ = _static_fc_program(use_fleet=True)
+        init_mesh({"dp": 2})
+        exe2 = paddle.static.Executor()
+        store1.restore({"train": exe2.sharded_state(main2)})
+        migrated = exe2.sharded_state(main2)._getter()
+        assert set(migrated.get("slots", {})) == set(ref_slots)
+        store2 = SnapshotStore(str(tmp_path / "ck2"))
+        store2.save(0, {"train": exe2.sharded_state(main2)})
+        exe2.close()
+        paddle.static.reset_default_programs()
+
+        # the re-published snapshot still carries every slot, bitwise
+        init_mesh({"dp": 8})
+        main3, _ = _static_fc_program(use_fleet=True)
+        init_mesh({"dp": 8})
+        exe3 = paddle.static.Executor()
+        ss3 = exe3.sharded_state(main3)
+        store2.restore({"train": ss3})
+        got = ss3._getter()
+        assert set(got.get("slots", {})) == set(ref_slots)
+        for k, slots in ref_slots.items():
+            for sk, sv in slots.items():
+                np.testing.assert_array_equal(
+                    np.asarray(got["slots"][k][sk]), sv)
+        exe3.close()
+        paddle.static.reset_default_programs()
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_analyze_prices_sharded_program_per_shard():
+    """Program.analyze(sharding=plan) divides tensor bytes by the mesh
+    axis sizes each PartitionSpec shards over."""
+    import paddle_tpu.nn.functional as F
+    paddle.enable_static()
+    try:
+        mesh = init_mesh({"dp": 8})
+        paddle.seed(0)
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [64, 32], "float32")
+            y = paddle.static.data("y", [64, 1], "float32")
+            pred = paddle.static.nn.fc(x, 1)
+            loss = F.mse_loss(pred, y)
+            optimizer.Adam(learning_rate=0.01).minimize(loss)
+        params = main.parameters()
+        w_name = params[0].name
+        plan = shx.plan_for_params(
+            [(p.name, p) for p in params], mesh=mesh,
+            rules=[(rf"{w_name}$", P("dp")), (r".*", P())])
+        rep = main.analyze(fetch_list=[loss], sharding=plan)
+        ms, mf = rep.memory_per_shard, rep.memory
+        # weight [32,1] f32 over dp=8 -> 16B/shard; bias [1] replicated
+        assert ms.param_bytes == (32 * 4) // 8 + 4
+        assert ms.slot_bytes == 2 * ((32 * 4) // 8 + 4)  # Adam m+v
+        assert ms.peak_bytes_donated < mf.peak_bytes_donated
+        assert rep.totals["mesh_devices"] == 8
+        assert "per-shard" in rep.render()
+        # compile_summary rides the per-chip number too
+        from paddle_tpu.static.analysis.cost import compile_summary
+        s = compile_summary(main, sharding=plan)
+        assert s["peak_bytes_per_shard"] == ms.peak_bytes_donated
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_chaos_reshard_scenario_in_process(tmp_path):
+    """tools/chaos_smoke.py --scenario reshard, in-process: kill
+    mid-run on mesh dp=8, restore the sharded snapshot onto mesh dp=2,
+    loss-trajectory parity with the uninterrupted run."""
+    from paddle_tpu.testing import chaos
+    assert chaos.reshard_main(workdir=str(tmp_path)) == 0

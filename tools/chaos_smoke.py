@@ -19,10 +19,17 @@ Scenarios (``--scenario``, default ``all``):
   admitted sequence streams to a clean finish with tokens bitwise-
   identical to a fault-free serial run (admission order must not leak
   into results) or errors cleanly, with the page pool fully reclaimed.
+- ``reshard`` — :func:`paddle_tpu.testing.chaos.reshard_main`: a
+  fleet-sharded static training run on mesh ``{dp: 8}`` killed mid-run
+  by an injected ``executor.run`` fault, then restored from its
+  per-shard digest-verified SnapshotStore checkpoint onto mesh
+  ``{dp: 2}``; fails unless the restore is bitwise and the
+  post-restore loss trajectory matches the uninterrupted run
+  (ROADMAP item 1's success criterion).
 
 Usage::
 
-    python tools/chaos_smoke.py [--scenario all|training|serving|generation]
+    python tools/chaos_smoke.py [--scenario all|training|serving|generation|reshard]
                                 [--epochs 4] [--verbose]
 
 CI treats a non-zero exit as a robustness regression.  The same flows
@@ -43,10 +50,22 @@ if REPO not in sys.path:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     ap.add_argument("--scenario", default="all",
-                    choices=["all", "training", "serving", "generation"])
+                    choices=["all", "training", "serving", "generation",
+                             "reshard"])
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
+    if args.scenario == "reshard":
+        # the reshard drill needs a multi-device mesh; set env BEFORE
+        # anything initialises jax.  Scoped to this scenario only — the
+        # other drills must keep exercising the host's real device
+        # config (under --scenario all the drill runs in a subprocess).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from paddle_tpu.testing import chaos
     rc = 0
     if args.scenario in ("all", "training"):
@@ -55,6 +74,15 @@ def main(argv=None) -> int:
         rc |= chaos.serving_main(verbose=args.verbose)
     if args.scenario in ("all", "generation"):
         rc |= chaos.generation_main(verbose=args.verbose)
+    if args.scenario == "reshard":
+        rc |= chaos.reshard_main(verbose=args.verbose)
+    elif args.scenario == "all":
+        import subprocess
+        sub = [sys.executable, os.path.abspath(__file__),
+               "--scenario", "reshard"]
+        if args.verbose:
+            sub.append("--verbose")
+        rc |= subprocess.run(sub).returncode
     return rc
 
 
