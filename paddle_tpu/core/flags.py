@@ -181,6 +181,18 @@ define_flag("flight_recorder_path", "",
             "an exception escaping Executor.run, SIGTERM or an "
             "unhandled exception, the last tracer events + a full "
             "metrics snapshot are written here atomically.")
+define_flag("perf_sample_every", 16,
+            "Runtime performance observatory (observability.enable_perf)"
+            ": fence (block_until_ready) and sample device memory on "
+            "every Nth step per compile identity.  Unsampled steps stay "
+            "fully async — only host-side timestamps are taken — so the "
+            "donated dispatch pipeline is never serialized.  <=0 "
+            "disables fencing entirely (host anatomy only).")
+define_flag("perf_chip", "",
+            "Roofline chip spec used to turn the cost model's predicted "
+            "FLOPs/traffic into a predicted step time for the drift "
+            "tracker (static/analysis/cost.CHIP_SPECS key).  Empty = "
+            "auto: 'cpu' on the CPU backend, 'v5e' on TPU.")
 define_flag("pallas_attention_dropout_min_seqlen", 512,
             "Flash threshold when attention dropout is active: the XLA "
             "path must materialize [B,H,L,L] dropout masks in HBM, so "

@@ -14,6 +14,8 @@ from __future__ import annotations
 _tracer = None      # paddle_tpu.observability.Tracer when enabled
 _crash = None       # callable(exc, context_str) when a flight
                     # recorder is installed
+_perf = None        # paddle_tpu.observability.perf.PerfObservatory
+                    # when the runtime performance observatory is on
 
 
 def set_tracer(tracer) -> None:
@@ -23,6 +25,15 @@ def set_tracer(tracer) -> None:
 
 def current():
     return _tracer
+
+
+def set_perf(perf) -> None:
+    global _perf
+    _perf = perf
+
+
+def current_perf():
+    return _perf
 
 
 def set_crash_handler(fn) -> None:

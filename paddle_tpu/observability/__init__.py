@@ -24,6 +24,14 @@ The reproduction's four telemetry islands (profiler host spans,
 - :func:`install_flight_recorder` arms the crash flight recorder:
   EnforceError / executor exceptions / SIGTERM / sys.excepthook dump
   the last N events + full metrics snapshot atomically for post-mortem.
+- :func:`enable_perf` installs the runtime performance observatory
+  (:mod:`.perf`): sampled step-time anatomy (host vs device lanes),
+  per-device live/peak memory gauges, and a rolling
+  predicted-vs-measured drift tracker surfaced by :func:`perf_report`.
+- :func:`install_slo_monitor` (:mod:`.slo`) evaluates declarative
+  :class:`SLORule` rolling-window burn-rate rules over the monitor
+  registry; :func:`slo_status` drives ``/healthz`` degradation and the
+  ``paddle_tpu_slo_*`` Prometheus gauges.
 """
 from __future__ import annotations
 
@@ -35,6 +43,12 @@ from .compiles import explain_compiles, record_compile, reset_compiles
 from .flight import (dump_flight, flight_recorder_path,
                      install_flight_recorder, uninstall_flight_recorder)
 from .metrics import dump_metrics, metrics_snapshot, prometheus_text
+from .perf import (PerfObservatory, device_memory, disable_perf,
+                   enable_perf, get_perf, perf_enabled, perf_report,
+                   render_perf_report)
+from .slo import (SLOMonitor, SLORule, get_slo_monitor,
+                  install_slo_monitor, slo_status,
+                  standard_serving_rules, uninstall_slo_monitor)
 from .tracer import EVENT_KINDS, Tracer
 
 __all__ = [
@@ -44,6 +58,11 @@ __all__ = [
     "prometheus_text", "metrics_snapshot", "dump_metrics",
     "install_flight_recorder", "uninstall_flight_recorder",
     "dump_flight", "flight_recorder_path",
+    "PerfObservatory", "enable_perf", "disable_perf", "perf_enabled",
+    "get_perf", "perf_report", "render_perf_report", "device_memory",
+    "SLORule", "SLOMonitor", "install_slo_monitor",
+    "uninstall_slo_monitor", "get_slo_monitor", "slo_status",
+    "standard_serving_rules",
 ]
 
 

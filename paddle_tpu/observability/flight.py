@@ -88,12 +88,23 @@ def dump_flight(path: Optional[str] = None, reason: str = "manual",
             "pid": os.getpid(),
             "exception": _dump_exc_info(exc) if exc is not None else None,
             "events": events,
+            # drop accounting rides every dump: a black box whose ring
+            # wrapped must say so, or the truncated tape misleads
+            "obs": trc.ring_stats() if trc is not None else None,
             "stats": monitor.all_stats(),
             "histograms": monitor.all_histograms(),
             "compiles": {"total": comp["total"],
                          "unexplained": comp["unexplained"],
                          "by_cause": comp["by_cause"]},
         }
+        from . import slo as _slo
+        if _slo.get_slo_monitor() is not None:
+            # last evaluation, not a fresh poll — a dump mid-crash must
+            # not start measuring windows
+            payload["slo"] = _slo.slo_status(poll=False)
+        perf = obs_hook._perf
+        if perf is not None:
+            payload["perf"] = perf.report()
         from ..utils import fs
         fs.write_atomic(path, json.dumps(payload, default=str).encode())
         monitor.stat_add("flight.dumps")

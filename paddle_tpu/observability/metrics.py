@@ -22,7 +22,7 @@ import re
 import time
 from typing import Dict, Optional
 
-from ..core import flags
+from ..core import flags, obs_hook
 from ..utils import monitor
 
 __all__ = ["prometheus_text", "metrics_snapshot", "dump_metrics"]
@@ -47,39 +47,87 @@ def _fmt(v) -> str:
 def prometheus_text(extra_gauges: Optional[Dict[str, float]] = None
                     ) -> str:
     """The whole monitor registry (plus caller-supplied gauges) in
-    Prometheus text exposition format (version 0.0.4)."""
+    Prometheus text exposition format (version 0.0.4).
+
+    An ``extra_gauges`` key may carry a label set after the name —
+    ``'serving_engine_queue_depth{engine="bert"}'`` — the name part is
+    sanitized, the label part passes through verbatim (the serving
+    front-end's per-engine labels ride this).  An extra gauge whose
+    sanitized name matches a monitor-stat family joins that family
+    (one ``# TYPE`` line, samples contiguous — strict parsers reject
+    repeated or split families); an exact duplicate series (same name,
+    same label set) is skipped, the registry's value wins."""
+    t = obs_hook._tracer
+    if t is not None:
+        t.ring_stats()      # refresh the drop-accounting gauges
     stats = monitor.all_stats()
     hists = monitor.all_histograms()
     hist_names = {_prom_name(n) for n in hists}
-    lines = []
+    # family name -> (type, sample lines, label sets seen); insertion-
+    # ordered so each family renders once, contiguously
+    families: Dict[str, tuple] = {}
+
+    def fam(m: str, typ: str) -> tuple:
+        f = families.get(m)
+        if f is None:
+            f = families[m] = (typ, [], set())
+        return f
+
     for name in sorted(stats):
         m = _prom_name(name)
         if m in hist_names:     # a stat and a histogram sharing a name
             m += "_stat"        # must not collide in the exposition
-        lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {_fmt(stats[name])}")
+        _, smp, seen = fam(m, "gauge")
+        smp.append(f"{m} {_fmt(stats[name])}")
+        seen.add("")
     for name in sorted(hists):
         m = _prom_name(name)
         s = hists[name]
-        lines.append(f"# TYPE {m} summary")
+        _, smp, _ = fam(m, "summary")
         for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
-            lines.append(f'{m}{{quantile="{q}"}} {_fmt(s[key])}')
-        lines.append(f"{m}_sum {_fmt(s['sum'])}")
-        lines.append(f"{m}_count {_fmt(int(s['count']))}")
+            smp.append(f'{m}{{quantile="{q}"}} {_fmt(s[key])}')
+        smp.append(f"{m}_sum {_fmt(s['sum'])}")
+        smp.append(f"{m}_count {_fmt(int(s['count']))}")
     for name in sorted(extra_gauges or {}):
-        m = _prom_name(name)
-        lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {_fmt(extra_gauges[name])}")
+        base, brace, label = name.partition("{")
+        m = _prom_name(base)
+        if m in hist_names:
+            m += "_stat"
+        _, smp, seen = fam(m, "gauge")
+        key = brace + label
+        if key in seen:
+            continue
+        seen.add(key)
+        smp.append(f"{m}{key} {_fmt(extra_gauges[name])}")
+    lines = []
+    for m, (typ, smp, _) in families.items():
+        lines.append(f"# TYPE {m} {typ}")
+        lines.extend(smp)
     return "\n".join(lines) + "\n"
 
 
 def metrics_snapshot(extra: Optional[dict] = None) -> dict:
-    """Timestamped JSON-ready snapshot of every stat and histogram."""
+    """Timestamped JSON-ready snapshot of every stat and histogram,
+    plus — when the respective layers are live — the tracer's drop
+    accounting (``obs``), the current SLO evaluation (``slo``), and
+    the perf observatory's drift report (``perf``), so one JSONL line
+    is a complete offline-analysis record (latency distributions and
+    objective state included, not just counters)."""
+    t = obs_hook._tracer
+    ring = t.ring_stats() if t is not None else None
     snap = {
         "time": time.time(),
         "stats": monitor.all_stats(),
         "histograms": monitor.all_histograms(),
     }
+    if ring is not None:
+        snap["obs"] = ring
+    from . import slo as _slo
+    if _slo.get_slo_monitor() is not None:
+        snap["slo"] = _slo.slo_status(poll=False)
+    p = obs_hook._perf
+    if p is not None:
+        snap["perf"] = p.report()
     if extra:
         snap.update(extra)
     return snap

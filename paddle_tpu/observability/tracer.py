@@ -53,6 +53,8 @@ EVENT_KINDS = (
     "serving",          # enqueue / dispatch / shed / deadline_expired
     "fault",            # an injected fault fired (testing.fault)
     "crash",            # flight-recorder dump trigger
+    "perf",             # step anatomy lane (observability.perf)
+    "slo",              # SLO breach / recover (observability.slo)
     "instant",          # free-form user event
 )
 
@@ -175,6 +177,36 @@ class Tracer:
     def emitted(self) -> int:
         """Total events emitted (>= len(events()) once the ring wraps)."""
         return self._emitted
+
+    @property
+    def dropped(self) -> int:
+        """Events the full ring evicted under pressure — nonzero means
+        the buffered trace is a truncated view of what was emitted.
+        Derived from the emit counter (the buffer is append-only, so
+        it holds exactly ``min(emitted, capacity)`` events) — per-emit
+        boundary bookkeeping raced between threads and could report a
+        clean tape for a truncated one."""
+        return max(0, self._emitted - self.capacity)
+
+    @property
+    def high_watermark(self) -> int:
+        """Most events ever buffered at once (== capacity once the
+        ring has wrapped); derived like :attr:`dropped`."""
+        return min(self._emitted, self.capacity)
+
+    def ring_stats(self) -> dict:
+        """Drop accounting block exporters embed next to any trace
+        snapshot; also mirrors the ``obs.events_dropped`` stat and the
+        capacity/high-watermark gauges into ``monitor``."""
+        from ..utils import monitor
+        dropped, hwm = self.dropped, self.high_watermark
+        monitor.stat_set("obs.events_dropped", dropped)
+        monitor.stat_set("obs.ring_capacity", self.capacity)
+        monitor.stat_set("obs.ring_high_watermark", hwm)
+        return {"events_emitted": self._emitted,
+                "events_dropped": dropped,
+                "ring_capacity": self.capacity,
+                "ring_high_watermark": hwm}
 
     def wall_time(self, ts: float) -> float:
         """Convert a perf_counter stamp to unix wall-clock seconds."""

@@ -85,6 +85,21 @@ def _safe_set_exception(fut: Future, exc: BaseException) -> None:
         pass
 
 
+def _mirrored_add(base: str, prefix, suffix: str, v=1) -> None:
+    """One engine counter: the process aggregate under ``base`` plus
+    the per-engine mirror under ``prefix`` when the engine is named —
+    the single mirroring rule both engine classes share."""
+    monitor.stat_add(base + suffix, v)
+    if prefix is not None:
+        monitor.stat_add(prefix + suffix, v)
+
+
+def _mirrored_observe(base: str, prefix, suffix: str, v) -> None:
+    monitor.stat_observe(base + suffix, v)
+    if prefix is not None:
+        monitor.stat_observe(prefix + suffix, v)
+
+
 class InferenceEngine:
     """Dynamic-batching front for a :class:`paddle_tpu.inference.Predictor`.
 
@@ -105,18 +120,28 @@ class InferenceEngine:
             AOT-compiles exactly these shapes.
         dispatch_retries: re-runs of a failed batch before its requests
             are failed (default ``FLAGS_serving_dispatch_retries``).
+        name: engine label for multi-model processes.  When set, the
+            engine's monitor stats mirror under
+            ``serving.engine.<name>.*`` (in addition to the process
+            aggregate ``serving.*``), tracer events carry it, and the
+            HTTP layer labels the Prometheus gauges
+            ``paddle_tpu_serving_engine_*{engine="<name>"}``.
     """
 
     def __init__(self, predictor, max_batch_size: int = 32,
                  batch_timeout_ms: float = 2.0, max_queue: int = 256,
                  default_deadline_ms: Optional[float] = None,
                  buckets: Optional[Sequence[int]] = None,
-                 dispatch_retries: Optional[int] = None):
+                 dispatch_retries: Optional[int] = None,
+                 name: Optional[str] = None):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         self._pred = predictor
+        self.name = str(name) if name else None
+        self._stat_prefix = (f"serving.engine.{self.name}."
+                             if self.name else None)
         self._input_names = list(predictor.get_input_names())
         meta = getattr(predictor, "_meta", {}) or {}
         self._in_dtypes = [np.dtype(d) for d in meta.get("in_dtypes", [])] \
@@ -187,6 +212,22 @@ class InferenceEngine:
                                         daemon=True)
         self._thread.start()
 
+    # -- per-engine metrics ------------------------------------------------
+    def _madd(self, suffix: str, v=1) -> None:
+        """Count ``serving.<suffix>`` — and mirror it under this
+        engine's ``serving.engine.<name>.`` prefix when labelled, so a
+        multi-model process can tell its engines apart."""
+        _mirrored_add("serving.", self._stat_prefix, suffix, v)
+
+    def _mobs(self, suffix: str, v) -> None:
+        _mirrored_observe("serving.", self._stat_prefix, suffix, v)
+
+    def _ev(self, **args) -> dict:
+        """Tracer event args, engine-labelled when the engine is."""
+        if self.name is not None:
+            args["engine"] = self.name
+        return args
+
     # -- admission ---------------------------------------------------------
     def _normalize(self, inputs) -> List[np.ndarray]:
         if isinstance(inputs, dict):
@@ -253,11 +294,11 @@ class InferenceEngine:
                 self._expire_locked()
             if len(self._queue) >= self._max_queue:
                 self._c["shed"] += 1
-                monitor.stat_add("serving.shed")
+                self._madd("shed")
                 trc = obs_hook._tracer
                 if trc is not None:
                     trc.emit("serving", "shed",
-                             args={"rid": req.rid, "rows": n})
+                             args=self._ev(rid=req.rid, rows=n))
                 raise QueueFull(
                     f"queue full ({self._max_queue} requests); retry with "
                     f"backoff")
@@ -266,12 +307,12 @@ class InferenceEngine:
             if req.deadline is not None:
                 self._queued_deadlines += 1
             self._c["requests"] += 1
-            monitor.stat_add("serving.requests")
+            self._madd("requests")
             self._cv.notify_all()
         trc = obs_hook._tracer
         if trc is not None:
             trc.emit("serving", "enqueue",
-                     args={"rid": req.rid, "rows": n})
+                     args=self._ev(rid=req.rid, rows=n))
         return req.future
 
     def infer_sync(self, inputs, deadline_ms: Optional[float] = None,
@@ -284,12 +325,12 @@ class InferenceEngine:
         self._queued_rows -= r.rows
         self._queued_deadlines -= 1
         self._c["deadline_expired"] += 1
-        monitor.stat_add("serving.deadline_expired")
+        self._madd("deadline_expired")
         trc = obs_hook._tracer
         if trc is not None:
             trc.emit("serving", "deadline_expired",
-                     args={"rid": r.rid,
-                           "waited_ms": (now - r.t_enq) * 1000.0})
+                     args=self._ev(rid=r.rid,
+                                   waited_ms=(now - r.t_enq) * 1000.0))
         _safe_set_exception(r.future, DeadlineExceeded(
             f"deadline expired after "
             f"{(now - r.t_enq) * 1000:.1f} ms in queue"))
@@ -396,26 +437,37 @@ class InferenceEngine:
             except Exception as e:          # pure inference: retry whole
                 last_exc = e                # batch on any dispatch fault
                 self._c["dispatch_errors"] += 1
-                monitor.stat_add("serving.dispatch_errors")
+                self._madd("dispatch_errors")
                 if attempt < self._retries:
                     self._c["dispatch_retries"] += 1
-                    monitor.stat_add("serving.dispatch_retries")
+                    self._madd("dispatch_retries")
+        t_done = time.perf_counter()
         trc = obs_hook._tracer
         if trc is not None:
             # one typed event per coalesced dispatch, correlated to the
             # member requests by id
             trc.emit("serving", "dispatch", ts=t_disp,
-                     dur=time.perf_counter() - t_disp,
-                     args={"rids": [r.rid for r in batch], "rows": rows,
-                           "bucket": target, "attempts": attempt + 1,
-                           "ok": last_exc is None})
+                     dur=t_done - t_disp,
+                     args=self._ev(rids=[r.rid for r in batch],
+                                   rows=rows, bucket=target,
+                                   attempts=attempt + 1,
+                                   ok=last_exc is None))
         if last_exc is not None:
             for r in batch:
                 _safe_set_exception(r.future, last_exc)
             self._c["failed"] += len(batch)
-            monitor.stat_add("serving.failed", len(batch))
+            self._madd("failed", len(batch))
             return
         host = [np.asarray(o) for o in outs]    # one device sync per batch
+        # perf observatory: per-engine dispatch anatomy + the device-
+        # memory sampler cadence (one None-check when off).  Measured
+        # AFTER the host sync above — predictor outputs are async jax
+        # arrays, so a pre-sync stamp would time the dispatch submit
+        # (~0) instead of the batch's actual device wall
+        p = obs_hook._perf
+        if p is not None:
+            p.serving_step(self.name, "dispatch",
+                           time.perf_counter() - t_disp)
         mask = self._out_mask
         batched = [h.ndim >= 1
                    and (mask[j] if mask is not None and j < len(mask)
@@ -432,18 +484,18 @@ class InferenceEngine:
             _safe_set_result(r.future, res)
             lat_ms = (now - r.t_enq) * 1000.0
             self._reg.observe("latency_ms", lat_ms)
-            monitor.stat_observe("serving.latency_ms", lat_ms)
+            self._mobs("latency_ms", lat_ms)
         with self._cv:      # stats() snapshots under this lock; keep
             self._c["responses"] += len(batch)   # its view consistent
             self._c["batches"] += 1
             self._c["rows"] += rows
             self._c["padded_rows"] += target - rows
             self._occ_sum += rows / target
-        monitor.stat_add("serving.batches")
-        monitor.stat_add("serving.rows", rows)
-        monitor.stat_add("serving.padded_rows", target - rows)
-        monitor.stat_observe("serving.batch_occupancy", rows / target)
-        monitor.stat_observe("serving.requests_per_batch", len(batch))
+        self._madd("batches")
+        self._madd("rows", rows)
+        self._madd("padded_rows", target - rows)
+        self._mobs("batch_occupancy", rows / target)
+        self._mobs("requests_per_batch", len(batch))
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -460,7 +512,7 @@ class InferenceEngine:
                 for r in batch:
                     _safe_set_exception(r.future, e)
                 self._c["failed"] += len(batch)
-                monitor.stat_add("serving.failed", len(batch))
+                self._madd("failed", len(batch))
             finally:
                 with self._cv:
                     self._inflight = False
@@ -594,6 +646,7 @@ class InferenceEngine:
         variants = self._pred.num_compiled_variants()
         return {
             "state": state,
+            "engine": self.name,
             "queue_depth": queue_depth,
             "queued_rows": queued_rows,
             "inflight": inflight,

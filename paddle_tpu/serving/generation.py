@@ -60,7 +60,8 @@ from ..core import flags, obs_hook
 from ..testing import fault
 from ..utils import monitor
 from .engine import (DeadlineExceeded, EngineClosed, QueueFull,
-                     ServingError, _REQUEST_IDS, _safe_set_exception,
+                     ServingError, _REQUEST_IDS, _mirrored_add,
+                     _mirrored_observe, _safe_set_exception,
                      _safe_set_result)
 from .kv_cache import KVCacheConfig, PagePool, pages_needed
 
@@ -177,6 +178,10 @@ class GenerationEngine:
             executable itself is NOT replayed under donation (the
             inputs may be invalidated) — the in-flight batch is failed
             and the pool rebuilt instead.
+        name: engine label for multi-model processes (same contract as
+            ``InferenceEngine``): monitor stats mirror under
+            ``serving.engine.<name>.decode.*``, tracer events carry
+            it, and the HTTP layer labels the Prometheus gauges.
     """
 
     def __init__(self, model, num_slots: int = 8, page_size: int = 16,
@@ -186,10 +191,14 @@ class GenerationEngine:
                  max_queue: int = 256,
                  default_deadline_ms: Optional[float] = None,
                  decode_retries: Optional[int] = None,
-                 donate_kv: bool = True):
+                 donate_kv: bool = True,
+                 name: Optional[str] = None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         self._model = model
+        self.name = str(name) if name else None
+        self._stat_prefix = (f"serving.engine.{self.name}.decode."
+                             if self.name else None)
         self._slots_n = int(num_slots)
         cfg = KVCacheConfig(
             num_layers=model.num_layers, num_kv_heads=model.num_kv_heads,
@@ -309,7 +318,7 @@ class GenerationEngine:
                 self._expire_queued_locked()
             if len(self._queue) >= self._max_queue:
                 self._c["shed"] += 1
-                monitor.stat_add("serving.decode.shed")
+                self._madd("shed")
                 self._emit("gen_shed", sid=seq.sid)
                 raise QueueFull(
                     f"generation queue full ({self._max_queue}); retry "
@@ -318,7 +327,7 @@ class GenerationEngine:
             if seq.deadline is not None:
                 self._queued_deadlines += 1
             self._c["requests"] += 1
-            monitor.stat_add("serving.decode.requests")
+            self._madd("requests")
             self._cv.notify_all()
         self._emit("gen_enqueue", sid=seq.sid, prompt=int(prompt.size),
                    max_new=max_new)
@@ -333,7 +342,19 @@ class GenerationEngine:
     def _emit(self, name: str, **args) -> None:
         trc = obs_hook._tracer
         if trc is not None:
+            if self.name is not None:
+                args["engine"] = self.name
             trc.emit("serving", name, args=args)
+
+    def _madd(self, suffix: str, v=1) -> None:
+        """Count ``serving.decode.<suffix>`` — mirrored under this
+        engine's ``serving.engine.<name>.decode.`` prefix when
+        labelled (the multi-model registry's per-engine view)."""
+        _mirrored_add("serving.decode.", self._stat_prefix, suffix, v)
+
+    def _mobs(self, suffix: str, v) -> None:
+        _mirrored_observe("serving.decode.", self._stat_prefix,
+                          suffix, v)
 
     # -- compiled entry points ---------------------------------------------
     def _select_tokens(self, logits, temps, keys):
@@ -434,7 +455,7 @@ class GenerationEngine:
             if s.deadline is not None and now > s.deadline:
                 self._queued_deadlines -= 1
                 self._c["deadline_expired"] += 1
-                monitor.stat_add("serving.decode.deadline_expired")
+                self._madd("deadline_expired")
                 self._emit("gen_deadline_expired", sid=s.sid, where="queue")
                 s.stream._fail(DeadlineExceeded(
                     f"deadline expired after "
@@ -481,7 +502,7 @@ class GenerationEngine:
             admitted.append(head)
             self._c["admitted"] += 1
             self._c["pages_allocated"] += need
-            monitor.stat_add("serving.decode.admitted")
+            self._madd("admitted")
         return admitted
 
     def _evict_locked(self, seq: _Sequence) -> None:
@@ -512,17 +533,17 @@ class GenerationEngine:
                 self._c["failed"] += 1
         if exc is None:
             seq.stream._finish(seq.tokens, reason)
-            monitor.stat_add("serving.decode.finished")
+            self._madd("finished")
             lat = (now - seq.t_enq) * 1000.0
             self._reg.observe("latency_ms", lat)
-            monitor.stat_observe("serving.decode.latency_ms", lat)
+            self._mobs("latency_ms", lat)
             if seq.t_first is not None and len(seq.tokens) > 1:
                 tpot = ((now - seq.t_first) * 1000.0
                         / (len(seq.tokens) - 1))
                 self._reg.observe("tpot_ms", tpot)
         else:
             seq.stream._fail(exc, reason)
-            monitor.stat_add("serving.decode.failed")
+            self._madd("failed")
         self._emit("gen_finish", sid=seq.sid, reason=reason,
                    tokens=len(seq.tokens))
 
@@ -532,8 +553,7 @@ class GenerationEngine:
         if seq.t_first is None:
             seq.t_first = now
             self._reg.observe("ttft_ms", (now - seq.t_enq) * 1000.0)
-            monitor.stat_observe("serving.decode.ttft_ms",
-                                 (now - seq.t_enq) * 1000.0)
+            self._mobs("ttft_ms", (now - seq.t_enq) * 1000.0)
         seq.tokens.append(tok)
         seq.last_token = tok
         seq.stream._push(tok)
@@ -570,22 +590,22 @@ class GenerationEngine:
             except Exception as e:      # pre-dispatch: always retryable
                 last = e
                 self._c["decode_errors"] += 1
-                monitor.stat_add("serving.decode.errors")
+                self._madd("errors")
                 if attempt < self._retries:
                     self._c["decode_retries"] += 1
-                    monitor.stat_add("serving.decode.retries")
+                    self._madd("retries")
                 continue
             try:
                 return ex(*args)
             except Exception as e:
                 last = e
                 self._c["decode_errors"] += 1
-                monitor.stat_add("serving.decode.errors")
+                self._madd("errors")
                 if self._donate:
                     break               # donated inputs may be dead
                 if attempt < self._retries:
                     self._c["decode_retries"] += 1
-                    monitor.stat_add("serving.decode.retries")
+                    self._madd("retries")
         raise GenerationError(
             f"{kind} failed after {self._retries + 1} attempts: "
             f"{type(last).__name__}: {last}") from last
@@ -622,10 +642,9 @@ class GenerationEngine:
         self._pool.kv = (k_pool, v_pool)
         self._c["prefills"] += 1
         self._c["prefill_tokens"] += int(seq.prompt.size)
-        monitor.stat_add("serving.decode.prefills")
-        monitor.stat_add("serving.decode.prefill_tokens",
-                         int(seq.prompt.size))
-        monitor.stat_add("serving.decode.tokens")
+        self._madd("prefills")
+        self._madd("prefill_tokens", int(seq.prompt.size))
+        self._madd("tokens")
         self._emit("gen_prefill", sid=seq.sid, bucket=bucket,
                    dur_ms=(time.perf_counter() - t0) * 1000.0)
         seq.position = int(seq.prompt.size) + 1
@@ -680,19 +699,24 @@ class GenerationEngine:
         occ = len(active) / S
         self._c["decode_steps"] += 1
         self._occ_sum += occ
-        monitor.stat_add("serving.decode.steps")
-        monitor.stat_observe("serving.decode.ctx_pages", p_b)
-        monitor.stat_observe("serving.decode.slot_occupancy", occ)
-        monitor.stat_observe("serving.decode.page_util",
-                             self._pool.utilization())
-        self._reg.observe("step_ms", (time.perf_counter() - t0) * 1000.0)
+        self._madd("steps")
+        self._mobs("ctx_pages", p_b)
+        self._mobs("slot_occupancy", occ)
+        self._mobs("page_util", self._pool.utilization())
+        step_s = time.perf_counter() - t0
+        self._reg.observe("step_ms", step_s * 1000.0)
+        self._mobs("step_ms", step_s * 1000.0)
+        # perf observatory: decode anatomy + memory sampler cadence
+        p = obs_hook._perf
+        if p is not None:
+            p.serving_step(self.name, "decode_step", step_s)
         emitted = 0
         now = time.monotonic()
         for s in active:
             if s.deadline is not None and now > s.deadline:
                 # mid-generation expiry: evict, free pages, fail cleanly
                 self._c["deadline_expired"] += 1
-                monitor.stat_add("serving.decode.deadline_expired")
+                self._madd("deadline_expired")
                 self._emit("gen_deadline_expired", sid=s.sid,
                            where="decode")
                 self._finish(s, "deadline", DeadlineExceeded(
@@ -703,7 +727,7 @@ class GenerationEngine:
             self._emit_token(s, int(toks[s.slot]))
             emitted += 1
         if emitted:
-            monitor.stat_add("serving.decode.tokens", emitted)
+            self._madd("tokens", emitted)
 
     def _loop(self) -> None:
         while True:
@@ -839,6 +863,7 @@ class GenerationEngine:
         decode_toks = c.get("tokens", 0)
         return {
             "state": state,
+            "engine": self.name,
             "queue_depth": queue_depth,
             "num_slots": self._slots_n,
             "active_slots": active,

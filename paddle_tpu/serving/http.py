@@ -23,6 +23,14 @@ dispatcher coalesces into micro-batches):
   line (errors mid-stream arrive in-band as an ``{"error": ...}``
   line).  ``stream: false`` returns one JSON object at the end.
 - ``GET /healthz`` — 200 while serving, 503 when draining/closed.
+  With an SLO monitor installed (``observability.install_slo_monitor``)
+  each probe also polls the rule set: any breached burn-rate rule
+  degrades the reply to 503 with ``{"status": "degraded", "slo":
+  {reasons...}}`` while the engine itself keeps serving — the
+  load-balancer sees the objective, not just liveness — and the
+  endpoint recovers to 200 as soon as the rolling windows clear.
+- ``GET /perf`` — the runtime performance observatory's drift report
+  (``observability.perf_report``) plus the last SLO evaluation.
 - ``GET /metrics`` — content-negotiated.  Default (and any JSON
   Accept): the engine's stats JSON — queue depth, batch occupancy,
   padding waste, request/shed/deadline counters, latency p50/p95/p99.
@@ -47,12 +55,24 @@ from urllib.parse import urlsplit
 
 import numpy as np
 
+from ..observability import perf as _perf, slo as _slo
 from .engine import (DeadlineExceeded, EngineClosed, InferenceEngine,
                      QueueFull, ServingError)
 
 __all__ = ["ServingServer", "Client", "serve"]
 
 _NPY = "application/x-npy"
+
+
+def _engine_label(name) -> str:
+    """``{engine="<name>"}`` with the value escaped per the Prometheus
+    text format (backslash, quote, newline) — an engine name is an
+    arbitrary user string and must not break the scrape."""
+    if not name:
+        return ""
+    v = (str(name).replace("\\", r"\\").replace('"', r'\"')
+         .replace("\n", r"\n"))
+    return f'{{engine="{v}"}}'
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -123,8 +143,28 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/healthz":
             src = self.engine if self.engine is not None else self.generation
             st = src.stats()["state"] if src is not None else "empty"
-            self._reply_json(200 if st in ("running", "paused") else 503,
-                             {"status": st})
+            if st not in ("running", "paused"):
+                self._reply_json(503, {"status": st})
+                return
+            # liveness is fine; with an SLO monitor installed the probe
+            # also polls the objectives — any breached burn-rate rule
+            # degrades the reply to 503 with the reasons (the load
+            # balancer sees the objective, not just liveness) and the
+            # endpoint recovers to 200 as soon as the windows clear
+            slo = _slo.slo_status()
+            if slo.get("status") == "degraded":
+                self._reply_json(503, {
+                    "status": "degraded", "engine_state": st,
+                    "slo": {"breached": slo.get("breached", []),
+                            "reasons": slo.get("reasons", [])}})
+            else:
+                body = {"status": st}
+                if slo.get("installed"):
+                    body["slo"] = "ok"
+                self._reply_json(200, body)
+        elif path == "/perf":
+            self._reply_json(200, {"perf": _perf.perf_report(),
+                                   "slo": _slo.slo_status(poll=False)})
         elif path == "/metrics":
             accept = (self.headers.get("Accept") or "").lower()
             stats = (self.engine.stats() if self.engine is not None
@@ -135,19 +175,27 @@ class _Handler(BaseHTTPRequestHandler):
             if ("text/plain" in accept or "openmetrics" in accept
                     or "prometheus" in accept):
                 from ..observability import prometheus_text
-                gauges = {f"serving_engine_{k}": v
+                # a named engine labels its gauges
+                # (paddle_tpu_serving_engine_*{engine="<name>"}) so a
+                # multi-model scrape can tell its engines apart
+                ename = (getattr(self.engine, "name", None)
+                         if self.engine is not None else None)
+                lab = _engine_label(ename)
+                gauges = {f"serving_engine_{k}{lab}": v
                           for k, v in stats.items()
                           if isinstance(v, (int, float))}
-                gauges.update({f"serving_engine_{k}": v
+                gauges.update({f"serving_engine_{k}{lab}": v
                                for k, v in stats["counters"].items()})
                 if gen is not None:
                     gs = stats["generation"]
-                    gauges.update({f"serving_decode_{k}": v
+                    gname = getattr(gen, "name", None)
+                    glab = _engine_label(gname)
+                    gauges.update({f"serving_decode_{k}{glab}": v
                                    for k, v in gs.items()
                                    if isinstance(v, (int, float))})
-                    gauges.update({f"serving_decode_{k}": v
+                    gauges.update({f"serving_decode_{k}{glab}": v
                                    for k, v in gs["counters"].items()})
-                    gauges.update({f"serving_decode_pages_{k}": v
+                    gauges.update({f"serving_decode_pages_{k}{glab}": v
                                    for k, v in gs["page_pool"].items()})
                 self._reply(200, prometheus_text(gauges).encode(),
                             ctype="text/plain; version=0.0.4; "
@@ -495,6 +543,10 @@ class Client:
 
     def metrics(self) -> dict:
         return self._get_json("/metrics")
+
+    def perf(self) -> dict:
+        """The server's ``/perf`` drift report + last SLO evaluation."""
+        return self._get_json("/perf")
 
     def metrics_text(self) -> str:
         """Prometheus text exposition (the scraper's view of /metrics)."""

@@ -764,16 +764,59 @@ def analyze(program: Program, fetch_list: Optional[Sequence] = None,
     return rep
 
 
+def resolve_perf_chip() -> str:
+    """The ``CHIP_SPECS`` key runtime predictions are priced against:
+    ``FLAGS_perf_chip`` when set to a known spec, else auto-detected
+    from the jax backend (``cpu`` on CPU, ``v5e`` on TPU).  The single
+    policy both ``compile_summary`` and the perf observatory's drift
+    fallback use — one place to extend when a backend is added."""
+    from ...core.flags import get_flag
+    chip = get_flag("perf_chip")
+    if chip:
+        if chip in CHIP_SPECS:
+            return chip
+        import warnings
+        warnings.warn(
+            f"FLAGS_perf_chip={chip!r} is not a known chip spec "
+            f"(choose from {sorted(CHIP_SPECS)}); falling back to "
+            f"backend auto-detection — drift predictions will be "
+            f"priced against the wrong roofline otherwise silently",
+            RuntimeWarning)
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001
+        return "cpu"
+    if backend == "cpu":
+        return "cpu"
+    if backend == "tpu":
+        return "v5e"
+    import warnings
+    warnings.warn(
+        f"no roofline chip spec for jax backend {backend!r}; pricing "
+        f"predictions against 'cpu' — set FLAGS_perf_chip to a "
+        f"CHIP_SPECS key to choose explicitly", RuntimeWarning)
+    return "cpu"
+
+
 def compile_summary(program: Program, donate: bool = True,
                     sharding=None) -> Optional[dict]:
     """The light, always-on slice the Executor records per compile:
     predicted FLOPs per step + peak bytes from the recorded avals (no
-    re-derivation, no hazard passes).  With a ``sharding`` plan the
-    summary also carries ``peak_bytes_per_shard`` — what one chip
-    actually holds.  Returns None instead of raising — a cost-model
-    gap must never break a compile."""
+    re-derivation, no hazard passes), plus the roofline's predicted
+    step time for the chip this process is actually running on
+    (``FLAGS_perf_chip``, auto-detected backend by default) — the
+    number the perf observatory's drift tracker compares measured
+    steps against.  With a ``sharding`` plan the summary also carries
+    ``peak_bytes_per_shard`` — what one chip actually holds.  Returns
+    None instead of raising — a cost-model gap must never break a
+    compile."""
     try:
-        rep = analyze(program, include_hazards=False, chip="cpu",
+        # inside the try: resolve_perf_chip warns on a misconfigured
+        # flag/backend, and under warnings-as-errors (pytest/CI -W
+        # error) that warning RAISES — it must not break a compile
+        chip = resolve_perf_chip()
+        rep = analyze(program, include_hazards=False, chip=chip,
                       top_k=0, sharding=sharding)
     except Exception:  # noqa: BLE001 - prediction is best-effort
         return None
@@ -786,6 +829,8 @@ def compile_summary(program: Program, donate: bool = True,
         "flops_fwd": t["flops_fwd"],
         "peak_bytes": peak,
         "min_traffic_bytes": t["min_traffic_bytes"],
+        "chip": chip,
+        "predicted_step_s": rep.roofline[chip]["predicted_step_s"],
         "unmodeled_ops": t["unmodeled"]["count"],
         "unmodeled_bytes": t["unmodeled"]["bytes"],
     }

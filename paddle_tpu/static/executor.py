@@ -36,6 +36,7 @@ lazy ``Parameter.data`` resolution above).
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from typing import Dict, List, Optional, Sequence
 
@@ -612,7 +613,12 @@ class Executor:
         params = program.parameters()
         feed_items = sorted(feed.items())
         feed_names = tuple(n for n, _ in feed_items)
+        # perf observatory (one module-attribute None-check when off):
+        # host-side anatomy stamps around feed conversion and dispatch
+        perf = obs_hook._perf
+        t_h0 = time.perf_counter() if perf is not None else 0.0
         feed_arrays = [self._feed_array(a) for _, a in feed_items]
+        t_h1 = time.perf_counter() if perf is not None else 0.0
 
         self._track(program)
         donate = bool(get_flag("static_donate"))
@@ -631,6 +637,7 @@ class Executor:
                tuple(fetch_names), program._optimizer is not None, donate,
                None if plan is None else plan.fingerprint())
         compiled = self._cache.get(key)
+        compiled_this_run = compiled is None
         if compiled is None:
             # recompile for a NEW version: executables for older
             # versions of this program can never be requested again
@@ -673,6 +680,22 @@ class Executor:
                                  predicted["flops"])
                 monitor.stat_set("predicted.executor.peak_bytes",
                                  predicted["peak_bytes"])
+            # the prediction rides the executable too: cache-hit runs
+            # hand it to the perf observatory's drift tracker.  The
+            # drift identity is per EXECUTABLE, not per program — two
+            # feed signatures of one program are different cache
+            # entries with different predictions, and mixing their
+            # step times in one rolling window would make the drift
+            # number compare shape A's measurement against shape B's
+            # prediction (the crc tail separates fetch/donate/plan
+            # variants the readable prefix doesn't show)
+            import zlib
+            shapes = ";".join("x".join(map(str, a.shape))
+                              for a in feed_arrays)
+            compiled._predicted = predicted
+            compiled._perf_identity = (
+                f"{program._serial}v{program._version}[{shapes}]"
+                f"#{zlib.crc32(repr(key).encode()) & 0xffffff:06x}")
             # recompile attribution: the first changed field (most
             # significant first) names the cause of this compile
             from ..observability import record_compile
@@ -746,6 +769,7 @@ class Executor:
                              jnp.asarray(int(seed), jnp.int32))
             if donate:
                 state.shield_escaped()
+            t_d0 = time.perf_counter() if perf is not None else 0.0
             fetches, new_p, new_s, new_aux = compiled(
                 state.p_arrays, state.opt_state, state.aux,
                 state.lr_device, state.base_key, *seed_args, *feed_arrays)
@@ -755,7 +779,21 @@ class Executor:
         else:
             rng_key = jax.random.fold_in(
                 state.base_key, run_i if seed is None else int(seed))
+            t_d0 = time.perf_counter() if perf is not None else 0.0
             fetches = compiled(state.p_arrays, rng_key, *feed_arrays)
+
+        # step anatomy: host lane every run, device fence + memory
+        # sample on the observatory's cadence.  The run that compiled
+        # is excluded — its dispatch wall is compile time, which the
+        # attribution layer already accounts for and would poison the
+        # step-time distribution by orders of magnitude.
+        if perf is not None and not compiled_this_run:
+            perf.step("executor",
+                      getattr(compiled, "_perf_identity",
+                              program._serial),
+                      t_h0, t_h1 - t_h0,
+                      t_d0, time.perf_counter() - t_d0, fetches,
+                      predicted=getattr(compiled, "_predicted", None))
 
         if return_numpy:
             return [np.asarray(f) for f in fetches]

@@ -14,7 +14,8 @@ from typing import Dict, List, Union
 
 __all__ = ["StatRegistry", "get_stat", "stat_add", "stat_set",
            "stat_reset", "all_stats", "stat_observe", "quantile",
-           "histogram_summary", "all_histograms"]
+           "histogram_summary", "all_histograms", "histogram_raw",
+           "quantile_from_counts"]
 
 # Histogram bucket layout: log-spaced, 8 buckets per decade covering
 # [1e-3, 1e7) — sub-microsecond to ~3 hours when observing milliseconds.
@@ -87,6 +88,16 @@ class _Histogram:
                 return min(max(est, self.vmin), self.vmax)
         return self.vmax
 
+    def raw(self) -> Dict[str, object]:
+        """Cumulative bucket counts + exact aggregates — the windowable
+        view: two raws taken at different times subtract bucket-wise
+        into the distribution of the interval between them (the SLO
+        monitor's rolling-window quantiles are built on this)."""
+        return {"counts": tuple(self.counts), "count": self.n,
+                "sum": self.total,
+                "min": self.vmin if self.n else 0.0,
+                "max": self.vmax if self.n else 0.0}
+
     def summary(self) -> Dict[str, float]:
         return {
             "count": self.n,
@@ -150,6 +161,12 @@ class StatRegistry:
             h = self._hists.get(name)
             return h.summary() if h is not None else _Histogram().summary()
 
+    def histogram_raw(self, name: str):
+        """Cumulative bucket counts for ``name`` (None if unobserved)."""
+        with self._lock:
+            h = self._hists.get(name)
+            return h.raw() if h is not None else None
+
     def histograms(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {k: h.summary() for k, h in self._hists.items()}
@@ -195,3 +212,45 @@ def histogram_summary(name):
 
 def all_histograms():
     return _default.histograms()
+
+
+def histogram_raw(name):
+    """Cumulative bucket counts/aggregates for histogram ``name``
+    (None if it was never observed) — the subtractable view rolling
+    windows are computed from."""
+    return _default.histogram_raw(name)
+
+
+def quantile_from_counts(counts, n: int, q: float,
+                         vmin=None, vmax=None) -> float:
+    """q-quantile of a raw bucket-count vector (e.g. the difference of
+    two :func:`histogram_raw` snapshots).  Same rank-linear
+    interpolation as :meth:`_Histogram.quantile`; a windowed delta has
+    no per-window extremes, but the histogram's CUMULATIVE min/max
+    (``raw()['min']/['max']``) always bound any window's values —
+    pass them as ``vmin``/``vmax`` so the estimate can't overshoot
+    the true extreme by a bucket width (an un-clamped p99 can read up
+    to ~1.33x the largest value ever observed and falsely breach an
+    SLO the service is actually inside)."""
+    if n <= 0:
+        return 0.0
+    rank = min(max(q, 0.0), 1.0) * n
+    seen = 0
+    last = 0
+    est = None
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        last = i
+        prev, seen = seen, seen + c
+        if seen >= rank:
+            lo, hi = _bucket_bounds(i)
+            est = lo + (hi - lo) * (max(rank - prev, 0.0) / c)
+            break
+    if est is None:
+        est = _bucket_bounds(last)[1]
+    if vmax is not None:
+        est = min(est, vmax)
+    if vmin is not None:
+        est = max(est, vmin)
+    return est

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Drift-report CLI for the runtime performance observatory.
+
+`observability.perf_report()` is the in-process view; this CLI renders
+the same report from wherever it was persisted or is being served:
+
+- a **flight-recorder dump** (the ``perf`` block every black box
+  embeds when the observatory was live at crash time),
+- a **metrics JSONL** file (``observability.dump_metrics`` /
+  ``hapi.callbacks.MetricsDump`` lines — the last line carrying a
+  ``perf`` block wins by default, or ``--line N`` picks a literal
+  line index, negatives Python-style: ``--line -1`` is the actual
+  last line even when it has no perf block),
+- a **live serving server** (``GET /perf`` on the HTTP front-end).
+
+Each source also carries the SLO evaluation taken at the same moment,
+which is printed below the drift table (``--json`` emits the raw
+report object instead).
+
+Usage:
+  python tools/perf_report.py flight_record.json
+  python tools/perf_report.py metrics.jsonl [--line N]
+  python tools/perf_report.py http://127.0.0.1:8000
+  python tools/perf_report.py ... --json
+
+Exit status: 1 when the source carries no perf block (observatory was
+never enabled) or any SLO rule is breached in the embedded evaluation,
+else 0.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _load(source: str, line) -> dict:
+    """-> {"perf": report|None, "slo": status|None} from any source."""
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+        url = source.rstrip("/") + "/perf"
+        with urllib.request.urlopen(url, timeout=10.0) as r:
+            return json.load(r)
+    with open(source) as f:
+        text = f.read()
+    try:                            # JSONL (flight dumps are one line
+        rows = [json.loads(ln)      # of JSON, so they parse here too)
+                for ln in text.splitlines() if ln.strip()]
+    except json.JSONDecodeError:    # pretty-printed single document
+        rows = [json.loads(text)]
+    if not rows:
+        raise SystemExit(f"{source}: empty JSONL")
+    if line is not None:            # explicit index, -1 = literal last
+        try:
+            row = rows[line]
+        except IndexError:
+            raise SystemExit(f"{source}: --line {line} out of range "
+                             f"({len(rows)} lines)")
+    else:                           # last line with a perf block, else last
+        row = next((r for r in reversed(rows) if r.get("perf")), rows[-1])
+    return {"perf": row.get("perf"), "slo": row.get("slo")}
+
+
+def _render_slo(slo) -> str:
+    if not slo:
+        return "slo: no monitor installed"
+    lines = [f"slo: {slo.get('status', '?')}"]
+    for r in slo.get("rules", []):
+        m = r.get("measured")       # non-finite values arrive as the
+        b = r.get("burn", 0.0)      # JSON-safe string "inf"
+        lines.append(
+            f"  {r['name']}: measured "
+            f"{'n/a' if m is None else m if isinstance(m, str) else round(m, 3)} "
+            f"vs objective {r['objective']} over {r['window']}s "
+            f"(burn {b if isinstance(b, str) else format(b, '.2f')}x"
+            f"{', BREACHED' if r.get('breached') else ''})")
+    for reason in slo.get("reasons", []):
+        lines.append(f"  ! {reason}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render the perf observatory's predicted-vs-"
+                    "measured drift report from a flight dump, a "
+                    "metrics JSONL, or a live server URL")
+    ap.add_argument("source",
+                    help="flight_record.json | metrics.jsonl | "
+                         "http://host:port")
+    ap.add_argument("--line", type=int, default=None,
+                    help="JSONL line index to render (negatives "
+                         "Python-style; default: the last line "
+                         "carrying a perf block)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report object, not text")
+    args = ap.parse_args(argv)
+
+    payload = _load(args.source, args.line)
+    rep, slo = payload.get("perf"), payload.get("slo")
+    # a live /perf with the observatory off answers {"enabled": false}
+    # — that is "no report" for the exit contract, or a CI gate built
+    # on this code silently passes with the observatory disabled
+    has_rep = bool(rep) and bool(rep.get("enabled"))
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+    else:
+        from paddle_tpu.observability import render_perf_report
+        if has_rep:
+            print(render_perf_report(rep))
+        else:
+            print("perf observatory: no report in source (was "
+                  "observability.enable_perf() on?)")
+        print(_render_slo(slo))
+    breached = bool(slo and slo.get("breached"))
+    return 1 if (not has_rep or breached) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
