@@ -994,6 +994,37 @@ def bench_lenet_dygraph(args):
     return res
 
 
+def bench_multichip(args):
+    """Multichip GPT-tiny collective-efficiency run (ISSUE 10 gate):
+    tools/comm_smoke.py on 8 virtual CPU devices in a subprocess (this
+    process's jax is already initialised with its own device count),
+    comparing int8 block-scaled grad_comm against the fp32 wire
+    baseline — wire bytes/step (measured == cost-model prediction),
+    loss-trajectory parity under error feedback, recompiles."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "comm_smoke.py"), "--json"]
+    if args.steps:
+        cmd += ["--steps", str(args.steps)]
+    try:
+        out = subprocess.run(cmd, env=env, capture_output=True,
+                             text=True, timeout=600)
+        line = out.stdout.strip().splitlines()[-1]
+        res = json.loads(line)
+        if out.returncode != 0:
+            res["gate_failures"] = out.stderr.strip().splitlines()[-5:]
+    except Exception as e:  # pragma: no cover - defensive
+        return {"metric": "multichip_gpt_int8_wire_ratio_vs_fp32",
+                "error": f"{type(e).__name__}: {e}"}
+    res.update({"platform": "cpu", "devices": 8, "mesh": {"dp": 8}})
+    return res
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=None)
@@ -1004,7 +1035,7 @@ def main():
                     help="force the tiny CPU config")
     ap.add_argument("--suite", type=str, default="all",
                     choices=["all", "bert", "gpt", "resnet", "lenet",
-                             "static", "serving"],
+                             "static", "serving", "multichip"],
                     help="which benchmarks to run (default: all)")
     args = ap.parse_args()
 
@@ -1051,6 +1082,8 @@ def main():
             extra["serving_generation"] = {
                 "metric": "serving_generation_tokens_per_sec",
                 "error": f"{type(e).__name__}: {e}"}
+    if args.suite in ("all", "multichip"):
+        extra["multichip"] = bench_multichip(args)
     if args.suite in ("all", "lenet"):
         extra["lenet_dygraph"] = bench_lenet_dygraph(args)
 
@@ -1064,7 +1097,7 @@ def main():
         # never exit non-zero without a JSON line: promote the first
         # successful secondary result (round-4 lesson — rc=1 loses the
         # round's perf evidence entirely)
-        for k in ("gpt", "resnet50", "static", "serving",
+        for k in ("gpt", "resnet50", "static", "serving", "multichip",
                   "lenet_dygraph"):
             if k in extra and "error" not in extra[k]:
                 result = extra.pop(k)

@@ -17,7 +17,10 @@ from .mesh import (DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS, axis_size,  # noqa
                    release_mesh_user, set_mesh)
 from .strategy import DistributedStrategy  # noqa: F401
 # `paddle_tpu.distributed.sharding` is the GSPMD sharding subsystem
-# (rule engine, plans, reshardable checkpoint state)
+# (rule engine, plans, reshardable checkpoint state);
+# `paddle_tpu.distributed.grad_comm` is the quantized/bucketed
+# gradient-collective stage (strategy.grad_comm knobs)
+from . import grad_comm  # noqa: F401
 from . import sharding  # noqa: F401
 from .sharding import (ShardedState, ShardingPlan,  # noqa: F401
                        SpecLayout, gather_tree, match_partition_rules,

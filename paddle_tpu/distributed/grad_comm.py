@@ -1,0 +1,521 @@
+"""Quantized, bucketed gradient collectives with error feedback.
+
+The gradient-communication stage the sharded static Executor (and
+``SpmdTrainStep``) lowers in-graph between backward and the optimizer
+update — ROADMAP item 2, after EQuARX (block-scaled quantized AllReduce
+inside XLA) and T3 (compute-collective overlap via bucketing):
+
+- **Quantized reduction** — gradients cross the wire as block-scaled
+  int8 (one f32 absmax scale per ``block_size`` elements) or bf16
+  instead of fp32.  The int8 route is the two-shot bandwidth algorithm:
+  each device quantizes its local (residual-corrected) gradient,
+  ``all_to_all`` exchanges int8 chunks + scales, every device
+  dequantizes and sums its chunk in f32, requantizes, and an
+  ``all_gather`` of int8 chunks + scales rebuilds the reduced tensor —
+  both directions carry quantized payload, so wire bytes are ~1/4 of a
+  fp32 ring allreduce (+ scale overhead).
+- **Error feedback** — the quantization error each device incurs
+  (local quantize error, plus the requantize error on the chunk it
+  owns) is returned as a per-device residual and added back into the
+  next step's gradient before quantization, so the *sum* of applied
+  updates tracks the sum of true gradients and the loss trajectory
+  stays at parity with fp32 collectives.  The residual is
+  device-varying state; the static Executor carries it in the donated
+  ``_ExecState`` aux tree (sharded ``[dp, numel]``).
+- **Bucketing** — small gradients fuse into flat buckets of
+  ``strategy.fuse_grad_size_in_MB``, assembled in *backward production
+  order* (the reverse of parameter creation order: the last layer's
+  grads exist first).  Each bucket is reduced by its own independent
+  collective, so XLA's latency-hiding scheduler can overlap the
+  reduction of bucket N with the backward computation producing bucket
+  N-1's gradients — one monolithic post-backward reduction would be a
+  barrier (the reference Reducer's design, reducer.cc, in-graph).
+- **Algorithm selection by message size** — buckets whose quantized
+  payload is at least ``scatter_threshold_KB`` take the
+  bandwidth-optimal scatter route (``psum_scatter``+``all_gather``, or
+  the int8 two-shot above); smaller latency-bound buckets take one
+  fused ``psum`` (at bf16 wire when the config asks for int8 — a
+  single-shot int8 psum cannot sum payloads carrying per-device
+  scales).  Every choice is recorded on the plan and surfaced through
+  ``comm.*`` monitor stats and the static cost model.
+
+Everything here is shape-static: :func:`plan_reduction` computes the
+buckets, algorithms and exact per-device wire bytes from gradient
+shapes alone, so the cost model's prediction and the runtime's
+``comm.wire_bytes`` stat are the *same number* by construction.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .mesh import DP_AXIS
+
+__all__ = [
+    "CommSpec", "Bucket", "GradCommPlan", "resolve", "plan_reduction",
+    "build_buckets", "flatten_bucket", "unflatten_bucket",
+    "quantize_int8_blocks", "dequantize_int8_blocks", "reduce_gradients",
+    "source_label", "incompatibility", "plan_status",
+]
+
+_WIRE_ITEMSIZE = {"fp32": 4, "bf16": 2, "int8": 1}
+_SCALE_BYTES = 4  # one f32 absmax per block
+
+
+# ---------------------------------------------------------------------------
+# config resolution
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CommSpec:
+    """Resolved, hashable grad-comm configuration (strategy knobs +
+    bucket size + which toggle asked for it)."""
+    dtype: str                    # 'fp32' | 'bf16' | 'int8'
+    block_size: int
+    error_feedback: bool
+    scatter_threshold_KB: float
+    fuse_grad_size_in_MB: float
+    source: str                   # 'grad_comm' | 'fp16_allreduce'
+
+    def fingerprint(self) -> tuple:
+        return (self.dtype, self.block_size, self.error_feedback,
+                float(self.scatter_threshold_KB),
+                float(self.fuse_grad_size_in_MB))
+
+
+def resolve(strategy) -> Optional[CommSpec]:
+    """The effective grad-comm spec of a DistributedStrategy, or None
+    when gradient reduction stays with GSPMD's default lowering.
+
+    ``strategy.grad_comm.dtype`` wins; ``strategy.fp16_allreduce`` is
+    the backward-compatible alias for a bf16 wire (without error
+    feedback — the historical semantics of the bf16 psum graft)."""
+    if strategy is None:
+        return None
+    gc = getattr(strategy, "grad_comm", None)
+    fuse = float(getattr(strategy, "fuse_grad_size_in_MB", 32) or 32)
+    if gc is not None and gc.dtype is not None:
+        return CommSpec(str(gc.dtype), int(gc.block_size),
+                        bool(gc.error_feedback),
+                        float(gc.scatter_threshold_KB), fuse, "grad_comm")
+    if getattr(strategy, "fp16_allreduce", False):
+        block = int(gc.block_size) if gc is not None else 256
+        thresh = (float(gc.scatter_threshold_KB) if gc is not None
+                  else 32.0)
+        return CommSpec("bf16", block, False, thresh, fuse,
+                        "fp16_allreduce")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# activation / compatibility (ONE predicate for every consumer)
+# ---------------------------------------------------------------------------
+
+def source_label(cfg: CommSpec) -> str:
+    """The user-facing name of whichever toggle asked for the stage."""
+    return ("strategy.fp16_allreduce" if cfg.source == "fp16_allreduce"
+            else f'strategy.grad_comm (dtype="{cfg.dtype}")')
+
+
+def incompatibility(cfg: CommSpec, mesh_shape,
+                    sharded_params: Sequence[str] = ()) -> Optional[str]:
+    """Why the explicit shard_map reduction cannot run on this mesh /
+    param layout, or None when it can.  The single source of the
+    constraint messages — SpmdTrainStep, the Executor and the cost
+    model all consult this, so they cannot drift apart."""
+    src = source_label(cfg)
+    others = [a for a, s in dict(mesh_shape).items()
+              if a != DP_AXIS and s > 1]
+    if others:
+        return (f"{src} covers the data-parallel grad reduction; mesh "
+                f"axes {others} carry model shardings whose collectives "
+                f"GSPMD schedules — run it on a pure-dp mesh.")
+    sharded = list(sharded_params)
+    if sharded:
+        return (f"{src} + dp-sharded params (ZeRO-3 / partition rules: "
+                f"{sharded[:4]}): the explicit shard_map grad path "
+                f"would replicate them.  Keep params replicated (ZeRO "
+                f"stage <= 2) with it.")
+    return None
+
+
+def plan_status(plan) -> Tuple[str, Optional[str]]:
+    """Activation state of a ShardingPlan's grad_comm spec:
+    ``('off', None)`` — no spec, or a 1-device dp axis (nothing crosses
+    a wire); ``('active', None)`` — the Executor lowers the stage;
+    ``('error', msg)`` — configured but impossible (the Executor raises
+    ``msg``; the cost model reports it).  Executor and cost model share
+    this predicate so measured and predicted can never disagree about
+    WHICH path runs."""
+    cfg = getattr(plan, "grad_comm", None)
+    if cfg is None:
+        return "off", None
+    if dict(plan.mesh.shape).get(DP_AXIS, 1) <= 1:
+        return "off", None
+    from .sharding import spec_axes
+    sharded = [n for n, s in zip(plan.param_names, plan.param_specs)
+               if spec_axes(s)]
+    msg = incompatibility(cfg, plan.mesh.shape, sharded)
+    if msg is not None:
+        return "error", msg
+    return "active", None
+
+
+# ---------------------------------------------------------------------------
+# block-scaled int8 quantization
+# ---------------------------------------------------------------------------
+
+def quantize_int8_blocks(x, block_size: int):
+    """1-D float array -> (int8 blocks ``[nb, B]``, f32 scales
+    ``[nb, 1]``).  Pads to a block multiple; scale = absmax/127 per
+    block (zero blocks get scale 1 so dequantize is exact zero)."""
+    n = x.shape[0]
+    pad = (-n) % block_size
+    xp = jnp.pad(x.astype(jnp.float32), (0, pad)).reshape(-1, block_size)
+    absmax = jnp.max(jnp.abs(xp), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xp / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_int8_blocks(q, scales, numel: int):
+    """Inverse of :func:`quantize_int8_blocks` (drops the padding)."""
+    return (q.astype(jnp.float32) * scales).reshape(-1)[:numel]
+
+
+# ---------------------------------------------------------------------------
+# buckets + plan
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Bucket:
+    """One fused reduction: which grads it carries (in backward
+    production order), how it crosses the wire, and what that costs."""
+    indices: Tuple[int, ...]      # positions into the grad list
+    shapes: Tuple[tuple, ...]
+    sizes: Tuple[int, ...]        # numels, aligned with indices
+    numel: int
+    algorithm: str                # 'psum' | 'scatter' | 'none'
+    wire_dtype: str               # 'fp32' | 'bf16' | 'int8'
+    wire_bytes: int               # per-device bytes per step
+    collectives: int
+    carries_residual: bool
+
+    @property
+    def classification(self) -> str:
+        return ("none" if self.algorithm == "none"
+                else "bandwidth" if self.algorithm == "scatter"
+                else "latency")
+
+    def to_dict(self) -> dict:
+        return {
+            "params": list(self.indices), "numel": self.numel,
+            "algorithm": self.algorithm, "wire_dtype": self.wire_dtype,
+            "wire_bytes": self.wire_bytes,
+            "collectives": self.collectives,
+            "classification": self.classification,
+            "error_feedback": self.carries_residual,
+        }
+
+
+def build_buckets(shapes: Sequence[tuple], fuse_mb: float
+                  ) -> List[Tuple[Tuple[int, ...], int]]:
+    """Greedy bucket assembly over grads in backward production order
+    (reverse of the given creation order).  Returns ``[(indices,
+    numel)]``; every index appears exactly once, each bucket holds at
+    most ``fuse_mb`` MB of f32 payload (a single grad larger than the
+    budget gets its own bucket)."""
+    budget = max(int(float(fuse_mb) * (1 << 20)) // 4, 1)  # f32 elements
+    out: List[Tuple[Tuple[int, ...], int]] = []
+    cur: List[int] = []
+    cur_n = 0
+    for i in reversed(range(len(shapes))):
+        n = 1
+        for d in shapes[i]:
+            n *= int(d)
+        if cur and cur_n + n > budget:
+            out.append((tuple(cur), cur_n))
+            cur, cur_n = [], 0
+        cur.append(i)
+        cur_n += n
+    if cur:
+        out.append((tuple(cur), cur_n))
+    return out
+
+
+def _padded_numel(numel: int, multiple: int) -> int:
+    return int(math.ceil(numel / multiple)) * multiple if multiple > 1 \
+        else numel
+
+
+def _int8_payload(numel: int, dp: int, block_size: int) -> int:
+    """One direction's int8 wire payload: values padded so each device
+    owns a block-aligned chunk, plus one f32 scale per block.  The ONE
+    formula both the scatter-vs-psum threshold and the wire-byte
+    accounting use — they must agree or the recorded bytes would not
+    match the algorithm actually chosen."""
+    np_ = _padded_numel(numel, dp * block_size)
+    return np_ * 1 + (np_ // block_size) * _SCALE_BYTES
+
+
+def _wire_bytes(numel: int, wire_dtype: str, algorithm: str, dp: int,
+                block_size: int) -> int:
+    """Exact per-device wire bytes of one bucket's reduction under the
+    ring model: an allreduce (or its reduce-scatter + all-gather
+    decomposition) moves ``2*(dp-1)/dp`` of the payload through every
+    device's links per step."""
+    if dp <= 1 or algorithm == "none":
+        return 0
+    ring = 2.0 * (dp - 1) / dp
+    if wire_dtype == "int8":
+        # scatter route: quantized payload + scales ride both directions
+        payload = _int8_payload(numel, dp, block_size)
+    elif algorithm == "scatter":
+        payload = _padded_numel(numel, dp) * _WIRE_ITEMSIZE[wire_dtype]
+    else:
+        payload = numel * _WIRE_ITEMSIZE[wire_dtype]
+    return int(round(ring * payload))
+
+
+class GradCommPlan:
+    """Static reduction plan: buckets, algorithms, wire bytes.
+
+    Built once per compile from gradient shapes; the in-graph
+    :func:`reduce_gradients` follows it exactly, and its byte totals
+    are what the Executor reports as ``comm.wire_bytes`` per step and
+    the cost model reports as ``predicted_wire_bytes``."""
+
+    __slots__ = ("cfg", "dp", "buckets", "wire_bytes_per_step",
+                 "collectives_per_step", "fp32_wire_bytes_per_step")
+
+    def __init__(self, cfg: CommSpec, dp: int, buckets: List[Bucket]):
+        self.cfg = cfg
+        self.dp = int(dp)
+        self.buckets = buckets
+        self.wire_bytes_per_step = sum(b.wire_bytes for b in buckets)
+        self.collectives_per_step = sum(b.collectives for b in buckets)
+        # the un-quantized, un-bucketed baseline the ratio gates measure
+        # against: one fp32 ring allreduce over every gradient byte
+        total = sum(b.numel for b in buckets)
+        self.fp32_wire_bytes_per_step = _wire_bytes(
+            total, "fp32", "psum", self.dp, cfg.block_size)
+
+    @property
+    def residual_buckets(self) -> List[Bucket]:
+        return [b for b in self.buckets if b.carries_residual]
+
+    def algo_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for b in self.buckets:
+            out[b.algorithm] = out.get(b.algorithm, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "dtype": self.cfg.dtype, "dp": self.dp,
+            "block_size": self.cfg.block_size,
+            "error_feedback": self.cfg.error_feedback,
+            "scatter_threshold_KB": self.cfg.scatter_threshold_KB,
+            "fuse_grad_size_in_MB": self.cfg.fuse_grad_size_in_MB,
+            "wire_bytes_per_step": self.wire_bytes_per_step,
+            "fp32_wire_bytes_per_step": self.fp32_wire_bytes_per_step,
+            "collectives_per_step": self.collectives_per_step,
+            "buckets": [b.to_dict() for b in self.buckets],
+        }
+
+    def __repr__(self):
+        return (f"GradCommPlan(dtype={self.cfg.dtype}, dp={self.dp}, "
+                f"buckets={len(self.buckets)}, "
+                f"wire={self.wire_bytes_per_step}B/step "
+                f"[fp32 {self.fp32_wire_bytes_per_step}B], "
+                f"algos={self.algo_counts()})")
+
+
+def plan_reduction(shapes: Sequence[tuple], dp: int, cfg: CommSpec
+                   ) -> GradCommPlan:
+    """Assemble buckets over gradient ``shapes`` (creation order) and
+    pick each bucket's wire dtype + collective algorithm."""
+    buckets: List[Bucket] = []
+    for indices, numel in build_buckets(shapes, cfg.fuse_grad_size_in_MB):
+        if dp <= 1:
+            algo, wire = "none", cfg.dtype
+        else:
+            # threshold compares the QUANTIZED payload (what the
+            # scatter route would put on the wire, one direction)
+            if cfg.dtype == "int8":
+                payload = _int8_payload(numel, dp, cfg.block_size)
+            else:
+                payload = numel * _WIRE_ITEMSIZE[cfg.dtype]
+            if payload >= cfg.scatter_threshold_KB * 1024:
+                algo, wire = "scatter", cfg.dtype
+            else:
+                # latency-bound: one fused psum.  A single-shot int8
+                # psum cannot sum payloads carrying per-device scales,
+                # so the int8 config's small buckets ride bf16 wire.
+                algo = "psum"
+                wire = "bf16" if cfg.dtype == "int8" else cfg.dtype
+        if algo == "none":
+            n_coll = 0
+        elif algo == "psum":
+            n_coll = 1
+        elif wire == "int8":
+            n_coll = 4      # all_to_all q, all_to_all scales, ag q, ag s
+        else:
+            n_coll = 2      # psum_scatter + all_gather
+        carries = (cfg.error_feedback and algo != "none"
+                   and wire != "fp32")
+        buckets.append(Bucket(
+            indices=indices,
+            shapes=tuple(tuple(shapes[i]) for i in indices),
+            sizes=tuple(int(np.prod(shapes[i])) if shapes[i] else 1
+                        for i in indices),
+            numel=numel, algorithm=algo, wire_dtype=wire,
+            wire_bytes=_wire_bytes(numel, wire, algo, dp, cfg.block_size),
+            collectives=n_coll, carries_residual=carries))
+    return GradCommPlan(cfg, dp, buckets)
+
+
+# ---------------------------------------------------------------------------
+# bucket (dis)assembly — bitwise
+# ---------------------------------------------------------------------------
+
+def flatten_bucket(grads: Sequence, bucket: Bucket):
+    """Concatenate the bucket's grads into one flat f32 vector (in the
+    bucket's production order)."""
+    return jnp.concatenate(
+        [jnp.asarray(grads[i], jnp.float32).reshape(-1)
+         for i in bucket.indices])
+
+
+def unflatten_bucket(flat, bucket: Bucket, like: Sequence):
+    """Split a flat vector back into the bucket's grads — bitwise
+    inverse of :func:`flatten_bucket` (shape AND dtype restored from
+    ``like``).  Returns ``[(index, grad)]``."""
+    out = []
+    off = 0
+    for i, n, shp in zip(bucket.indices, bucket.sizes, bucket.shapes):
+        piece = jax.lax.slice_in_dim(flat, off, off + n).reshape(shp)
+        out.append((i, piece.astype(like[i].dtype)))
+        off += n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# in-graph reduction (call INSIDE shard_map over the dp axis)
+# ---------------------------------------------------------------------------
+
+def _rs_ag(x, axis_name: str, dp: int):
+    """Bandwidth route for non-int8 wire: pad to a dp multiple,
+    psum_scatter (each device reduces its chunk), all_gather back."""
+    n = x.shape[0]
+    np_ = _padded_numel(n, dp)
+    xp = jnp.pad(x, (0, np_ - n))
+    chunk = jax.lax.psum_scatter(xp, axis_name, scatter_dimension=0,
+                                 tiled=True)
+    return jax.lax.all_gather(chunk, axis_name, tiled=True)[:n]
+
+
+def _reduce_int8_scatter(carry, axis_name: str, dp: int, block: int,
+                         error_feedback: bool):
+    """The two-shot block-scaled int8 reduction.  ``carry`` is the
+    residual-corrected local gradient (flat f32).  Returns (reduced sum
+    as f32, per-device residual or None)."""
+    n = carry.shape[0]
+    np_ = _padded_numel(n, dp * block)
+    chunk = np_ // dp
+    cb = chunk // block
+    # shot 1: quantize local, exchange chunks (int8 + scales on wire)
+    q, s = quantize_int8_blocks(jnp.pad(carry, (0, np_ - n)), block)
+    qq = jax.lax.all_to_all(q.reshape(dp, cb, block), axis_name, 0, 0)
+    ss = jax.lax.all_to_all(s.reshape(dp, cb, 1), axis_name, 0, 0)
+    # dequantize per peer, sum in f32: my chunk of the global sum
+    red_chunk = jnp.sum(qq.astype(jnp.float32) * ss, axis=0).reshape(-1)
+    # shot 2: requantize the reduced chunk, gather (int8 + scales)
+    q2, s2 = quantize_int8_blocks(red_chunk, block)
+    qg = jax.lax.all_gather(q2.reshape(-1), axis_name, tiled=True)
+    sg = jax.lax.all_gather(s2.reshape(-1), axis_name, tiled=True)
+    total = dequantize_int8_blocks(qg.reshape(-1, block),
+                                   sg.reshape(-1, 1), n)
+    if not error_feedback:
+        return total, None
+    # residual: my local quantize error everywhere, PLUS the requantize
+    # error on the chunk I own (I am the only device that knows it; the
+    # next step's psum recovers it exactly once)
+    e1 = jnp.pad(carry, (0, np_ - n)) - dequantize_int8_blocks(q, s, np_)
+    e2 = red_chunk - dequantize_int8_blocks(q2, s2, chunk)
+    idx = jax.lax.axis_index(axis_name)
+    own = jax.lax.dynamic_slice(e1, (idx * chunk,), (chunk,))
+    e1 = jax.lax.dynamic_update_slice(e1, own + e2, (idx * chunk,))
+    return total, e1[:n]
+
+
+def _reduce_bucket(flat, residual, axis_name: str, bucket: Bucket,
+                   plan: GradCommPlan):
+    """Reduce one flat bucket over the dp axis following the plan.
+    Returns (mean-reduced f32 vector, new residual or None)."""
+    dp = plan.dp
+    if bucket.algorithm == "none":
+        return flat, residual
+    carry = flat + residual if residual is not None else flat
+    wire = bucket.wire_dtype
+    if wire == "fp32":
+        total = (jax.lax.psum(carry, axis_name)
+                 if bucket.algorithm == "psum"
+                 else _rs_ag(carry, axis_name, dp))
+        new_res = residual
+        if residual is not None:  # fp32 wire is exact: residual drains
+            new_res = jnp.zeros_like(residual)
+        return total / dp, new_res
+    if wire == "bf16":
+        sent = carry.astype(jnp.bfloat16)
+        total = (jax.lax.psum(sent, axis_name)
+                 if bucket.algorithm == "psum"
+                 else _rs_ag(sent, axis_name, dp)).astype(jnp.float32)
+        new_res = (carry - sent.astype(jnp.float32)
+                   if bucket.carries_residual and residual is not None
+                   else None)
+        return total / dp, new_res
+    total, new_res = _reduce_int8_scatter(
+        carry, axis_name, dp, plan.cfg.block_size,
+        bucket.carries_residual and residual is not None)
+    return total / dp, new_res
+
+
+def reduce_gradients(grads: Sequence, *, plan: GradCommPlan,
+                     axis_name: str = DP_AXIS,
+                     residuals: Optional[Sequence] = None):
+    """Reduce per-shard gradients to their dp-mean following ``plan``.
+
+    Must be called INSIDE a ``shard_map`` over ``axis_name``: ``grads``
+    are the local (device-varying) gradients, one entry per trainable
+    param in creation order.  ``residuals`` is the per-device error-
+    feedback carry — one flat f32 vector per ``plan.residual_buckets``
+    entry, in plan order — or None to reduce without error feedback
+    (the residual-less SpmdTrainStep path).
+
+    Returns ``(reduced grads, new residuals)``; reduced grads come back
+    replicated (every device holds the same mean), in the original
+    order/shape/dtype.  Buckets are emitted in backward production
+    order, each as an independent collective, so the XLA scheduler can
+    overlap bucket N's reduction with bucket N-1's producers."""
+    out = list(grads)
+    new_res: List = []
+    ri = 0
+    for bucket in plan.buckets:
+        res = None
+        if residuals is not None and bucket.carries_residual:
+            res = residuals[ri]
+        flat = flatten_bucket(grads, bucket)
+        red, r2 = _reduce_bucket(flat, res, axis_name, bucket, plan)
+        if residuals is not None and bucket.carries_residual:
+            new_res.append(r2 if r2 is not None
+                           else jnp.zeros_like(flat))
+            ri += 1
+        for i, g in unflatten_bucket(red, bucket, grads):
+            out[i] = g
+    return out, new_res

@@ -443,17 +443,22 @@ class ShardingPlan:
     program per-chip."""
 
     __slots__ = ("mesh", "param_names", "param_specs", "batch_axes",
-                 "label", "_fp")
+                 "label", "grad_comm", "_fp")
 
     def __init__(self, mesh: Mesh, param_names: Sequence[str],
                  param_specs: Sequence[PartitionSpec],
-                 batch_axes: Sequence[str] = (DP_AXIS,), label: str = ""):
+                 batch_axes: Sequence[str] = (DP_AXIS,), label: str = "",
+                 grad_comm=None):
         self.mesh = mesh
         self.param_names = list(param_names)
         self.param_specs = [_as_spec(s) for s in param_specs]
         self.batch_axes = tuple(a for a in batch_axes
                                 if a in mesh.shape)
         self.label = label
+        # resolved grad_comm.CommSpec (or None): the explicit quantized/
+        # bucketed gradient-collective stage the Executor lowers for
+        # this plan — part of the compile identity below
+        self.grad_comm = grad_comm
         self._fp = None
 
     # -- identity ----------------------------------------------------------
@@ -467,7 +472,9 @@ class ShardingPlan:
             self._fp = (tuple(self.mesh.shape.items()),
                         tuple(d.id for d in self.mesh.devices.flat),
                         tuple(str(s) for s in self.param_specs),
-                        self.batch_axes)
+                        self.batch_axes,
+                        (None if self.grad_comm is None
+                         else self.grad_comm.fingerprint()))
         return self._fp
 
     @property
@@ -580,7 +587,9 @@ def plan_for_params(named_params, strategy=None, mesh: Optional[Mesh] = None,
             spec = PartitionSpec()
         shape = tuple(arr.shape) if arr is not None else ()
         specs.append(_fit_spec_to_mesh(spec, shape, mesh, name))
-    return ShardingPlan(mesh, names, specs, label=label)
+    from . import grad_comm as _gc
+    return ShardingPlan(mesh, names, specs, label=label,
+                        grad_comm=_gc.resolve(strategy))
 
 
 # ---------------------------------------------------------------------------

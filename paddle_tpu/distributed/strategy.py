@@ -128,6 +128,34 @@ class SequenceParallelConfig:
     ring_attention: bool = True
 
 
+@dataclass
+class GradCommConfig:
+    """Beyond-reference (ROADMAP item 2): the gradient-communication
+    stage — quantized collectives (EQuARX-style block-scaled int8 /
+    bf16 wire) with error feedback, bucketed reduction, and
+    latency-vs-bandwidth algorithm selection (see
+    ``distributed/grad_comm.py``).
+
+    ``dtype``: ``None`` leaves gradient reduction to GSPMD (the default
+    fp32 psum the compiler inserts); ``"fp32"``/``"bf16"``/``"int8"``
+    switch to the explicit bucketed stage at that wire precision
+    (``"fp32"`` is the measured baseline — same math, but wire bytes
+    and collective choices become observable as ``comm.*`` stats).
+    ``block_size``: int8 block-scaling granularity (one f32 absmax
+    scale per block).  ``error_feedback``: carry the per-device
+    quantization residual in the donated executor state and add it
+    back into the next step's gradient (keeps the loss trajectory at
+    parity with fp32 collectives).  ``scatter_threshold_KB``: buckets
+    whose quantized payload is at least this large take the
+    bandwidth-optimal psum_scatter/all_to_all + all_gather route;
+    smaller (latency-bound) buckets take a single fused psum.
+    Bucket sizing itself is ``strategy.fuse_grad_size_in_MB``."""
+    dtype: Optional[str] = None       # None=off | 'fp32' | 'bf16' | 'int8'
+    block_size: int = 256
+    error_feedback: bool = True
+    scatter_threshold_KB: float = 32.0
+
+
 class DistributedStrategy:
     """fleet.DistributedStrategy parity: bool toggles + nested *_configs.
 
@@ -148,6 +176,9 @@ class DistributedStrategy:
         "lars_configs": LarsConfig,
         "a_sync_configs": AsyncConfig,
         "sequence_parallel_configs": SequenceParallelConfig,
+        # knob object, not a bool toggle: `strategy.grad_comm.dtype =
+        # "int8"` (or a dict assignment) enables the stage
+        "grad_comm": GradCommConfig,
     }
 
     def __init__(self):
@@ -168,6 +199,9 @@ class DistributedStrategy:
         self.fp16_allreduce = False
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True     # XLA does this natively
+        # bucket size for the explicit grad_comm reduction stage: small
+        # grads fuse into flat buckets of this many MB, each reduced by
+        # one collective (the reference Reducer's bucket knob)
         self.fuse_grad_size_in_MB = 32
         self.nccl_comm_num = 1              # parity no-op
         self.hierarchical_allreduce = False  # topology handled by XLA
@@ -249,14 +283,46 @@ def validate_toggles(strategy: "DistributedStrategy",
     device count (the check :meth:`infer_mesh_shape` enforces)."""
     if n_devices is not None:
         strategy.infer_mesh_shape(int(n_devices))  # raises on non-divisible
+    from ..core.enforce import InvalidArgumentError
+    fuse = strategy.fuse_grad_size_in_MB
+    if not isinstance(fuse, (int, float)) or fuse <= 0:
+        raise InvalidArgumentError(
+            f"strategy.fuse_grad_size_in_MB={fuse!r}: the gradient "
+            f"bucket size must be a positive number of megabytes — each "
+            f"bucket is one fused collective, so 0 or negative would "
+            f"mean no reduction at all.  Typical values: 8-64 (small = "
+            f"more overlap opportunities, large = fewer collectives).")
+    gc = strategy.grad_comm
+    if gc.dtype not in (None, "fp32", "bf16", "int8"):
+        raise InvalidArgumentError(
+            f"strategy.grad_comm.dtype={gc.dtype!r}: wire dtype must be "
+            f"None (off), 'fp32', 'bf16' or 'int8'.")
+    if int(gc.block_size) <= 0:
+        raise InvalidArgumentError(
+            f"strategy.grad_comm.block_size={gc.block_size!r}: int8 "
+            f"block-scaling needs a positive block size (one f32 absmax "
+            f"scale per block; typical: 128-1024).")
+    if float(gc.scatter_threshold_KB) < 0:
+        raise InvalidArgumentError(
+            f"strategy.grad_comm.scatter_threshold_KB="
+            f"{gc.scatter_threshold_KB!r} must be >= 0 (buckets at least "
+            f"this large take psum_scatter+all_gather; smaller take one "
+            f"fused psum).")
+    if strategy.fp16_allreduce and gc.dtype not in (None, "bf16"):
+        raise InvalidArgumentError(
+            f"strategy.fp16_allreduce is an alias for grad_comm.dtype="
+            f"'bf16' but grad_comm.dtype={gc.dtype!r} is also set — "
+            f"drop the alias or the explicit dtype; they conflict.")
     if strategy.dgc:
         raise NotImplementedError(
             "strategy.dgc: deep gradient compression (dgc_optimizer.py, "
             "dgc_momentum_op.cc) is a bandwidth-bound-GPU-interconnect "
-            "technique; TPU ICI is fast enough that GSPMD's fused bf16 "
-            "collectives (strategy.fp16_allreduce) cover the capability, "
-            "and top-k sparsified allreduce is data-dependent (dynamic "
-            "shapes) which XLA cannot compile efficiently.")
+            "technique; the quantized gradient-collective stage "
+            "(strategy.grad_comm.dtype='int8', block-scaled with error "
+            "feedback — or the bf16 alias strategy.fp16_allreduce) covers "
+            "the wire-compression capability, and top-k sparsified "
+            "allreduce is data-dependent (dynamic shapes) which XLA "
+            "cannot compile efficiently.")
     if strategy.a_sync:
         raise NotImplementedError(
             "strategy.a_sync: async/GEO parameter-server push-pull "

@@ -25,11 +25,15 @@ One jit'd step over a ``Mesh`` with explicit in/out shardings:
   strategy.amp (float16) → in-graph dynamic loss scaling;
   strategy.recompute → jax.checkpoint over the loss (rematerialised
   backward, recompute_optimizer.py:18);
-  strategy.fp16_allreduce → grads quantised to bf16 and psum'd at reduced
-  precision inside a shard_map over 'dp'
+  strategy.grad_comm (and its alias strategy.fp16_allreduce ==
+  grad_comm.dtype='bf16') → the explicit gradient-collective stage
+  (distributed/grad_comm.py): grads bucketed and quantised to the wire
+  dtype, reduced inside a shard_map over 'dp' with per-bucket
+  latency-vs-bandwidth algorithm selection
   (fp16_allreduce_optimizer.py:18; bf16 instead of fp16 because bf16
   shares f32's exponent range — no loss-scale overflow on the wire — and
-  is the TPU-native half type).
+  is the TPU-native half type.  The error-feedback residual carry lives
+  on the static Executor path).
 """
 from __future__ import annotations
 
@@ -97,20 +101,31 @@ class SpmdTrainStep(TrainStep):
                          recompute=strategy.recompute, amp_level=amp_level)
         self.mesh = mesh or ensure_mesh()
         self.strategy = strategy
-        if strategy.fp16_allreduce:
-            others = [a for a, s in self.mesh.shape.items()
-                      if a != DP_AXIS and s > 1]
-            if others:
-                raise NotImplementedError(
-                    f"strategy.fp16_allreduce covers the data-parallel "
-                    f"grad reduction; mesh axes {others} carry model "
-                    f"shardings whose collectives GSPMD schedules — run "
-                    f"it on a pure-dp mesh.")
-            if strategy.sharding and strategy.sharding_configs.stage >= 3:
-                raise NotImplementedError(
-                    "fp16_allreduce + ZeRO-3: stage 3 keeps params "
-                    "dp-sharded, which the explicit shard_map grad path "
-                    "would replicate.  Use stage<=2 with fp16_allreduce.")
+        # explicit gradient-collective stage (distributed/grad_comm.py):
+        # strategy.grad_comm knobs, with strategy.fp16_allreduce as the
+        # backward-compatible alias for a bf16 wire
+        from ..distributed import grad_comm as _gc
+        self._grad_comm = _gc.resolve(strategy)
+        self._comm_plan = None
+        if self._grad_comm is not None:
+            zero3 = (strategy.sharding
+                     and strategy.sharding_configs.stage >= 3)
+            msg = _gc.incompatibility(
+                self._grad_comm, self.mesh.shape,
+                sharded_params=(["<ZeRO-3 stage-3 params>"] if zero3
+                                else ()))
+            if msg is not None:
+                raise NotImplementedError(msg)
+            if (self._grad_comm.error_feedback
+                    and self._grad_comm.source == "grad_comm"
+                    and self._grad_comm.dtype != "fp32"):
+                import warnings
+                warnings.warn(
+                    "grad_comm.error_feedback: the per-device residual "
+                    "carry lives in the static Executor's donated state; "
+                    "SpmdTrainStep reduces without error feedback.  Use "
+                    "the static path (fleet + Executor) for EF, or set "
+                    "error_feedback=False to silence this.")
         # -- ZeRO-3 padding plan (reference: sharding/shard.py pads numel) --
         self._padded = {}
         if (strategy.sharding and strategy.sharding_configs.stage >= 3
@@ -268,12 +283,20 @@ class SpmdTrainStep(TrainStep):
                 out.append(g)
         return out
 
-    # -- fp16_allreduce: reduced-precision grad psum ----------------------
+    # -- grad_comm: explicit bucketed/quantized grad reduction ------------
     def _wrap_loss_and_grad(self, fn):
-        if not self.strategy.fp16_allreduce:
+        cfg = self._grad_comm
+        if cfg is None:
             return fn
         mesh = self.mesh
         dp = self._dp_size()
+        if dp <= 1:
+            return fn  # nothing crosses a wire
+        from ..distributed import grad_comm as _gc
+        shapes = [self._stored_shape(i, p)
+                  for i, p in enumerate(self._params)]
+        plan = _gc.plan_reduction(shapes, dp=dp, cfg=cfg)
+        self._comm_plan = plan
 
         def wrapped(p_cur, b_cur, mb_inputs, mb_labels, kidx):
             def local(ins, labs, k):
@@ -284,10 +307,13 @@ class SpmdTrainStep(TrainStep):
                 # invariant cotangent) so the ONLY reduction is ours below
                 p_var = [_pvary(a, DP_AXIS) for a in p_cur]
                 loss, new_b, grads = fn(p_var, b_cur, ins, labs, k)
-                # quantise → reduce → restore: the wire carries bf16
-                # (fp16_allreduce_optimizer.py:18's cast/recast pair)
-                grads = [jax.lax.psum(g.astype(jnp.bfloat16), DP_AXIS)
-                         .astype(jnp.float32) / dp for g in grads]
+                # bucketed quantize → reduce → dequantize: the wire
+                # carries the plan's dtype (bf16 subsumes the old
+                # fp16_allreduce cast/recast pair,
+                # fp16_allreduce_optimizer.py:18); residual-less — the
+                # error-feedback carry lives on the Executor path
+                grads, _ = _gc.reduce_gradients(
+                    grads, plan=plan, axis_name=DP_AXIS, residuals=None)
                 loss = jax.lax.pmean(loss, DP_AXIS)
                 new_b = jax.tree.map(
                     lambda a: jax.lax.pmean(a, DP_AXIS), new_b)
@@ -295,10 +321,14 @@ class SpmdTrainStep(TrainStep):
 
             from ..core.jax_compat import shard_map
             P = PartitionSpec
+            # check_vma off: the int8 route's all_to_all/all_gather
+            # results are replicated by construction, which the static
+            # replication checker cannot infer
             return shard_map(
                 local, mesh=mesh,
                 in_specs=(P(DP_AXIS), P(DP_AXIS), P()),
-                out_specs=P())(mb_inputs, mb_labels, kidx)
+                out_specs=P(), check_vma=False)(mb_inputs, mb_labels,
+                                                kidx)
 
         return wrapped
 

@@ -345,6 +345,63 @@ def _optimizer_flops(program: Program, trainable_bytes: int,
 
 
 # ---------------------------------------------------------------------------
+# gradient-collective prediction (grad_comm wire bytes)
+# ---------------------------------------------------------------------------
+
+def _comm_block(program: Program, plan) -> Optional[dict]:
+    """Predicted per-step gradient-communication cost of a training
+    program under a sharding plan: per-collective wire bytes (quantized
+    payload + scales), latency-vs-bandwidth classification, and the
+    fp32 baseline.  With an active ``grad_comm`` spec the numbers come
+    from the SAME ``plan_reduction`` the Executor compiles, so
+    prediction and the runtime ``comm.wire_bytes`` stat agree exactly;
+    without one, the block models GSPMD's default fp32 grad psum."""
+    if program._optimizer is None or plan is None:
+        return None
+    from ...distributed import grad_comm as _gc
+    from ...distributed.mesh import DP_AXIS
+    from .liveness import _opt_unpack, param_array
+    dp = dict(plan.mesh.shape).get(DP_AXIS, 1)
+    # the SAME trainable filter the Executor differentiates with
+    # (honors minimize's parameters=/no_grad_set) — the measured ==
+    # predicted contract depends on the grad list matching exactly
+    _opt, trainable = _opt_unpack(program)
+    shapes = [tuple(param_array(p).shape) for p in trainable]
+    grad_bytes = sum(4 * int(np.prod(s)) if s else 4 for s in shapes)
+    ring = (2.0 * (dp - 1) / dp) if dp > 1 else 0.0
+    fp32_wire = int(round(ring * grad_bytes))
+    # the Executor's OWN activation predicate (shared, so measured and
+    # predicted can never disagree about which path runs); a configured-
+    # but-impossible spec is reported, not silently priced as fp32 —
+    # the Executor will refuse to compile that program
+    status, err = _gc.plan_status(plan)
+    if status != "active":
+        return {
+            "enabled": False, "dp": dp, "dtype": "fp32",
+            **({"error": err} if err else {}),
+            "wire_bytes_per_step": fp32_wire,
+            "fp32_wire_bytes_per_step": fp32_wire,
+            "collectives": ([] if dp <= 1 else [{
+                "params": list(range(len(shapes))),
+                "numel": grad_bytes // 4, "algorithm": "gspmd_psum",
+                "wire_dtype": "fp32", "wire_bytes": fp32_wire,
+                "collectives": 1, "classification": "bandwidth",
+                "error_feedback": False}]),
+        }
+    cfg = plan.grad_comm
+    gplan = _gc.plan_reduction(shapes, dp=dp, cfg=cfg)
+    return {
+        "enabled": True, "dp": dp, "dtype": cfg.dtype,
+        "block_size": cfg.block_size,
+        "error_feedback": cfg.error_feedback,
+        "wire_bytes_per_step": gplan.wire_bytes_per_step,
+        "fp32_wire_bytes_per_step": gplan.fp32_wire_bytes_per_step,
+        "collectives_per_step": gplan.collectives_per_step,
+        "collectives": [b.to_dict() for b in gplan.buckets],
+    }
+
+
+# ---------------------------------------------------------------------------
 # shape re-derivation (concrete batch size)
 # ---------------------------------------------------------------------------
 
@@ -555,6 +612,16 @@ class ProgramReport:
                 f"no-donation (params {_fmt_bytes(ms.param_bytes)}, "
                 f"slots {_fmt_bytes(ms.slot_bytes)}, grads "
                 f"{_fmt_bytes(ms.grad_bytes)})")
+        comm = self.totals.get("comm")
+        if comm is not None:
+            ratio = (comm["wire_bytes_per_step"]
+                     / max(comm["fp32_wire_bytes_per_step"], 1))
+            lines.append(
+                f"  comm (dp={comm['dp']}, "
+                f"{'grad_comm ' + str(comm['dtype']) if comm['enabled'] else 'gspmd fp32'}): "
+                f"{_fmt_bytes(comm['wire_bytes_per_step'])}/step wire "
+                f"({ratio:.2f}x fp32), "
+                f"{len(comm['collectives'])} collective group(s)")
         if self.roofline:
             lines.append("  roofline (predicted):")
             for name, r in self.roofline.items():
@@ -738,9 +805,12 @@ def analyze(program: Program, fetch_list: Optional[Sequence] = None,
     rep.batch_hint = batch_size
     rep.per_op = costs
     rep.memory_per_shard = memory_per_shard
+    comm = _comm_block(program, sharding) if sharding is not None \
+        else None
     rep.totals = {
         **({"mesh_devices": sharding.n_devices}
            if sharding is not None else {}),
+        **({"comm": comm} if comm is not None else {}),
         "flops_fwd": flops_fwd,
         "flops_train": flops_train,
         "optimizer_flops": opt_flops if training else 0,
@@ -840,4 +910,11 @@ def compile_summary(program: Program, donate: bool = True,
             ms.peak_bytes_donated if donate
             else ms.peak_bytes_no_donation)
         out["mesh_devices"] = t.get("mesh_devices")
+    comm = t.get("comm")
+    if comm is not None:
+        # predicted gradient wire bytes per step ride the compile
+        # record next to predicted_step_s — the number the runtime's
+        # comm.wire_bytes stat is compared against
+        out["predicted_wire_bytes"] = comm["wire_bytes_per_step"]
+        out["comm_enabled"] = comm["enabled"]
     return out

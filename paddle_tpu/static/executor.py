@@ -502,8 +502,12 @@ class Executor:
                     step = int(np.asarray(aux["step"]))
                     run = int(np.asarray(aux.get(
                         "run", state.aux["run"])))
-                    state.aux = {"run": jnp.asarray(run, jnp.int32),
-                                 "step": jnp.asarray(step, jnp.int32)}
+                    # dict(state.aux, ...) keeps non-counter carries
+                    # (grad_comm residuals) a restore must not drop —
+                    # they are error accumulators, not checkpoint state
+                    state.aux = dict(state.aux,
+                                     run=jnp.asarray(run, jnp.int32),
+                                     step=jnp.asarray(step, jnp.int32))
                     self._run_counts[program._serial] = run
                     if opt is not None:
                         opt._step_count = step
@@ -743,6 +747,21 @@ class Executor:
                 state.synced_step = opt._step_count
                 # static-mode optimizer.state_dict reads slots from here
                 opt._static_state_provider = weakref.ref(state)
+            # grad_comm error-feedback residuals ride the donated aux
+            # carry (one device-varying [dp, numel] array per quantized
+            # bucket); (re)zero them when the compiled plan's residual
+            # structure differs from what the carry holds (first train
+            # run, or a grad_comm knob change recompiled the program)
+            rs = getattr(compiled, "_residual_shapes", None)
+            cur = state.aux.get("grad_comm")
+            if rs:
+                if (cur is None or [tuple(a.shape) for a in cur]
+                        != [tuple(s) for s in rs]):
+                    state.aux = dict(state.aux, grad_comm=[
+                        jnp.zeros(s, jnp.float32) for s in rs])
+            elif cur is not None:
+                state.aux = {k: v for k, v in state.aux.items()
+                             if k != "grad_comm"}
             opt._step_count += 1
             if state.synced_step != opt._step_count - 1:
                 # the optimizer counter moved outside this loop
@@ -776,6 +795,17 @@ class Executor:
             state.p_arrays = list(new_p)
             state.opt_state = new_s
             state.aux = new_aux
+            # wire-byte accounting: the grad_comm plan's per-step bytes
+            # and collective choices are static, so the measured stat is
+            # the plan total per dispatched step (predict == measure by
+            # construction; the cost model reports the same number)
+            cs = getattr(compiled, "_comm_stats", None)
+            if cs is not None:
+                from ..utils import monitor
+                monitor.stat_add("comm.wire_bytes", cs[0])
+                monitor.stat_add("comm.collectives", cs[1])
+                for algo, cnt in cs[2].items():
+                    monitor.stat_add(f"comm.algo.{algo}", cnt)
         else:
             rng_key = jax.random.fold_in(
                 state.base_key, run_i if seed is None else int(seed))
@@ -830,6 +860,249 @@ class Executor:
         aux_sh = {"run": rep, "step": rep}
         return (p_sh, s_sh, aux_sh, rep, feed_sh, fetch_sh)
 
+    # -- grad_comm (quantized/bucketed gradient collectives) ---------------
+    def _grad_comm_plan(self, plan, params, t_idx):
+        """Reduction plan for the explicit grad-comm stage, or None when
+        the mesh makes it a no-op (dp <= 1).  Raises loudly on meshes /
+        param shardings the shard_map grad path cannot carry — the
+        activation predicate is grad_comm.plan_status, SHARED with the
+        cost model so prediction and runtime agree about which path
+        runs."""
+        from ..distributed import grad_comm as _gc
+        from ..distributed.mesh import DP_AXIS
+        from .analysis.liveness import param_array
+        status, msg = _gc.plan_status(plan)
+        if status == "off":
+            return None
+        if status == "error":
+            raise NotImplementedError(msg)
+        shapes = [tuple(param_array(params[i]).shape) for i in t_idx]
+        return _gc.plan_reduction(shapes,
+                                  dp=plan.mesh.shape[DP_AXIS],
+                                  cfg=plan.grad_comm)
+
+    def _build_grad_comm(self, params, fetch_names, donate, plan, gplan,
+                         feed_arrays, opt, loss_var, t_idx, params_meta,
+                         forward_env):
+        """Compile the training step with the explicit gradient-
+        communication stage: forward+backward run inside a shard_map
+        over dp (params replicated and device-varied, batch feeds
+        sharded), gradients are reduced by grad_comm.reduce_gradients —
+        bucketed in backward production order so each bucket's
+        collective is independently schedulable against the remaining
+        backward compute, quantized per the plan, with the per-device
+        error-feedback residual carried (and donated) in the aux tree —
+        and the optimizer update runs outside on the replicated mean
+        grads."""
+        from jax.sharding import PartitionSpec
+        from ..core import rng as _rng
+        from ..core.jax_compat import pvary, shard_map
+        from ..distributed import grad_comm as _gc
+        from ..distributed.mesh import DP_AXIS
+        from ..distributed.sharding import spec_axes
+        from .analysis.liveness import param_array
+
+        mesh = plan.mesh
+        dp = gplan.dp
+        P = PartitionSpec
+        feed_specs = tuple(plan.feed_spec(a.shape) for a in feed_arrays)
+
+        # fetch reconstruction rules from abstract shapes: a fetch whose
+        # per-shard shape equals the global one is pmean'd (exact for
+        # the mean-reduced scalars programs fetch); a batch-major fetch
+        # reassembles over dp; anything else cannot be rebuilt from
+        # shards and must fail at compile, not return wrong numbers
+        p_avals = [jax.ShapeDtypeStruct(tuple(param_array(p).shape),
+                                        np.dtype(param_array(p).dtype))
+                   for p in params]
+
+        def _abstract_fetches(p_arrs, f_arrs):
+            with _rng.seed_scope(jax.random.PRNGKey(0)):
+                env = forward_env(list(p_arrs), f_arrs)
+            return [env[n] for n in fetch_names]
+
+        def _aval(a, local):
+            shp = tuple(a.shape)
+            if local and spec_axes(plan.feed_spec(shp)):
+                shp = (shp[0] // dp,) + shp[1:]
+            return jax.ShapeDtypeStruct(shp, np.dtype(a.dtype))
+
+        loc = jax.eval_shape(_abstract_fetches, p_avals,
+                             [_aval(a, True) for a in feed_arrays])
+        glob = jax.eval_shape(_abstract_fetches, p_avals,
+                              [_aval(a, False) for a in feed_arrays])
+        fetch_rules = []
+        for name, lo, go in zip(fetch_names, loc, glob):
+            if tuple(lo.shape) == tuple(go.shape):
+                fetch_rules.append("mean")
+            elif (lo.shape and tuple(go.shape)
+                  == (lo.shape[0] * dp,) + tuple(lo.shape[1:])):
+                fetch_rules.append("batch")
+            else:
+                raise NotImplementedError(
+                    f"grad_comm: fetch '{name}' (global "
+                    f"{tuple(go.shape)}, per-shard {tuple(lo.shape)}) "
+                    f"is neither shard-invariant nor batch-major — it "
+                    f"cannot be reconstructed from dp shards.  Fetch "
+                    f"batch-major or scalar-mean tensors, or disable "
+                    f"grad_comm.")
+
+        # certify the 'mean' classification numerically: a SUM-reduced
+        # fetch (or loss) has the same shape as a mean-reduced one, but
+        # pmean of per-shard partials would silently return 1/dp of it
+        # — and the grads of a sum loss would be psum'd WITH the /dp
+        # this stage applies, training a different model than GSPMD's
+        # default.  The probe runs the forward eagerly at compile time
+        # (dp shard runs + two global runs, one fixed RNG key) and
+        # raises on a certified sum; a program whose randomness defeats
+        # the probe gets a warning, not silence.
+        probe_names = [n for n, r in zip(fetch_names, fetch_rules)
+                       if r == "mean"]
+        if loss_var.name not in probe_names:
+            probe_names.append(loss_var.name)
+        p_conc = [param_array(p) for p in params]
+
+        def _probe(f_arrs, key):
+            with _rng.seed_scope(key):
+                env = forward_env(list(p_conc), list(f_arrs))
+            return {n: np.asarray(env[n]) for n in probe_names}
+
+        feeds_np = [np.asarray(a) for a in feed_arrays]
+        k0 = jax.random.PRNGKey(0)
+        g1 = _probe(feeds_np, k0)
+        _rand_memo: list = []
+
+        def _randomized():
+            # only consulted when certification fails — don't pay a
+            # full extra forward on the common all-certified compile
+            if not _rand_memo:
+                _rand_memo.append(any(
+                    not np.array_equal(g1[n], v) for n, v in
+                    _probe(feeds_np, jax.random.PRNGKey(1)).items()))
+            return _rand_memo[0]
+
+        shard_vals = []
+        for i in range(dp):
+            fs = [a[i * (a.shape[0] // dp):(i + 1) * (a.shape[0] // dp)]
+                  if spec_axes(sp) else a
+                  for a, sp in zip(feeds_np, feed_specs)]
+            shard_vals.append(_probe(fs, k0))
+        for n in probe_names:
+            g = g1[n].astype(np.float64)
+            parts = np.stack([sv[n].astype(np.float64)
+                              for sv in shard_vals])
+            mean_est, sum_est = parts.mean(0), parts.sum(0)
+            scale = max(float(np.abs(g).max()),
+                        float(np.abs(sum_est).max()), 1e-6)
+            if np.abs(g - mean_est).max() <= 1e-3 * scale:
+                continue
+            what = ("loss" if n == loss_var.name else "fetch")
+            if np.abs(g - sum_est).max() <= 1e-3 * scale:
+                raise NotImplementedError(
+                    f"grad_comm: {what} '{n}' is SUM-reduced over the "
+                    f"batch — the dp-mean reduction this stage applies "
+                    f"would silently scale it (and its gradients) by "
+                    f"1/dp.  Use a mean reduction, or disable "
+                    f"grad_comm for this program.")
+            if _randomized():
+                import warnings
+                warnings.warn(
+                    f"grad_comm: could not certify that {what} '{n}' "
+                    f"is a per-shard mean (the program's random ops "
+                    f"defeat the compile-time probe); proceeding under "
+                    f"the mean assumption — a sum-reduced {what} would "
+                    f"be scaled by 1/dp.")
+            else:
+                raise NotImplementedError(
+                    f"grad_comm: {what} '{n}' is neither the mean nor "
+                    f"the sum of its per-shard values — it cannot be "
+                    f"reconstructed from dp shards.  Fetch batch-major "
+                    f"or mean-reduced tensors, or disable grad_comm.")
+
+        n_res = len(gplan.residual_buckets)
+
+        def train_fn(p_arrays, opt_state, aux, lr, base_key, sflag,
+                     rseed, *feed_arrays):
+            p_arrays = list(p_arrays)
+            run_i = aux["run"] + 1
+            step_i = (aux["step"] + 1).astype(jnp.float32)
+            rng_key = jax.random.fold_in(
+                base_key, jnp.where(sflag > 0, rseed, run_i))
+            t_arrays = [p_arrays[i] for i in t_idx]
+            residuals = tuple(aux.get("grad_comm", ()))
+
+            def local(res_rows, *local_feeds):
+                # decorrelate per-shard random ops (dropout masks)
+                k_local = jax.random.fold_in(
+                    rng_key, jax.lax.axis_index(DP_AXIS))
+                # differentiate w.r.t. device-VARYING copies: grads
+                # stay local, the ONLY reduction is grad_comm's below
+                t_var = [pvary(a, DP_AXIS) for a in t_arrays]
+
+                def loss_of(tlist):
+                    full = list(p_arrays)
+                    for j, a in zip(t_idx, tlist):
+                        full[j] = a
+                    with _rng.seed_scope(k_local):
+                        env = forward_env(full, local_feeds)
+                    return env[loss_var.name], env
+
+                (loss, env), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(t_var)
+                del loss
+                grads, new_res = _gc.reduce_gradients(
+                    grads, plan=gplan, axis_name=DP_AXIS,
+                    residuals=([r[0] for r in res_rows]
+                               if res_rows else None))
+                outs = []
+                for name, rule in zip(fetch_names, fetch_rules):
+                    v = env[name]
+                    outs.append(jax.lax.pmean(v, DP_AXIS)
+                                if rule == "mean" else v)
+                return (tuple(outs), tuple(grads),
+                        tuple(r[None] for r in new_res))
+
+            fetch_vals, grads, new_res = shard_map(
+                local, mesh=mesh,
+                in_specs=((tuple(P(DP_AXIS) for _ in residuals),)
+                          + feed_specs),
+                out_specs=(tuple(P(DP_AXIS) if r == "batch" else P()
+                                 for r in fetch_rules),
+                           tuple(P() for _ in t_idx),
+                           tuple(P(DP_AXIS) for _ in residuals)),
+                check_vma=False)(residuals, *feed_arrays)
+
+            new_t, new_s = opt.functional_update(
+                t_arrays, list(grads), opt_state, lr, step_i,
+                params_meta=params_meta)
+            new_p = list(p_arrays)
+            for j, a in zip(t_idx, new_t):
+                new_p[j] = a
+            new_aux = {"run": run_i, "step": aux["step"] + 1}
+            if n_res:
+                new_aux["grad_comm"] = list(new_res)
+            return (list(fetch_vals), new_p, new_s, new_aux)
+
+        jit_kw = dict(donate_argnums=(0, 1, 2)) if donate else {}
+        p_sh, s_sh, aux_sh, rep, feed_sh, fetch_sh = self._shardings(
+            plan, params, t_idx, opt, feed_arrays, fetch_names)
+        if n_res:
+            aux_sh = dict(aux_sh,
+                          grad_comm=[plan._ns(P(DP_AXIS))] * n_res)
+        jit_kw["in_shardings"] = (p_sh, s_sh, aux_sh, rep, rep, rep,
+                                  rep, *feed_sh)
+        jit_kw["out_shardings"] = (fetch_sh, p_sh, s_sh, aux_sh)
+        compiled = _no_persistent_cache_first_call(
+            jax.jit(train_fn, **jit_kw))
+        compiled._t_idx = t_idx
+        compiled._gc_plan = gplan
+        compiled._residual_shapes = [(dp, b.numel)
+                                     for b in gplan.residual_buckets]
+        compiled._comm_stats = (gplan.wire_bytes_per_step,
+                                gplan.collectives_per_step,
+                                gplan.algo_counts())
+        return compiled
+
     def _build(self, program: Program, params, feed_names, fetch_names,
                donate, plan=None, feed_arrays=()):
         nodes = list(program.nodes)
@@ -880,6 +1153,20 @@ class Executor:
 
         t_idx = [i for i, p in enumerate(params) if trainable(p)]
         params_meta = [params[i] for i in t_idx]
+
+        # -- grad_comm: explicit quantized/bucketed gradient collectives --
+        # When the plan carries a grad_comm spec (strategy.grad_comm /
+        # fp16_allreduce through fleet) on a multi-device pure-dp mesh,
+        # the loss+backward runs inside a shard_map over dp and the
+        # gradient reduction is OURS: bucketed, quantized, with the
+        # error-feedback residual carried in the donated aux tree.
+        gplan = None
+        if plan is not None and plan.grad_comm is not None:
+            gplan = self._grad_comm_plan(plan, params, t_idx)
+        if gplan is not None:
+            return self._build_grad_comm(
+                params, fetch_names, donate, plan, gplan, feed_arrays,
+                opt, loss_var, t_idx, params_meta, forward_env)
 
         def train_fn(p_arrays, opt_state, aux, lr, base_key, sflag, rseed,
                      *feed_arrays):

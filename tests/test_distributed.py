@@ -923,3 +923,300 @@ def test_chaos_reshard_scenario_in_process(tmp_path):
     loss-trajectory parity with the uninterrupted run."""
     from paddle_tpu.testing import chaos
     assert chaos.reshard_main(workdir=str(tmp_path)) == 0
+
+
+# ---- grad_comm: quantized gradient collectives (ISSUE 10) --------------
+
+import jax.numpy as jnp
+
+from paddle_tpu.distributed import grad_comm as gcx
+
+
+def _spec(dtype="int8", block=64, ef=True, thresh=0.0, fuse=32.0):
+    return gcx.CommSpec(dtype, block, ef, thresh, fuse, "grad_comm")
+
+
+def test_grad_comm_int8_roundtrip_error_bound():
+    """Block-scaled int8 quantize->dequantize error is bounded by half
+    an LSB of each block's scale (absmax/127/2), elementwise."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.standard_normal(1000).astype(np.float32) * 7.0)
+    q, s = gcx.quantize_int8_blocks(x, 64)
+    back = gcx.dequantize_int8_blocks(q, s, 1000)
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    bound = np.repeat(np.asarray(s).ravel(), 64)[:1000] * 0.5 + 1e-7
+    assert np.all(err <= bound)
+    # bf16 wire round trip: relative error within bf16's 8-bit mantissa
+    bf = np.asarray(x.astype(jnp.bfloat16).astype(jnp.float32))
+    assert np.all(np.abs(bf - np.asarray(x)) <= np.abs(np.asarray(x))
+                  * 2 ** -8 + 1e-7)
+
+
+def test_grad_comm_bucket_assembly_bitwise():
+    """Buckets cover every grad exactly once in backward production
+    order (reverse creation order), respect fuse_grad_size_in_MB, and
+    flatten->unflatten is bitwise."""
+    shapes = [(3, 5), (7,), (2, 2, 2), (11,), (4,)]
+    # 1 KB budget = 256 f32 elements: everything fits one bucket
+    one = gcx.build_buckets(shapes, 256 * 4 / (1 << 20))
+    assert len(one) == 1 and one[0][0] == (4, 3, 2, 1, 0)
+    # 12-element budget: greedy packing in reverse order
+    tiny = gcx.build_buckets(shapes, 12 * 4 / (1 << 20))
+    flat_idx = [i for b, _ in tiny for i in b]
+    assert sorted(flat_idx) == list(range(5))
+    assert flat_idx == [4, 3, 2, 1, 0]  # production order preserved
+    assert all(n <= 15 for _, n in tiny)  # 11-elem grad fits alone
+    # bitwise (dis)assembly through a plan bucket
+    rng = np.random.RandomState(1)
+    grads = [jnp.asarray(rng.standard_normal(s).astype(np.float32))
+             for s in shapes]
+    plan = gcx.plan_reduction(shapes, dp=1, cfg=_spec())
+    for b in plan.buckets:
+        flat = gcx.flatten_bucket(grads, b)
+        back = dict(gcx.unflatten_bucket(flat, b, grads))
+        for i in b.indices:
+            np.testing.assert_array_equal(np.asarray(back[i]),
+                                          np.asarray(grads[i]))
+
+
+def test_grad_comm_algorithm_threshold_boundary():
+    """>= threshold -> bandwidth route (scatter), below -> one fused
+    psum; int8's latency buckets ride bf16 wire; dp=1 is a no-op."""
+    dp, block = 8, 64
+    # int8 payload of a 2048-elem grad: padded to dp*block=512 multiple
+    # -> 2048 ints + 32 scales * 4B = 2176 bytes
+    payload = 2048 + (2048 // block) * 4
+    at = gcx.plan_reduction([(2048,)], dp=dp, cfg=_spec(
+        thresh=payload / 1024.0))
+    assert at.buckets[0].algorithm == "scatter"
+    assert at.buckets[0].wire_dtype == "int8"
+    assert at.buckets[0].classification == "bandwidth"
+    assert at.buckets[0].collectives == 4
+    below = gcx.plan_reduction([(2048,)], dp=dp, cfg=_spec(
+        thresh=(payload + 1) / 1024.0))
+    assert below.buckets[0].algorithm == "psum"
+    assert below.buckets[0].wire_dtype == "bf16"  # int8 psum can't sum scales
+    assert below.buckets[0].classification == "latency"
+    assert below.buckets[0].collectives == 1
+    # wire bytes: ring model, exact
+    assert at.buckets[0].wire_bytes == round(2 * 7 / 8 * payload)
+    assert below.buckets[0].wire_bytes == round(2 * 7 / 8 * 2048 * 2)
+    # int8 quantized wire is far below the fp32 baseline
+    assert at.wire_bytes_per_step < 0.35 * at.fp32_wire_bytes_per_step
+    none = gcx.plan_reduction([(2048,)], dp=1, cfg=_spec())
+    assert none.buckets[0].algorithm == "none"
+    assert none.wire_bytes_per_step == 0
+    assert none.collectives_per_step == 0
+
+
+def test_grad_comm_error_feedback_accumulation_identity():
+    """Sum of applied (quantized, EF-corrected) updates tracks the sum
+    of true gradients: the residual telescopes, so T steps of int8
+    reduction with EF stay within a one-step error bound, while the
+    EF-off error grows ~T times larger."""
+    from paddle_tpu.core.jax_compat import shard_map
+    dp, n, T = 8, 96, 24
+    mesh = dist.get_mesh()
+    plan = gcx.plan_reduction([(n,)], dp=dp, cfg=_spec(block=32))
+    rng = np.random.RandomState(3)
+    g = jnp.asarray(rng.standard_normal((dp, n)).astype(np.float32))
+    true_mean = np.asarray(g).mean(0)
+
+    def one(res_rows, g_rows, use_res):
+        def local(r, gr):
+            res = [r[0]] if use_res else None
+            out, new_res = gcx.reduce_gradients(
+                [gr[0]], plan=plan, residuals=res)
+            nr = new_res[0] if use_res else jnp.zeros((n,), jnp.float32)
+            return out[0], nr[None]
+        return shard_map(local, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                         out_specs=(P(), P("dp")), check_vma=False)(
+                             res_rows, g_rows)
+
+    for use_res in (True, False):
+        res = jnp.zeros((dp, n), jnp.float32)
+        applied = np.zeros(n, np.float64)
+        for _ in range(T):
+            red, res = one(res, g, use_res)
+            applied += np.asarray(red, np.float64)
+        err = np.abs(applied - T * true_mean).max()
+        if use_res:
+            err_ef = err
+        else:
+            err_plain = err
+    # one-step int8 error scale: half-LSB of the largest block
+    one_step = float(np.abs(np.asarray(g)).max()) / 127.0
+    assert err_ef < 2 * one_step, err_ef
+    assert err_plain > 3 * err_ef, (err_plain, err_ef)
+
+
+def _grad_comm_fc_program(gc=None, zero3=False):
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import optimizer
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        pred = paddle.static.nn.fc(x, 1)
+        loss = F.mse_loss(pred, y)
+        opt = optimizer.Adam(learning_rate=1e-2)
+        f = dist.fleet
+        s = dist.DistributedStrategy()
+        if gc is not None:
+            s.grad_comm = gc
+        if zero3:
+            s.sharding = True
+            s.sharding_configs = {"stage": 3, "min_shard_numel": 1}
+        f.init(is_collective=True, strategy=s)
+        opt = f.distributed_optimizer(opt)
+        opt.minimize(loss)
+    return main, loss
+
+
+def test_grad_comm_executor_parity_wire_stats_and_prediction():
+    """The executor's grad_comm lowering: one compile, loss parity with
+    the GSPMD fp32 default, measured comm.wire_bytes == the cost
+    model's predicted_wire_bytes exactly, algorithm choices recorded."""
+    from paddle_tpu.utils import monitor
+    paddle.enable_static()
+    try:
+        rng = np.random.RandomState(1)
+        xs = rng.standard_normal((64, 8)).astype(np.float32)
+        ys = (xs @ rng.standard_normal((8, 1))).astype(np.float32)
+        feed = {"x": xs, "y": ys}
+        losses = {}
+        wire = {}
+        for mode in (None, "int8"):
+            init_mesh({"dp": 8})
+            paddle.seed(7)
+            gc = (None if mode is None else
+                  {"dtype": mode, "scatter_threshold_KB": 0.01,
+                   "block_size": 64})
+            main, loss = _grad_comm_fc_program(gc)
+            init_mesh({"dp": 8})
+            exe = paddle.static.Executor()
+            w0 = monitor.get_stat("comm.wire_bytes") or 0
+            c0 = monitor.get_stat("comm.collectives") or 0
+            losses[mode] = [float(exe.run(main, feed=feed,
+                                          fetch_list=[loss])[0])
+                            for _ in range(5)]
+            assert exe.compile_count == 1
+            wire[mode] = (monitor.get_stat("comm.wire_bytes") or 0) - w0
+            if mode == "int8":
+                # measured == predicted, by construction
+                plan = exe._plan_for(main, main.parameters())
+                rep = main.analyze(fetch_list=[loss], sharding=plan)
+                comm = rep.totals["comm"]
+                assert comm["enabled"] and comm["dtype"] == "int8"
+                assert wire[mode] == 5 * comm["wire_bytes_per_step"]
+                assert ((monitor.get_stat("comm.collectives") or 0) - c0
+                        == 5 * comm["collectives_per_step"])
+                for c in comm["collectives"]:
+                    assert c["algorithm"] in ("psum", "scatter")
+                    assert c["classification"] in ("latency", "bandwidth")
+                from paddle_tpu.static.analysis.cost import \
+                    compile_summary
+                cs = compile_summary(main, sharding=plan)
+                assert cs["predicted_wire_bytes"] == \
+                    comm["wire_bytes_per_step"]
+                assert cs["comm_enabled"] is True
+                # residual carry lives in the donated aux tree, sharded
+                state = exe._states[main._serial]
+                assert len(state.aux["grad_comm"]) == 1
+                assert state.aux["grad_comm"][0].shape == (8, 9)
+            exe.close()
+            paddle.static.reset_default_programs()
+        assert wire[None] == 0          # GSPMD default: no explicit stage
+        assert wire["int8"] > 0
+        np.testing.assert_allclose(losses[None], losses["int8"],
+                                   atol=2e-3)
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_grad_comm_executor_rejects_sharded_params():
+    """grad_comm + ZeRO-3 (dp-sharded params) must fail loudly at
+    compile — the shard_map grad path would replicate the shards."""
+    paddle.enable_static()
+    try:
+        init_mesh({"dp": 8})
+        main, loss = _grad_comm_fc_program({"dtype": "int8"}, zero3=True)
+        init_mesh({"dp": 8})
+        exe = paddle.static.Executor()
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.standard_normal((64, 8)).astype(np.float32),
+                "y": rng.standard_normal((64, 1)).astype(np.float32)}
+        with pytest.raises(NotImplementedError, match="dp-sharded"):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        exe.close()
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_fp16_allreduce_alias_equals_grad_comm_bf16():
+    """Satellite: strategy.fp16_allreduce is now an alias for
+    grad_comm.dtype='bf16' — the two spellings train bitwise
+    identically through the same reduction plan."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import nn, optimizer
+    results = {}
+    for spelling in ("alias", "explicit"):
+        paddle.seed(23)
+        net = nn.Linear(8, 8)
+        rng = np.random.RandomState(23)
+        x = jnp.asarray(rng.standard_normal((32, 8)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((32, 8)), jnp.float32)
+        strat = dist.DistributedStrategy()
+        if spelling == "alias":
+            strat.fp16_allreduce = True
+        else:
+            strat.grad_comm = {"dtype": "bf16", "error_feedback": False}
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters())
+        step = SpmdTrainStep(net, lambda o, l: F.mse_loss(o, l), opt,
+                             strategy=strat)
+        assert step._grad_comm is not None
+        assert step._grad_comm.dtype == "bf16"
+        if spelling == "alias":
+            assert step._grad_comm.source == "fp16_allreduce"
+        for _ in range(3):
+            step(x, y)
+        assert step._comm_plan is not None
+        results[spelling] = np.asarray(net.weight.data).copy()
+    np.testing.assert_array_equal(results["alias"], results["explicit"])
+
+
+def test_grad_comm_rejects_sum_reduced_loss():
+    """A SUM-reduced loss under grad_comm would silently train at 1/dp
+    gradient scale — the compile-time probe must catch it."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import optimizer
+    paddle.enable_static()
+    try:
+        init_mesh({"dp": 8})
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 8], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            pred = paddle.static.nn.fc(x, 1)
+            diff = pred - y
+            loss = paddle.sum(diff * diff)   # sum, not mean
+            f = dist.fleet
+            s = dist.DistributedStrategy()
+            s.grad_comm = {"dtype": "int8"}
+            f.init(is_collective=True, strategy=s)
+            opt = f.distributed_optimizer(optimizer.SGD(learning_rate=0.1))
+            opt.minimize(loss)
+        init_mesh({"dp": 8})
+        exe = paddle.static.Executor()
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.standard_normal((64, 8)).astype(np.float32),
+                "y": rng.standard_normal((64, 1)).astype(np.float32)}
+        with pytest.raises(NotImplementedError, match="SUM-reduced"):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        exe.close()
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()

@@ -460,3 +460,101 @@ def test_static_dropout_reseeds_per_run():
         assert (c != e).any()
     finally:
         paddle.disable_static()
+
+
+# -- grad_comm knob validation (ISSUE 10 satellites) ---------------------
+
+def test_fuse_grad_size_rejects_nonsense():
+    """fuse_grad_size_in_MB is wired to bucketing now; <=0 must fail
+    with an actionable message instead of silently disabling reduction."""
+    from paddle_tpu.core.enforce import InvalidArgumentError
+    from paddle_tpu.distributed.strategy import validate_toggles
+    for bad in (0, -3, 0.0):
+        s = DistributedStrategy()
+        s.fuse_grad_size_in_MB = bad
+        with pytest.raises(InvalidArgumentError,
+                           match="fuse_grad_size_in_MB"):
+            validate_toggles(s)
+    s = DistributedStrategy()
+    s.fuse_grad_size_in_MB = 16
+    validate_toggles(s)  # positive passes
+
+
+def test_grad_comm_knob_validation():
+    from paddle_tpu.core.enforce import InvalidArgumentError
+    from paddle_tpu.distributed.strategy import validate_toggles
+    s = DistributedStrategy()
+    s.grad_comm = {"dtype": "fp8"}
+    with pytest.raises(InvalidArgumentError, match="wire dtype"):
+        validate_toggles(s)
+    s = DistributedStrategy()
+    s.grad_comm = {"dtype": "int8", "block_size": 0}
+    with pytest.raises(InvalidArgumentError, match="block"):
+        validate_toggles(s)
+    s = DistributedStrategy()
+    s.grad_comm = {"dtype": "int8", "scatter_threshold_KB": -1}
+    with pytest.raises(InvalidArgumentError, match="scatter_threshold"):
+        validate_toggles(s)
+    # the alias conflicts with an explicit non-bf16 dtype
+    s = DistributedStrategy()
+    s.fp16_allreduce = True
+    s.grad_comm = {"dtype": "int8"}
+    with pytest.raises(InvalidArgumentError, match="alias"):
+        validate_toggles(s)
+    # alias + explicit bf16 agree; every valid dtype passes
+    for d in (None, "fp32", "bf16", "int8"):
+        s = DistributedStrategy()
+        s.grad_comm = {"dtype": d}
+        validate_toggles(s)
+
+
+def test_grad_comm_rejects_model_sharded_mesh():
+    """Same guard the fp16_allreduce graft had: the explicit dp
+    reduction cannot run on a mesh carrying model axes."""
+    net, x, y, loss_fn = _toy()
+    dist.init_mesh({"dp": 4, "mp": 2})
+    strat = DistributedStrategy()
+    strat.grad_comm = {"dtype": "int8", "error_feedback": False}
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    with pytest.raises(NotImplementedError, match="grad_comm"):
+        SpmdTrainStep(net, loss_fn, opt, strategy=strat)
+
+
+def test_grad_comm_spmd_int8_trains_close_to_fp32():
+    """int8 block-scaled reduction on the SpmdTrainStep path changes
+    numerics (no silent no-op) while staying close to fp32."""
+    net, x, y, loss_fn = _toy(seed=23, din=8, dout=8, bs=32)
+    init = _weights(net)
+    strat = DistributedStrategy()
+    strat.grad_comm = {"dtype": "int8", "error_feedback": False,
+                       "scatter_threshold_KB": 0.01, "block_size": 32}
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = SpmdTrainStep(net, loss_fn, opt, strategy=strat)
+    for _ in range(3):
+        step(x, y)
+    assert step._comm_plan is not None  # set at first compile
+    assert any(b.wire_dtype == "int8" for b in step._comm_plan.buckets)
+    w_q = np.asarray(net.weight.data).copy()
+
+    net.set_state_dict(init)
+    opt2 = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    base = SpmdTrainStep(net, loss_fn, opt2)
+    for _ in range(3):
+        base(x, y)
+    w_full = np.asarray(net.weight.data).copy()
+    np.testing.assert_allclose(w_q, w_full, rtol=3e-2, atol=3e-3)
+    assert not np.array_equal(w_q, w_full), \
+        "grad_comm int8 changed nothing — silent no-op"
+
+
+def test_fp16_allreduce_zero3_still_raises():
+    """Satellite guard kept through the grad_comm retirement: the alias
+    + ZeRO-3 (dp-sharded params) is still a loud incompatibility."""
+    net, x, y, loss_fn = _toy()
+    strat = DistributedStrategy()
+    strat.fp16_allreduce = True
+    strat.sharding = True
+    strat.sharding_configs = {"stage": 3, "min_shard_numel": 1}
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    with pytest.raises(NotImplementedError, match="fp16_allreduce"):
+        SpmdTrainStep(net, loss_fn, opt, strategy=strat)

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Gradient-collective smoke gate: quantized grad_comm on multichip GPT.
+
+The collective-efficiency promise of ``paddle_tpu.distributed.grad_comm``
+(ISSUE 10 / ROADMAP item 2), executably: the GPT-tiny causal LM from
+``tools/shard_smoke.py``, trained through ``fleet.distributed_optimizer``
++ the static ``Executor`` on an 8-device dp mesh, once with fp32 wire
+(the measured baseline — same math as GSPMD's default, but with the
+explicit bucketed stage so ``comm.*`` stats exist) and once with
+block-scaled int8 + error feedback:
+
+- **wire bytes**: int8 ``comm.wire_bytes``/step < 0.35x the fp32 run's
+  (quantized payload + scales, both measured from monitor stats);
+- **prediction closes**: measured wire bytes == the static cost model's
+  ``predicted_wire_bytes`` (``Program.analyze(sharding=plan)`` comm
+  block) exactly — the plan is the single source of both numbers;
+- **loss parity**: int8-with-error-feedback loss trajectory within
+  2e-3 of the fp32 baseline after every step;
+- **0 steady-state recompiles** (one XLA compile per run) and
+  ``explain_compiles()`` reports no unexplained executor compiles;
+- **bucketing + algorithm selection**: the small fuse budget forces
+  multiple buckets, and every bucket records a psum/scatter choice.
+
+Usage::
+
+    python tools/comm_smoke.py [--steps 8] [--json] [--verbose]
+
+``--json`` prints one JSON line (consumed by ``bench.py --suite
+multichip``).  CI treats a non-zero exit as a regression.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# env BEFORE jax initialises: 8 virtual CPU devices
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+from tools.shard_smoke import _feeds, build_gpt_tiny  # noqa: E402
+
+
+def _train(dtype, steps, verbose=False):
+    """GPT-tiny on mesh {dp: 8} with the given grad_comm wire dtype.
+    Returns a result dict (losses, wire stats, prediction, timing)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import distributed as dist, optimizer
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.utils import monitor
+
+    init_mesh({"dp": 8})
+    paddle.seed(7)
+    main, loss, _ = build_gpt_tiny()
+    with paddle.static.program_guard(main):
+        f = dist.fleet
+        strategy = dist.DistributedStrategy()
+        # small fuse budget -> several buckets (overlap-shaped), low
+        # threshold -> the big buckets take the bandwidth route
+        strategy.fuse_grad_size_in_MB = 0.05
+        strategy.grad_comm = {"dtype": dtype, "error_feedback": True,
+                              "block_size": 256,
+                              "scatter_threshold_KB": 4.0}
+        f.init(is_collective=True, strategy=strategy)
+        opt = f.distributed_optimizer(optimizer.AdamW(learning_rate=1e-3))
+        opt.minimize(loss)
+    init_mesh({"dp": 8})  # fleet.init infers over ALL devices; pin it
+    exe = paddle.static.Executor()
+    feed = _feeds("gpt")
+    w0 = monitor.get_stat("comm.wire_bytes") or 0
+    c0 = monitor.get_stat("comm.collectives") or 0
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])]
+    t0 = time.perf_counter()
+    for _ in range(steps - 1):
+        losses.append(float(exe.run(main, feed=feed,
+                                    fetch_list=[loss])[0]))
+    dt = time.perf_counter() - t0
+    wire = ((monitor.get_stat("comm.wire_bytes") or 0) - w0) / steps
+    colls = ((monitor.get_stat("comm.collectives") or 0) - c0) / steps
+    plan = exe._plan_for(main, main.parameters())
+    rep = main.analyze(fetch_list=[loss], sharding=plan)
+    comm = rep.totals["comm"]
+    state = exe._states[main._serial]
+    out = {
+        "losses": losses,
+        "compiles": exe.compile_count,
+        "wire_bytes_per_step": wire,
+        "collectives_per_step": colls,
+        "predicted_wire_bytes": comm["wire_bytes_per_step"],
+        "predicted_fp32_wire_bytes": comm["fp32_wire_bytes_per_step"],
+        "buckets": len(comm["collectives"]),
+        "algorithms": sorted({c["algorithm"]
+                              for c in comm["collectives"]}),
+        "residual_buckets": len(state.aux.get("grad_comm", [])),
+        "steps_per_sec": (steps - 1) / max(dt, 1e-9),
+    }
+    if verbose:
+        print(f"  {dtype}: losses {['%.4f' % v for v in losses]} "
+              f"wire {wire:.0f}B/step ({out['buckets']} buckets, "
+              f"{out['algorithms']}), {out['steps_per_sec']:.1f} steps/s")
+    exe.close()
+    paddle.static.reset_default_programs()
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON result line on stdout")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.observability import explain_compiles
+
+    problems = []
+    paddle.enable_static()
+    try:
+        fp32 = _train("fp32", args.steps, args.verbose)
+        int8 = _train("int8", args.steps, args.verbose)
+    finally:
+        paddle.disable_static()
+
+    for name, r in (("fp32", fp32), ("int8", int8)):
+        if r["compiles"] != 1:
+            problems.append(f"{name}: {r['compiles']} compiles for one "
+                            f"feed signature — recompiles after warmup")
+        if r["wire_bytes_per_step"] != r["predicted_wire_bytes"]:
+            problems.append(
+                f"{name}: measured wire bytes/step "
+                f"{r['wire_bytes_per_step']} != predicted "
+                f"{r['predicted_wire_bytes']} — the cost model and the "
+                f"runtime disagree")
+    ratio = int8["wire_bytes_per_step"] / max(fp32["wire_bytes_per_step"],
+                                              1)
+    if ratio >= 0.35:
+        problems.append(f"int8 wire bytes are {ratio:.3f}x of fp32 "
+                        f"(gate: < 0.35x)")
+    delta = max(abs(a - b) for a, b in zip(fp32["losses"],
+                                           int8["losses"]))
+    if delta > 2e-3:
+        problems.append(f"int8+error-feedback loss trajectory diverges "
+                        f"{delta:.2e} from fp32 (gate: <= 2e-3)")
+    if int8["buckets"] < 2:
+        problems.append("fuse_grad_size_in_MB did not produce multiple "
+                        "buckets — bucketing is inert")
+    if int8["residual_buckets"] < 1:
+        problems.append("error feedback on but no residual carry in the "
+                        "donated state")
+    ec = explain_compiles("executor")
+    unex = ec["by_cause"].get("executor.unexplained", 0)
+    if unex:
+        problems.append(f"{unex} unexplained executor compile(s)")
+
+    result = {
+        "metric": "multichip_gpt_int8_wire_ratio_vs_fp32",
+        "value": round(ratio, 4),
+        "unit": "x (lower is better; gate < 0.35)",
+        "loss_delta_max": delta,
+        "steps": args.steps,
+        "fp32": {k: v for k, v in fp32.items() if k != "losses"},
+        "int8": {k: v for k, v in int8.items() if k != "losses"},
+        "ok": not problems,
+    }
+    if args.json:
+        print(json.dumps(result))
+    if problems:
+        for p in problems:
+            print(f"FAIL: {p}", file=sys.stderr)
+        return 1
+    if not args.json:
+        print(f"comm_smoke OK: int8 grad_comm wire bytes {ratio:.3f}x "
+              f"of fp32 ({int8['wire_bytes_per_step']:.0f} vs "
+              f"{fp32['wire_bytes_per_step']:.0f} B/step, predicted "
+              f"exactly), loss parity {delta:.1e} <= 2e-3 with error "
+              f"feedback, {int8['buckets']} buckets "
+              f"{int8['algorithms']}, 1 compile each, all compiles "
+              f"attributed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
