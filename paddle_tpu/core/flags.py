@@ -193,6 +193,16 @@ define_flag("perf_chip", "",
             "FLOPs/traffic into a predicted step time for the drift "
             "tracker (static/analysis/cost.CHIP_SPECS key).  Empty = "
             "auto: 'cpu' on the CPU backend, 'v5e' on TPU.")
+define_flag("pallas_interpret", False,
+            "Let the automatic Pallas-tier selectors (the static "
+            "Executor's epilogue-fusion pass, the fused Adam update, "
+            "the paged-attention decode hook) pick Pallas kernels OFF "
+            "TPU, running them in interpret mode.  Interpret mode is "
+            "orders of magnitude slower than jnp — this exists so "
+            "tests, bench and tools/kernel_smoke.py exercise the exact "
+            "TPU kernel dataflow under JAX_PLATFORMS=cpu, never as a "
+            "CPU performance path.  On a real TPU backend the tier "
+            "needs only FLAGS_use_pallas_kernels.")
 define_flag("pallas_attention_dropout_min_seqlen", 512,
             "Flash threshold when attention dropout is active: the XLA "
             "path must materialize [B,H,L,L] dropout masks in HBM, so "

@@ -51,8 +51,8 @@ def _freeze(v):
 
 
 def record_compile(component: str, identity, signature: Dict[str, object],
-                   note: str = "", predicted: Optional[dict] = None
-                   ) -> dict:
+                   note: str = "", predicted: Optional[dict] = None,
+                   kernels: Optional[List[str]] = None) -> dict:
     """Report one compile.
 
     ``component``: "executor" | "jit" | "predictor" | ... .
@@ -67,6 +67,12 @@ def record_compile(component: str, identity, signature: Dict[str, object],
     cost-model change can never masquerade as a recompile cause.
     ``explain_compiles`` surfaces it next to the attribution, which is
     where predicted-vs-measured drift shows up.
+    ``kernels``: the Pallas-tier kernels this executable selected
+    (realized fusion-candidate epilogues, fused Adam) — like
+    ``predicted``, on the record but OUT of the signature: flipping
+    the tier recompiles via its own cache-key field, never as an
+    attribution mystery, and the perf observatory can attribute a
+    step-time delta to kernel on/off by reading the record.
     """
     sig = {k: _freeze(v) for k, v in signature.items()}
     now = time.time()
@@ -95,6 +101,8 @@ def record_compile(component: str, identity, signature: Dict[str, object],
             rec["note"] = note
         if predicted:
             rec["predicted"] = dict(predicted)
+        if kernels:
+            rec["kernels"] = list(kernels)
         _records.append(rec)
         _totals[(component, cause)] += 1
     monitor.stat_add(f"compiles.{component}.{cause}")

@@ -33,10 +33,12 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import apply
+from ..core.flags import get_flag
 from ..core.tensor import Tensor
 
 __all__ = ["paged_attention", "paged_attention_reference",
-           "paged_attention_supported", "register_paged_attention_kernel"]
+           "paged_attention_select", "paged_attention_supported",
+           "register_paged_attention_kernel"]
 
 _NEG = -1e30
 
@@ -62,15 +64,24 @@ def paged_attention_supported(q_shape, kv_pool_shape, dtype,
 
     Requires an installed kernel, a TPU backend, f32/bf16, a head dim
     aligned to the 128-lane registers, and pages aligned to the 8-row
-    f32 sublane tile — the layout the future ragged-paged-attention
-    kernel streams without relayout."""
+    f32 sublane tile — the layout the ragged-paged-attention kernel
+    (ops/pallas/paged_attention.py) streams without relayout.  A 5-D
+    [L, N, page, Hkv, D] pool is accepted for the per-layer ``layer=``
+    dispatch the serving decode step uses.  Off TPU, a kernel that
+    declares ``interpret_ok`` may still dispatch when the process opts
+    into interpret-mode execution with ``FLAGS_pallas_interpret``
+    (tests/bench only — interpret mode is not a performance path)."""
     if _PALLAS_KERNEL is None:
         return False
-    if jax.default_backend() != "tpu":
+    if not get_flag("use_pallas_kernels"):
         return False
+    if jax.default_backend() != "tpu":
+        if not (getattr(_PALLAS_KERNEL, "interpret_ok", False)
+                and get_flag("pallas_interpret")):
+            return False
     if dtype not in (jnp.float32, jnp.bfloat16):
         return False
-    if len(q_shape) != 3 or len(kv_pool_shape) != 4:
+    if len(q_shape) != 3 or len(kv_pool_shape) not in (4, 5):
         return False
     head_dim = q_shape[-1]
     if head_dim % 128 or head_dim != kv_pool_shape[-1]:
@@ -126,6 +137,55 @@ def paged_attention_reference(q, k_pool, v_pool, page_table, lengths,
                                  scale=float(scale), layer=layer)
 
 
+def _kernel_takes_layer(fn) -> bool:
+    """Whether the registered kernel accepts the ``layer=`` kwarg (the
+    stacked-pool contract) — decided by signature inspection, NOT by
+    catching TypeError from the call: JAX raises TypeError for genuine
+    trace-time shape defects too, and swallowing those would silently
+    degrade every decode step to the gather reference."""
+    import inspect
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+    return "layer" in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values())
+
+
+def paged_attention_select(q, k_pool, v_pool, page_table, lengths, *,
+                           scale, layer=None):
+    """Raw-array tier selection: the registered Pallas kernel when the
+    gates accept these shapes (incl. per-layer dispatch over a stacked
+    5-D pool), else the gather reference.  The serving decode step
+    (serving/models.py) calls this inside its compiled step — the hook
+    is what makes TPU decode gather-free without touching engine code.
+
+    Two gates compose: the hook-level :func:`paged_attention_supported`
+    (backend, dtype, tile alignment) and, when the registered kernel
+    publishes one via a ``supported`` attribute, the kernel's own
+    stricter capability check (e.g. whole GQA groups) — shapes either
+    gate rejects take the reference tier cleanly."""
+    pool_shape = tuple(k_pool.shape)
+    kernel = _PALLAS_KERNEL
+    if paged_attention_supported(tuple(q.shape), pool_shape, q.dtype,
+                                 int(pool_shape[-3])):
+        gate = getattr(kernel, "supported", None)
+        if gate is not None and not gate(tuple(q.shape), pool_shape,
+                                         q.dtype, int(pool_shape[-3])):
+            pass  # kernel-side gate rejected: reference tier
+        elif _kernel_takes_layer(kernel):
+            return kernel(q, k_pool, v_pool, page_table, lengths,
+                          scale=float(scale), layer=layer)
+        elif layer is None:
+            # a kernel registered against the PR-7 contract (no layer
+            # kwarg) still serves the 4-D un-stacked case
+            return kernel(q, k_pool, v_pool, page_table, lengths,
+                          scale=float(scale))
+    return _paged_attention_impl(q, k_pool, v_pool, page_table,
+                                 lengths, scale=float(scale),
+                                 layer=layer)
+
+
 def paged_attention(q, k_pool, v_pool, page_table, lengths, scale=None,
                     layer=None, name=None):
     """Decode-phase paged attention (one query token per sequence).
@@ -140,12 +200,11 @@ def paged_attention(q, k_pool, v_pool, page_table, lengths, scale=None,
         scale = 1.0 / math.sqrt(q_arr.shape[-1])
     pool_shape = tuple((k_pool.data if isinstance(k_pool, Tensor)
                         else k_pool).shape)
-    if layer is None and paged_attention_supported(
+    if paged_attention_supported(
             q_arr.shape, pool_shape, q_arr.dtype, int(pool_shape[-3])):
-        fn = _PALLAS_KERNEL
-        return apply(fn, q, k_pool, v_pool, page_table, lengths,
-                     op_name="paged_attention", nondiff=True,
-                     scale=float(scale))
+        return apply(paged_attention_select, q, k_pool, v_pool,
+                     page_table, lengths, op_name="paged_attention",
+                     nondiff=True, scale=float(scale), layer=layer)
     return apply(_paged_attention_impl, q, k_pool, v_pool, page_table,
                  lengths, op_name="paged_attention", nondiff=True,
                  scale=float(scale), layer=layer)

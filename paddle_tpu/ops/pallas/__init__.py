@@ -3,12 +3,36 @@
 These are the TPU analog of the reference's hand-written CUDA fusions
 (reference: operators/math/bert_encoder_functor.cu multi-head attention,
 operators/fused/, ir/*_fuse_pass.cc): where XLA's automatic fusion is not
-enough (attention's softmax-rescale dataflow), we write the kernel by hand
-against the MXU/VMEM model.  Selection is behind FLAGS_use_pallas_kernels
-with per-op capability checks; every kernel has an interpret-mode path so
-the same code runs (slowly) on CPU in tests.
+enough (attention's softmax-rescale dataflow, the matmul-epilogue chains
+the cost model ranks, the optimizer's multi-pass update), we write the
+kernel by hand against the MXU/VMEM model.  Selection is behind
+FLAGS_use_pallas_kernels with per-op capability checks (plus the
+FLAGS_pallas_interpret opt-in off TPU); every kernel has an
+interpret-mode path so the same code runs (slowly) on CPU in tests.
+
+The tier:
+
+- ``flash_attention``        — online-softmax attention, fwd + bwd;
+- ``fused_linear_epilogue``  — matmul + bias/gelu/relu/residual/
+  layer_norm epilogues off the cost model's ranked fusion candidates
+  (selected by the static Executor's fusion pass);
+- ``fused_adam_update``      — one-pass Adam over the donated
+  ``_ExecState`` param/slot pairs;
+- ``paged_attention_decode`` — gather-free paged decode attention
+  behind ``ops.attention.register_paged_attention_kernel``.
+
+Shared backend/gate/counter plumbing lives in ``support.py``.
 """
 from .flash_attention import (flash_attention, flash_attention_supported,
                               mha_reference)
+from .fused_adam import fused_adam_supported, fused_adam_update
+from .fused_epilogue import (fused_epilogue_supported,
+                             fused_linear_epilogue, reference_epilogue)
+from .paged_attention import paged_attention_decode, paged_decode_supported
+from .support import kernel_selections
 
-__all__ = ["flash_attention", "flash_attention_supported", "mha_reference"]
+__all__ = ["flash_attention", "flash_attention_supported", "mha_reference",
+           "fused_adam_supported", "fused_adam_update",
+           "fused_epilogue_supported", "fused_linear_epilogue",
+           "reference_epilogue", "paged_attention_decode",
+           "paged_decode_supported", "kernel_selections"]

@@ -30,34 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # TPU-only module; absent on some CPU-only installs
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover
-    pltpu = None
-
-NEG_INF = -1e30
-
-
-def _dot(a, b, dims):
-    """MXU matmul with f32 accumulation.  Precision is explicit: the global
-    jax_default_matmul_precision=highest (used by tests) is not lowerable by
-    Mosaic for bf16 operands; bf16 x bf16 -> f32 is the MXU-native path."""
-    prec = (jax.lax.Precision.DEFAULT if a.dtype == jnp.bfloat16
-            else jax.lax.Precision.HIGHEST)
-    return jax.lax.dot_general(a, b, (dims, ((), ())),
-                               preferred_element_type=jnp.float32,
-                               precision=prec)
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _smem_scalar_spec():
-    if pltpu is not None:
-        return pl.BlockSpec((1, 1), lambda *_: (0, 0),
-                            memory_space=pltpu.SMEM)
-    return pl.BlockSpec((1, 1), lambda *_: (0, 0))
+from .support import (NEG_INF, dot as _dot, interpret_mode as _interpret,
+                      pltpu, smem_scalar_spec as _smem_scalar_spec)
 
 
 def flash_attention_supported(q_shape, k_shape, dtype, attn_mask=None,

@@ -1,0 +1,110 @@
+"""Shared plumbing for the Pallas kernel tier.
+
+Every kernel file (flash_attention, fused_epilogue, fused_adam,
+paged_attention) needs the same four decisions made the same way:
+
+- **backend**: ``pltpu`` import (absent on some CPU-only installs),
+  interpret mode when not on a real TPU;
+- **activation**: the tier is ON when ``FLAGS_use_pallas_kernels`` is
+  set AND either the backend is TPU or ``FLAGS_pallas_interpret``
+  explicitly opts a CPU process into interpret-mode execution (tests,
+  bench, kernel_smoke — interpret mode is orders of magnitude slower
+  than jnp, so it must never be the silent CPU default);
+- **gates**: dtype and tile-alignment checks against the f32 (8, 128)
+  sublane/lane tile;
+- **observability**: every kernel SELECTION counts
+  ``pallas.selected.<kernel>`` in monitor.  Selections happen at trace
+  time (the kernel entry points run inside jitted programs, once per
+  compile, then the baked executable dispatches without re-entering
+  Python) — the counters say which kernels are compiled into the
+  program, not how many times they executed; per-step volume belongs
+  to the perf observatory.  "FLAGS off => zero selections" is the
+  testable contract.
+
+One place decides all four; the kernel files keep only their math.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only module; absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["pltpu", "interpret_mode", "tier_enabled", "dtype_ok",
+           "smem_scalar_spec", "count_kernel_selection",
+           "kernel_selections", "block_rows", "NEG_INF"]
+
+NEG_INF = -1e30
+
+
+def dot(a, b, dims):
+    """MXU matmul with f32 accumulation.  Precision is explicit: the
+    global jax_default_matmul_precision=highest (used by tests) is not
+    lowerable by Mosaic for bf16 operands; bf16 x bf16 -> f32 is the
+    MXU-native path."""
+    prec = (jax.lax.Precision.DEFAULT if a.dtype == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32,
+                               precision=prec)
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret mode: everywhere except a real TPU backend."""
+    return jax.default_backend() != "tpu"
+
+
+def tier_enabled() -> bool:
+    """Should automatic paths (Executor fusion pass, fused Adam, the
+    serving decode hook) select Pallas kernels right now?
+
+    ``FLAGS_use_pallas_kernels`` is the master switch; off-TPU the tier
+    additionally requires the explicit ``FLAGS_pallas_interpret`` opt-in
+    — interpret mode exists for numerics tests, not for speed, so a CPU
+    training run must never pay it by accident."""
+    from ...core.flags import get_flag
+    if not get_flag("use_pallas_kernels"):
+        return False
+    if jax.default_backend() == "tpu":
+        return True
+    return bool(get_flag("pallas_interpret"))
+
+
+def dtype_ok(dtype) -> bool:
+    """The two dtypes every tier kernel accumulates from (f32 math)."""
+    return jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16))
+
+
+def smem_scalar_spec():
+    """(1, 1) scalar operand placed in SMEM on TPU (plain block spec in
+    interpret mode / when pltpu is unavailable)."""
+    if pltpu is not None:
+        return pl.BlockSpec((1, 1), lambda *_: (0, 0),
+                            memory_space=pltpu.SMEM)
+    return pl.BlockSpec((1, 1), lambda *_: (0, 0))
+
+
+# selection counter: {kernel name: trace-time selections} (see module
+# docstring — compiles, not executions).  Tests assert the OFF contract
+# (flag off => no entry moves); bench embeds the delta per suite.
+kernel_selections: dict = {}
+
+
+def count_kernel_selection(name: str) -> None:
+    kernel_selections[name] = kernel_selections.get(name, 0) + 1
+    from ...utils import monitor
+    monitor.stat_add(f"pallas.selected.{name}")
+
+
+def block_rows(m: int, preferred: int = 512) -> int:
+    """Largest power-of-two row-block <= ``preferred`` that tiles ``m``
+    (assumes ``m % 8 == 0``, the f32 sublane gate)."""
+    bm = preferred
+    while bm > 8 and m % bm:
+        bm //= 2
+    return max(min(bm, m), 1)

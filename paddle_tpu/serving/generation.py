@@ -237,6 +237,20 @@ class GenerationEngine:
                          else int(decode_retries))
         self._donate = bool(donate_kv)
 
+        # Pallas tier: install the paged-attention decode kernel behind
+        # the ops.attention hook when the tier is active (TPU, or the
+        # explicit FLAGS_pallas_interpret opt-in) and nothing is
+        # registered yet — the compiled decode step then resolves to
+        # gather-free VMEM-resident attention through
+        # paged_attention_select; the shape gate still owns the final
+        # per-shape decision, so misaligned models stay on the
+        # reference tier untouched
+        from ..ops import attention as _attn
+        from ..ops.pallas.support import tier_enabled
+        if tier_enabled() and _attn._PALLAS_KERNEL is None:
+            from ..ops.pallas.paged_attention import register
+            register()
+
         # scheduler state (slots touched only by the scheduler thread)
         self._slots: List[Optional[_Sequence]] = [None] * self._slots_n
         self._tables = np.zeros((self._slots_n, self._P), np.int32)

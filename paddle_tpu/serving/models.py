@@ -147,7 +147,11 @@ class PagedDecoderLM:
             q, k, v = self._qkv(x, l)
             k_pool = write_token(k_pool, l, k, page_tables, positions)
             v_pool = write_token(v_pool, l, v, page_tables, positions)
-            attn = _attn.paged_attention_reference(
+            # tier selection: the registered Pallas decode kernel when
+            # the gate accepts (TPU / explicit interpret opt-in), else
+            # the gather reference — resolved at trace time, so the
+            # compiled decode step bakes one tier in
+            attn = _attn.paged_attention_select(
                 q, k_pool, v_pool, page_tables, lengths,
                 scale=self._scale, layer=l)
             x = self._mlp_residual(x, attn, l)

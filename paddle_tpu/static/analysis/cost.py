@@ -629,12 +629,19 @@ class ProgramReport:
                     f"    {name:>4}: step {r['predicted_step_s'] * 1e3:.3f} ms, "
                     f"MFU {r['predicted_mfu']:.3f}, {r['bound']}-bound")
         if self.fusion_candidates:
-            lines.append("  fusion candidates (by HBM traffic saved):")
+            n_real = sum(1 for c in self.fusion_candidates
+                         if c.get("realized"))
+            lines.append(
+                f"  fusion candidates (by HBM traffic saved; "
+                f"{n_real}/{len(self.fusion_candidates)} realized by "
+                f"the Pallas tier):")
             for c in self.fusion_candidates:
                 loc = f" @ {c['loc']}" if c.get("loc") else ""
+                real = (f" [realized: {c['realized']}]"
+                        if c.get("realized") else "")
                 lines.append(
                     f"    {'+'.join(c['op_names'])} (ops {c['ops']}): "
-                    f"saves {_fmt_bytes(c['saved_bytes'])}{loc}")
+                    f"saves {_fmt_bytes(c['saved_bytes'])}{loc}{real}")
         if self.hazards:
             lines.append("  hazards:")
             for d in self.hazards:
@@ -791,6 +798,17 @@ def analyze(program: Program, fetch_list: Optional[Sequence] = None,
 
     fetched_ids = {id(v) for v in fetch_vars}
     cands = _fusion_candidates(graph, costs, avals, fetched_ids, top_k)
+    if cands:
+        # mark what the executor's epilogue-fusion pass realizes for
+        # each candidate under the current flags (same matcher, same
+        # gates — prediction and execution cannot disagree); the
+        # report then separates realized from still-unrealized savings.
+        # Under a sharding plan the executor skips the pass entirely
+        # (pallas_call below an explicit GSPMD lowering is unsupported)
+        # — the report must say so too, hence plan_active.
+        from .fusion import annotate_candidates
+        annotate_candidates(program, cands, graph, avals, fetched_ids,
+                            plan_active=sharding is not None)
 
     hazards: List[Diagnostic] = []
     if include_hazards:

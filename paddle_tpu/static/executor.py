@@ -636,9 +636,16 @@ class Executor:
             trc.set_step(run_i)
 
         plan = self._plan_for(program, params)
+        # the Pallas tier state is part of the cache key: flipping
+        # FLAGS_use_pallas_kernels / FLAGS_pallas_interpret must
+        # recompile (and attribute as new_pallas), never reuse an
+        # executable built with the other tier baked in
+        from ..ops.pallas.support import tier_enabled
+        pallas_on = tier_enabled() and plan is None
         key = (program._serial, program._version, feed_names,
                tuple((a.shape, str(a.dtype)) for a in feed_arrays),
                tuple(fetch_names), program._optimizer is not None, donate,
+               pallas_on,
                None if plan is None else plan.fingerprint())
         compiled = self._cache.get(key)
         compiled_this_run = compiled is None
@@ -713,7 +720,9 @@ class Executor:
                 "fetch_set": tuple(fetch_names),
                 "optimizer": program._optimizer is not None,
                 "donate": donate,
-            }, predicted=predicted)
+                "pallas": pallas_on,
+            }, predicted=predicted,
+                kernels=getattr(compiled, "_pallas_kernels", None))
 
         state = self._state_for(program, params)
 
@@ -1108,6 +1117,30 @@ class Executor:
         nodes = list(program.nodes)
         opt_pack = program._optimizer
 
+        # -- Pallas tier: epilogue-fusion pass ------------------------
+        # Realize the cost model's ranked fusion candidates: matched
+        # single-consumer chains (linear anchor + bias/gelu/relu/
+        # residual/layer_norm epilogue) rewrite to ONE fused kernel
+        # node (ops/pallas/fused_epilogue, fwd + custom-vjp bwd) under
+        # the RUN-TIME feed shapes.  Single-device only: pallas_call
+        # under an explicit GSPMD sharding plan is not a lowering this
+        # tier supports.  The realized kernel list rides the compile
+        # record (kernels=) so explain_compiles / the perf observatory
+        # can attribute step-time deltas to the tier being on or off.
+        realized_kernels: List[str] = []
+        from ..ops.pallas.support import tier_enabled
+        pallas_on = tier_enabled() and plan is None
+        if pallas_on:
+            from .analysis import fusion
+            fplans = fusion.plan_fusions(
+                program, fetch_list=list(fetch_names),
+                feed_shapes={n: tuple(a.shape) for n, a in
+                             zip(feed_names, feed_arrays)})
+            if fplans:
+                nodes = fusion.apply_plans(nodes, fplans)
+                realized_kernels.extend(
+                    f"fused_epilogue[{p.label}]" for p in fplans)
+
         def forward_env(p_arrays, feed_arrays):
             env = {}
             for name, arr in zip(feed_names, feed_arrays):
@@ -1130,6 +1163,7 @@ class Executor:
                 def compiled(*args):
                     return jitted(*args)
 
+                compiled._pallas_kernels = realized_kernels
                 return compiled
             p_sh, _, _, rep, feed_sh, fetch_sh = self._shardings(
                 plan, params, [], None, feed_arrays, fetch_names)
@@ -1153,6 +1187,21 @@ class Executor:
 
         t_idx = [i for i, p in enumerate(params) if trainable(p)]
         params_meta = [params[i] for i in t_idx]
+
+        # -- Pallas tier: fused Adam over the donated param/slot pairs --
+        # One kernel pass reads (p, g, m, v) once and writes (p', m',
+        # v') once per param, replacing the composite multi-op update.
+        # fused_update_for returns None unless it reproduces THIS
+        # optimizer's exact semantics (plain f32 Adam, no clip/decay/
+        # master weights) — everything else stays on functional_update.
+        fused_update = None
+        if pallas_on:
+            from .analysis.liveness import param_array
+            from ..ops.pallas.fused_adam import fused_update_for
+            fused_update = fused_update_for(
+                opt, params_meta, [param_array(p) for p in params_meta])
+            if fused_update is not None:
+                realized_kernels.append("fused_adam")
 
         # -- grad_comm: explicit quantized/bucketed gradient collectives --
         # When the plan carries a grad_comm spec (strategy.grad_comm /
@@ -1190,7 +1239,9 @@ class Executor:
             t_arrays = [p_arrays[i] for i in t_idx]
             (loss, env), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(t_arrays)
-            new_t, new_s = opt.functional_update(
+            update = (fused_update if fused_update is not None
+                      else opt.functional_update)
+            new_t, new_s = update(
                 t_arrays, grads, opt_state, lr, step_i,
                 params_meta=params_meta)
             new_p = list(p_arrays)
@@ -1224,6 +1275,7 @@ class Executor:
                 return jitted(*args)
 
         compiled._t_idx = t_idx
+        compiled._pallas_kernels = realized_kernels
         return compiled
 
     # -- pre-change reference path (bench comparison + oracle) -------------

@@ -3,6 +3,8 @@ compile on TPU — parity there was measured during bring-up).
 
 Modelled on the reference's fused-op tests (test_fused_attention_op.py
 pattern: fused output vs composed-op oracle, fwd + grad)."""
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -184,3 +186,318 @@ def test_flash_dropout_raises_off_tpu():
     q = jnp.ones((1, 8, 1, 8), jnp.float32)
     with pytest.raises(NotImplementedError, match="TPU"):
         flash_attention(q, q, q, dropout_p=0.1)
+
+
+# ---------------------------------------------------------------------------
+# fused matmul-epilogue kernels (ISSUE 11 tentpole)
+# ---------------------------------------------------------------------------
+
+def _epilogue_case(stages, r, M=(2, 16), K=16, N=128):
+    q = {
+        "x": jnp.asarray(r.randn(*M, K), jnp.float32),
+        "w": jnp.asarray(r.randn(K, N) * 0.3, jnp.float32),
+        "b": jnp.asarray(r.randn(N) * 0.1, jnp.float32),
+    }
+    ops = []
+    for st in stages:
+        if st[0] == "add":
+            ops.append(jnp.asarray(r.randn(*M, N), jnp.float32))
+        elif st[0] == "layer_norm":
+            if st[2]:
+                ops.append(jnp.asarray(1.0 + 0.1 * r.randn(N),
+                                       jnp.float32))
+            if st[3]:
+                ops.append(jnp.asarray(0.1 * r.randn(N), jnp.float32))
+    return q, tuple(ops)
+
+
+@pytest.mark.parametrize("stages", [
+    (),
+    (("gelu", False),),
+    (("gelu", True),),
+    (("relu",),),
+    (("add",),),
+    (("add",), ("layer_norm", 1e-5, True, True)),
+    (("layer_norm", 1e-5, True, True),),
+], ids=lambda s: "+".join(x[0] for x in s) or "bias_only")
+def test_fused_epilogue_fwd_bwd_oracle(stages):
+    from paddle_tpu.ops.pallas.fused_epilogue import (
+        fused_linear_epilogue, reference_epilogue)
+    r = np.random.RandomState(0)
+    q, ops = _epilogue_case(stages, r)
+
+    out = fused_linear_epilogue(q["x"], q["w"], q["b"], stages, ops,
+                                interpret=True)
+    ref = reference_epilogue(q["x"], q["w"], q["b"], stages, ops)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+
+    def loss_fused(x, w, b, *ops):
+        o = fused_linear_epilogue(x, w, b, stages, ops, interpret=True)
+        return jnp.sum(o * o)
+
+    def loss_ref(x, w, b, *ops):
+        o = reference_epilogue(x, w, b, stages, ops)
+        return jnp.sum(o * o)
+
+    argn = tuple(range(3 + len(ops)))
+    gf = jax.grad(loss_fused, argn)(q["x"], q["w"], q["b"], *ops)
+    gr = jax.grad(loss_ref, argn)(q["x"], q["w"], q["b"], *ops)
+    for a, b in zip(gf, gr):
+        scale = max(float(jnp.max(jnp.abs(b))), 1e-6)
+        np.testing.assert_allclose(np.asarray(a) / scale,
+                                   np.asarray(b) / scale,
+                                   atol=1e-5, rtol=1e-4)
+
+
+def test_fused_epilogue_bf16():
+    from paddle_tpu.ops.pallas.fused_epilogue import (
+        fused_linear_epilogue, reference_epilogue)
+    r = np.random.RandomState(1)
+    stages = (("gelu", True),)
+    x = jnp.asarray(r.randn(16, 16), jnp.bfloat16)
+    w = jnp.asarray(r.randn(16, 128) * 0.3, jnp.bfloat16)
+    b = jnp.asarray(r.randn(128) * 0.1, jnp.bfloat16)
+    out = fused_linear_epilogue(x, w, b, stages, interpret=True)
+    ref = reference_epilogue(x, w, b, stages)
+    assert out.dtype == jnp.bfloat16
+    # the kernel holds the f32 accumulator through the epilogue while
+    # the composite rounds to bf16 after the matmul — bf16-step
+    # tolerance, not parity
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=6e-2, rtol=6e-2)
+
+
+def test_fused_epilogue_gate():
+    from paddle_tpu.ops.pallas.fused_epilogue import \
+        fused_epilogue_supported
+    ok = fused_epilogue_supported((32, 16), (16, 128), jnp.float32)
+    assert ok
+    # misaligned N / rows, wrong dtype, K mismatch
+    assert not fused_epilogue_supported((32, 16), (16, 100), jnp.float32)
+    assert not fused_epilogue_supported((33, 16), (16, 128), jnp.float32)
+    assert not fused_epilogue_supported((32, 16), (16, 128), jnp.int32)
+    assert not fused_epilogue_supported((32, 8), (16, 128), jnp.float32)
+    # operand shape must match its stage
+    assert fused_epilogue_supported(
+        (32, 16), (16, 128), jnp.float32, (("add",),), ((32, 128),))
+    assert not fused_epilogue_supported(
+        (32, 16), (16, 128), jnp.float32, (("add",),), ((16, 128),))
+
+
+# ---------------------------------------------------------------------------
+# fused Adam
+# ---------------------------------------------------------------------------
+
+def test_fused_adam_trajectory_vs_unfused():
+    from paddle_tpu.optimizer.optimizer import Adam
+    from paddle_tpu.ops.pallas.fused_adam import fused_adam_update
+    r = np.random.RandomState(0)
+    opt = Adam(learning_rate=1e-3)
+    for shape in [(7,), (130, 33)]:  # pad-exercising ragged shapes
+        p = jnp.asarray(r.randn(*shape), jnp.float32)
+        s = opt.init_slots(p)
+        pf, mf, vf = p, s["m"], s["v"]
+        pr, sr = p, dict(s)
+        for step in range(1, 7):
+            g = jnp.asarray(r.randn(*shape), jnp.float32)
+            pf, mf, vf = fused_adam_update(pf, g, mf, vf, 1e-3,
+                                           float(step), interpret=True)
+            pr, sr = opt.update_param(
+                pr, g, sr, jnp.asarray(1e-3, jnp.float32),
+                jnp.asarray(step, jnp.float32))
+        assert float(jnp.max(jnp.abs(pf - pr))) < 1e-6
+        assert float(jnp.max(jnp.abs(mf - sr["m"]))) < 1e-6
+        assert float(jnp.max(jnp.abs(vf - sr["v"]))) < 1e-6
+
+
+def test_fused_adam_eligibility():
+    from paddle_tpu import optimizer
+    from paddle_tpu.ops.pallas.fused_adam import fused_update_for
+    p = jnp.zeros((8, 8), jnp.float32)
+    assert fused_update_for(optimizer.Adam(1e-3), [None], [p]) is not None
+    # AdamW (decoupled decay), clip, multi-precision, bf16: composite
+    assert fused_update_for(
+        optimizer.AdamW(1e-3, weight_decay=0.01), [None], [p]) is None
+    from paddle_tpu.optimizer.clip import ClipGradByGlobalNorm
+    assert fused_update_for(
+        optimizer.Adam(1e-3, grad_clip=ClipGradByGlobalNorm(1.0)),
+        [None], [p]) is None
+    assert fused_update_for(
+        optimizer.Adam(1e-3), [None],
+        [jnp.zeros((8, 8), jnp.bfloat16)]) is None
+
+
+# ---------------------------------------------------------------------------
+# paged-attention decode kernel
+# ---------------------------------------------------------------------------
+
+def _paged_case(r, S=3, H=4, Hkv=2, D=128, page=8, P=4, N=12, layers=0):
+    pool_shape = ((layers, N, page, Hkv, D) if layers
+                  else (N, page, Hkv, D))
+    return (jnp.asarray(r.randn(S, H, D), jnp.float32),
+            jnp.asarray(r.randn(*pool_shape), jnp.float32),
+            jnp.asarray(r.randn(*pool_shape), jnp.float32),
+            jnp.asarray(r.randint(0, N, (S, P)), jnp.int32),
+            jnp.asarray([1, 13, 32], jnp.int32)[:S])
+
+
+def test_paged_decode_kernel_vs_reference_gqa_ragged():
+    from paddle_tpu.ops.attention import paged_attention_reference
+    from paddle_tpu.ops.pallas.paged_attention import \
+        paged_attention_decode
+    r = np.random.RandomState(0)
+    q, kp, vp, table, lens = _paged_case(r)
+    got = paged_attention_decode(q, kp, vp, table, lens, interpret=True)
+    ref = paged_attention_reference(q, kp, vp, table, lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_paged_decode_kernel_stacked_layers():
+    from paddle_tpu.ops.attention import paged_attention_reference
+    from paddle_tpu.ops.pallas.paged_attention import \
+        paged_attention_decode
+    r = np.random.RandomState(1)
+    q, kp, vp, table, lens = _paged_case(r, layers=3)
+    for layer in range(3):
+        got = paged_attention_decode(q, kp, vp, table, lens,
+                                     layer=layer, interpret=True)
+        ref = paged_attention_reference(q, kp, vp, table, lens,
+                                        layer=layer)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-6, rtol=2e-6)
+
+
+def test_paged_decode_gate():
+    from paddle_tpu.ops.pallas.paged_attention import \
+        paged_decode_supported
+    assert paged_decode_supported((4, 4, 128), (9, 8, 2, 128),
+                                  jnp.float32, 8)
+    assert paged_decode_supported((4, 4, 128), (3, 9, 8, 2, 128),
+                                  jnp.float32, 8)          # stacked
+    assert not paged_decode_supported((4, 4, 64), (9, 8, 2, 64),
+                                      jnp.float32, 8)      # lane align
+    assert not paged_decode_supported((4, 4, 128), (9, 6, 2, 128),
+                                      jnp.float32, 6)      # page align
+    assert not paged_decode_supported((4, 3, 128), (9, 8, 2, 128),
+                                      jnp.float32, 8)      # ragged GQA
+    assert not paged_decode_supported((4, 4, 128), (9, 8, 2, 128),
+                                      jnp.int32, 8)
+
+
+# ---------------------------------------------------------------------------
+# executor fusion pass: selection, fallback, OFF contract
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def static_guard():
+    paddle.enable_static()
+    set_flags({"pallas_interpret": True})
+    yield
+    set_flags({"pallas_interpret": False, "use_pallas_kernels": True})
+    paddle.disable_static()
+    paddle.static.reset_default_programs()
+
+
+def _mini_program(width=128):
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import optimizer
+    paddle.seed(3)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, width], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        h = paddle.static.nn.fc(x, width, activation="relu")
+        loss = F.mse_loss(paddle.static.nn.fc(h, 1), y)
+        optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return main, loss
+
+
+def _feed(width, batch=16):
+    r = np.random.RandomState(0)
+    return {"x": jnp.asarray(r.standard_normal(
+                (batch, width)).astype(np.float32)),
+            "y": jnp.asarray(r.standard_normal(
+                (batch, 1)).astype(np.float32))}
+
+
+def test_executor_realizes_and_records_selection(static_guard):
+    from paddle_tpu.observability import explain_compiles
+    main, loss = _mini_program()
+    exe = paddle.static.Executor()
+    for _ in range(3):
+        out = exe.run(main, feed=_feed(128), fetch_list=[loss])
+    assert np.isfinite(out[0]).all()
+    assert exe.compile_count == 1  # 0 recompiles after warmup
+    recs = [r for r in explain_compiles("executor")["records"]
+            if r["identity"] == main._serial]
+    kernels = recs[-1].get("kernels", [])
+    assert any(k.startswith("fused_epilogue[matmul+bias+relu]")
+               for k in kernels)
+    assert "fused_adam" in kernels
+    # analyze marks the same candidate realized (shared matcher); the
+    # batch_size hint re-derives the dynamic batch dim — the recorded
+    # placeholder of 1 fails the row-tile gate, as it should
+    rep = main.analyze(fetch_list=[loss], batch_size=16)
+    assert any(c.get("realized") for c in rep.fusion_candidates)
+    assert "realized" in rep.render()
+    exe.close()
+
+
+def test_flag_off_is_bitwise_and_selects_nothing(static_guard):
+    from paddle_tpu.observability import explain_compiles
+    from paddle_tpu.ops.pallas.support import kernel_selections
+
+    def losses(flag):
+        set_flags({"use_pallas_kernels": flag})
+        main, loss = _mini_program()
+        exe = paddle.static.Executor()
+        out = [float(exe.run(main, feed=_feed(128),
+                             fetch_list=[loss])[0])
+               for _ in range(4)]
+        serial = main._serial
+        exe.close()
+        return out, serial
+
+    before = dict(kernel_selections)
+    off, off_serial = losses(False)
+    assert dict(kernel_selections) == before  # zero Pallas selections
+    recs = [r for r in explain_compiles("executor")["records"]
+            if r["identity"] == off_serial]
+    assert not recs[-1].get("kernels")
+    on, _ = losses(True)
+    # the tier changes float association; the OFF path must be the
+    # exact pre-tier composite, so two OFF runs are bitwise
+    off2, _ = losses(False)
+    assert off == off2
+    assert max(abs(a - b) for a, b in zip(on, off)) < 1e-4
+
+
+def test_gated_out_shapes_fall_back_to_composite(static_guard):
+    from paddle_tpu.observability import explain_compiles
+    # width 100 fails the N%128 gate -> no epilogue; fused_adam still
+    # eligible and selected
+    main, loss = _mini_program(width=100)
+    exe = paddle.static.Executor()
+    out = exe.run(main, feed=_feed(100), fetch_list=[loss])
+    assert np.isfinite(out[0]).all()
+    recs = [r for r in explain_compiles("executor")["records"]
+            if r["identity"] == main._serial]
+    kernels = recs[-1].get("kernels", [])
+    assert not any(k.startswith("fused_epilogue") for k in kernels)
+    exe.close()
+
+
+def test_kernel_smoke_in_process():
+    import sys
+    TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    sys.path.insert(0, TOOLS)
+    try:
+        import kernel_smoke
+    finally:
+        sys.path.remove(TOOLS)
+    failures = kernel_smoke.run_checks()
+    assert not failures, failures

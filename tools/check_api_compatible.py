@@ -46,6 +46,7 @@ _MODULES = [
     "paddle_tpu.distribution", "paddle_tpu.profiler",
     "paddle_tpu.observability",
     "paddle_tpu.inference", "paddle_tpu.serving",
+    "paddle_tpu.ops.pallas",
     "paddle_tpu.quantization",
     "paddle_tpu.utils", "paddle_tpu.onnx",
 ]
