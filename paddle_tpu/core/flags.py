@@ -145,6 +145,23 @@ define_flag("dataloader_timeout", 120,
 define_flag("dataloader_batch_retries", 3,
             "Times one batch may be re-enqueued after DataLoader worker "
             "deaths before the epoch fails for good.")
+define_flag("dataloader_respawn_backoff_s", 0.2,
+            "Base delay before respawning a dead DataLoader worker when "
+            "deaths are clustering: the first death in the crash-loop "
+            "window respawns immediately, the Nth waits "
+            "~base*2^(N-2) (capped by "
+            "FLAGS_dataloader_respawn_backoff_max_s).  Keeps a flapping "
+            "node from burning the batch retry budget in a tight "
+            "respawn loop.")
+define_flag("dataloader_respawn_backoff_max_s", 5.0,
+            "Cap on the per-respawn backoff delay.")
+define_flag("dataloader_crashloop_window_s", 30.0,
+            "Sliding window for DataLoader worker crash-loop detection.")
+define_flag("dataloader_crashloop_budget", 6,
+            "Worker deaths tolerated inside the crash-loop window; one "
+            "more raises WorkerCrashLoop with the full exit_history "
+            "instead of respawning again (fast-fail for a poisoned "
+            "dataset or a dying node).")
 define_flag("mesh_replace_warn_only", False,
             "Downgrade the error raised when init_mesh/set_mesh would "
             "replace a live mesh that compiled programs still hold "

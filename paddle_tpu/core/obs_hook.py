@@ -16,6 +16,8 @@ _crash = None       # callable(exc, context_str) when a flight
                     # recorder is installed
 _perf = None        # paddle_tpu.observability.perf.PerfObservatory
                     # when the runtime performance observatory is on
+_heartbeat = None   # paddle_tpu.distributed.supervisor.HeartbeatWriter
+                    # when this process runs under a TrainingSupervisor
 
 
 def set_tracer(tracer) -> None:
@@ -43,3 +45,12 @@ def set_crash_handler(fn) -> None:
 
 def crash_handler():
     return _crash
+
+
+def set_heartbeat(hb) -> None:
+    global _heartbeat
+    _heartbeat = hb
+
+
+def current_heartbeat():
+    return _heartbeat

@@ -19,13 +19,18 @@ from .strategy import DistributedStrategy  # noqa: F401
 # `paddle_tpu.distributed.sharding` is the GSPMD sharding subsystem
 # (rule engine, plans, reshardable checkpoint state);
 # `paddle_tpu.distributed.grad_comm` is the quantized/bucketed
-# gradient-collective stage (strategy.grad_comm knobs)
+# gradient-collective stage (strategy.grad_comm knobs);
+# `paddle_tpu.distributed.supervisor` is the self-healing layer that
+# keeps a training entrypoint alive (hang watchdog, elastic restart)
 from . import grad_comm  # noqa: F401
 from . import sharding  # noqa: F401
+from . import supervisor  # noqa: F401
 from .sharding import (ShardedState, ShardingPlan,  # noqa: F401
                        SpecLayout, gather_tree, match_partition_rules,
                        plan_for_params, shard_tree, spec_divisor,
                        specs_for_state, with_constraint)
+from .supervisor import (StepWatchdog, SupervisorGaveUp,  # noqa: F401
+                         SupervisorResult, TrainingSupervisor)
 
 
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,

@@ -25,7 +25,11 @@ and releases the block.  Worker death is detected on queue timeout (the
 reference's SIGCHLD handler analog) and is SELF-HEALING: dead workers
 are respawned in place and their in-flight batches re-enqueued (bounded
 by ``FLAGS_dataloader_batch_retries`` per batch), so a single OOM-killed
-worker costs a recompute, not the epoch.  Restart counts and exit codes
+worker costs a recompute, not the epoch.  Deaths clustering inside
+``FLAGS_dataloader_crashloop_window_s`` respawn with exponential
+backoff, and past ``FLAGS_dataloader_crashloop_budget`` the loader
+raises :class:`WorkerCrashLoop` (exit ledger attached) instead of
+grinding the retry budget down in a tight loop.  Restart counts and exit codes
 surface in ``monitor`` stats (``dataloader.worker_restarts``,
 ``dataloader.batch_retries``) and in the death diagnostic.  The stall
 timeout honors ``DataLoader(timeout=...)`` end-to-end, defaulting to
@@ -51,6 +55,18 @@ from ..testing import fault
 from ..utils import monitor
 
 _live_shm: set = set()
+
+
+class WorkerCrashLoop(RuntimeError):
+    """DataLoader workers are dying faster than respawning can help
+    (``FLAGS_dataloader_crashloop_budget`` deaths inside
+    ``FLAGS_dataloader_crashloop_window_s``).  Carries ``exit_history``
+    — the (worker_id, exit_code) ledger — so the operator sees what
+    kept dying (OOM kills show -9, native crashes show the signal)."""
+
+    def __init__(self, msg: str, exit_history):
+        super().__init__(msg)
+        self.exit_history = list(exit_history)
 
 
 def _cleanup_shm():
@@ -236,6 +252,7 @@ class _WorkerPool:
         self.epoch = 0
         self.restarts = 0
         self.exit_history: List[tuple] = []   # (worker_id, exit_code)
+        self._death_times: List[float] = []   # crash-loop window ledger
         for w in range(loader.num_workers):
             try:
                 self._spawn(w, respawn=False, replace=False)
@@ -277,11 +294,40 @@ class _WorkerPool:
 
     def restart_worker(self, w) -> int:
         """Replace a dead worker — process AND queues (its pipes/locks
-        may be wedged mid-operation); returns its exit code."""
+        may be wedged mid-operation); returns its exit code.
+
+        Respawning is NOT free-running: deaths clustering inside
+        ``FLAGS_dataloader_crashloop_window_s`` back off exponentially
+        (first death respawns immediately — the common single-OOM case
+        stays fast), and one death past
+        ``FLAGS_dataloader_crashloop_budget`` raises
+        :class:`WorkerCrashLoop` with the full exit ledger instead of
+        burning ``FLAGS_dataloader_batch_retries`` in a tight loop."""
         dead = self.workers[w]
         dead.join(timeout=5)
         code = dead.exitcode
         self.exit_history.append((w, code))
+        now = time.monotonic()
+        window = float(_flags.get_flag("dataloader_crashloop_window_s"))
+        self._death_times = [t for t in self._death_times
+                             if now - t <= window] + [now]
+        recent = len(self._death_times)
+        budget = int(_flags.get_flag("dataloader_crashloop_budget"))
+        if recent > budget:
+            raise WorkerCrashLoop(
+                f"DataLoader workers crash-looping: {recent} deaths "
+                f"inside {window:.0f}s (budget {budget}).  Exit history "
+                f"(worker, code): {self.exit_history} — repeated fast "
+                f"deaths point at the dataset/collate_fn or a dying "
+                f"node, not a transient fault; respawning harder "
+                f"cannot fix it.", self.exit_history)
+        if recent > 1:
+            base = float(_flags.get_flag("dataloader_respawn_backoff_s"))
+            cap = float(_flags.get_flag(
+                "dataloader_respawn_backoff_max_s"))
+            delay = min(base * (2 ** (recent - 2)), cap)
+            monitor.stat_add("dataloader.respawn_backoff_s", delay)
+            time.sleep(delay)
         self._spawn(w, respawn=True, replace=True)
         self.restarts += 1
         monitor.stat_add("dataloader.worker_restarts")
@@ -289,7 +335,8 @@ class _WorkerPool:
         trc = obs_hook._tracer
         if trc is not None:
             trc.emit("worker_restart", "dataloader.worker",
-                     args={"worker": w, "exitcode": code})
+                     args={"worker": w, "exitcode": code,
+                           "recent_deaths": recent})
         return code
 
     def drain_worker(self, w, handler):
@@ -433,7 +480,14 @@ class MultiprocessIterator:
             self.pool.drain_worker(w, self._ingest)
             lost = list(self.inflight[w])
             self.inflight[w].clear()
-            self.pool.restart_worker(w)
+            try:
+                self.pool.restart_worker(w)
+            except WorkerCrashLoop:
+                # fast-fail: tear the pool down before surfacing, so
+                # the crash loop doesn't leave zombie workers behind
+                self.pool.close()
+                self.loader._mp_pool = None
+                raise
             if not lost:
                 continue
             # workers run FIFO, so the oldest undelivered batch is the

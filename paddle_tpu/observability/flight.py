@@ -59,10 +59,14 @@ def _dump_exc_info(exc: BaseException) -> dict:
 
 
 def dump_flight(path: Optional[str] = None, reason: str = "manual",
-                exc: Optional[BaseException] = None) -> Optional[str]:
+                exc: Optional[BaseException] = None,
+                extra: Optional[dict] = None) -> Optional[str]:
     """Write one flight dump now; returns the path (None if a dump was
     already in progress on this thread — reentrancy guard for failures
-    inside the dump itself)."""
+    inside the dump itself).  ``extra`` lands verbatim under the
+    payload's ``"extra"`` key — the training supervisor annotates its
+    kill-time dumps with the restart reason, attempt and last observed
+    step this way."""
     st = _state
     if path is None:
         if st is None:
@@ -97,6 +101,8 @@ def dump_flight(path: Optional[str] = None, reason: str = "manual",
                          "unexplained": comp["unexplained"],
                          "by_cause": comp["by_cause"]},
         }
+        if extra is not None:
+            payload["extra"] = extra
         from . import slo as _slo
         if _slo.get_slo_monitor() is not None:
             # last evaluation, not a fresh poll — a dump mid-crash must

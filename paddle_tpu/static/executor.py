@@ -634,6 +634,11 @@ class Executor:
         trc = obs_hook._tracer
         if trc is not None:
             trc.set_step(run_i)
+        # chaos hook: a sleep-action rule here wedges the step mid-run
+        # without raising — the hang (not crash) failure mode the
+        # supervisor's watchdog exists to detect
+        fault.point("executor.step_hang", program._serial,
+                    f"step={run_i}")
 
         plan = self._plan_for(program, params)
         # the Pallas tier state is part of the cache key: flipping
@@ -833,6 +838,15 @@ class Executor:
                       t_h0, t_h1 - t_h0,
                       t_d0, time.perf_counter() - t_d0, fetches,
                       predicted=getattr(compiled, "_predicted", None))
+
+        # supervised training: stamp the liveness heartbeat every step
+        # (one module-attribute None-check when unsupervised).  The beat
+        # carries the compile record's predicted_step_s so the parent's
+        # watchdog can derive its hang deadline from the cost model.
+        hb = obs_hook._heartbeat
+        if hb is not None:
+            hb.beat(run_i, getattr(compiled, "_predicted", None),
+                    fresh_compile=compiled_this_run)
 
         if return_numpy:
             return [np.asarray(f) for f in fetches]

@@ -562,6 +562,19 @@ def generation_main(requests=18, clients=3, verbose=False):
 # Reshard chaos (ISSUE 8): kill mid-run, restore onto a DIFFERENT mesh
 # ---------------------------------------------------------------------------
 
+def _reshard_feed():
+    """The deterministic regression feed every mesh-drill incarnation
+    (reference runs, chaos runs, supervised children — whatever the
+    process) must reconstruct identically, or the loss-parity gates
+    compare divergent trajectories."""
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    xs = rng.standard_normal((64, D)).astype(np.float32)
+    ys = xs @ rng.standard_normal((D, 1)).astype(np.float32)
+    return {"x": xs, "y": ys}
+
+
 def _reshard_build(lr=0.05):
     """One fleet-sharded static training program (the 'unchanged user
     code' both mesh sizes run)."""
@@ -620,10 +633,7 @@ def reshard_main(steps=12, save_every=4, kill_after=6, verbose=False,
 
     own_tmp = workdir is None
     workdir = workdir or tempfile.mkdtemp(prefix="chaos_reshard_")
-    rng = np.random.RandomState(7)
-    xs = rng.standard_normal((64, D)).astype(np.float32)
-    ys = xs @ rng.standard_normal((D, 1)).astype(np.float32)
-    feed = {"x": xs, "y": ys}
+    feed = _reshard_feed()
 
     was_static = paddle.in_static_mode() \
         if hasattr(paddle, "in_static_mode") else False
@@ -717,6 +727,310 @@ def reshard_main(steps=12, save_every=4, kill_after=6, verbose=False,
               f"the step-{saved_at} sharded snapshot onto mesh dp=2 "
               "(bitwise params), loss trajectory matches the "
               "uninterrupted run")
+        return 0
+    finally:
+        if not was_static:
+            paddle.disable_static()
+        import paddle_tpu.static as _st
+        _st.reset_default_programs()
+        if own_tmp:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Supervised self-healing (ISSUE 13): hang -> watchdog kill -> resume;
+# crash -> restart onto a SMALLER mesh via reshard restore
+# ---------------------------------------------------------------------------
+
+def _supervised_entry(workdir, steps, save_every):
+    """The training entrypoint the supervisor keeps alive.  Stateless
+    by design: every incarnation re-detects the visible device count,
+    builds the (unchanged) fleet-sharded program on mesh ``{dp: ndev}``,
+    auto-resumes from the newest intact snapshot through the
+    ShardedState reshard path, and trains with step-cadence snapshots.
+    Faults arrive via ``FLAGS_fault_spec`` in the spawn environment."""
+    import json
+
+    import numpy as np
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.utils.checkpoint import TrainEpochRange
+
+    ndev = len(jax.devices())            # re-detect the visible mesh
+    paddle.enable_static()
+    init_mesh({"dp": ndev})
+    main, loss, exe = _reshard_build()
+    init_mesh({"dp": ndev})
+    feed = _reshard_feed()
+    r = TrainEpochRange(1, f"{workdir}/ckpt", save_every_steps=save_every,
+                        train=exe.sharded_state(main))
+    # the step log is the parent-visible record: resume markers prove
+    # which snapshot (and device count) each incarnation started from,
+    # step lines carry the losses the parity gate checks
+    with open(f"{workdir}/steps.jsonl", "a", buffering=1) as log:
+        for _epoch in r:
+            log.write(json.dumps({"event": "resume",
+                                  "step": r.resume_step,
+                                  "devices": ndev}) + "\n")
+            for step in range(r.resume_step, steps):
+                val = float(exe.run(main, feed=feed,
+                                    fetch_list=[loss])[0])
+                log.write(json.dumps({"step": step, "loss": val,
+                                      "devices": ndev}) + "\n")
+                r.step()
+    exe.close()
+
+
+def _sv_flaky_entry(state_file, failures=2, code=5):
+    """Supervisor test fixture (module-level so spawn children can
+    unpickle it): exit ``code`` for the first ``failures`` incarnations
+    — the counter persists in ``state_file`` — then exit cleanly."""
+    n = 0
+    if os.path.exists(state_file):
+        n = int(open(state_file).read())
+    with open(state_file, "w") as f:
+        f.write(str(n + 1))
+    if n < failures:
+        sys.exit(code)
+
+
+def _sv_slow_start_entry(state_file):
+    """Supervisor test fixture: the first incarnation beats at step
+    scale then crashes; the second stays beat-silent for a while (a
+    restart's recompile wall) before finishing.  The watchdog must
+    judge that quiet start against ``startup_timeout_s``, not the
+    step-scale deadline its retained interval window would give."""
+    import time
+
+    from paddle_tpu.distributed.supervisor import current_heartbeat
+
+    hb = current_heartbeat()
+    if not os.path.exists(state_file):
+        with open(state_file, "w") as f:
+            f.write("1")
+        for i in range(10):
+            hb.beat(i)
+            time.sleep(0.02)
+        sys.exit(3)
+    time.sleep(2.0)                  # 'compiling': no step beats
+    hb.beat(0)
+
+
+def _sv_hang_entry(state_file, beats=6, interval=0.05):
+    """Supervisor test fixture: beat the heartbeat by hand for a while,
+    then wedge (sleep 600s) on the FIRST incarnation; exit cleanly on
+    the second — a hang the watchdog must clear exactly once."""
+    import time
+
+    from paddle_tpu.distributed.supervisor import current_heartbeat
+
+    if os.path.exists(state_file):
+        return
+    with open(state_file, "w") as f:
+        f.write("1")
+    hb = current_heartbeat()
+    for i in range(beats):
+        hb.beat(i)
+        time.sleep(interval)
+    time.sleep(600)
+
+
+def supervise_main(steps=14, save_every=2, hang_after=5, crash_after=4,
+                   verbose=False, workdir=None):
+    """Self-healing training gate; returns 0 on success, 1 on failure.
+
+    One supervised job survives, with zero manual intervention:
+
+    1. an injected mid-step hang (``executor.step_hang`` sleep fault)
+       — the watchdog misses heartbeats, escalates SIGTERM→SIGKILL,
+       and restarts; the job resumes from the latest step-cadence
+       snapshot;
+    2. an injected hard crash (``executor.run`` exit fault) — the
+       restarted incarnation sees only 4 of the original 8 devices and
+       resumes via the SnapshotStore/ShardedState reshard path
+       (mesh 8 → 4 is a restart, not an outage);
+
+    and the assembled per-step loss trajectory matches an
+    uninterrupted fault-free run (rtol 1e-5 — dp reduction order
+    differs across mesh sizes).  The watchdog kill, restart reasons and
+    snapshot fallback must all be visible in ``supervisor.*`` stats,
+    the exit history, and the kill-time flight dump.
+    """
+    import json
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.supervisor import (StepWatchdog,
+                                                   TrainingSupervisor)
+    from paddle_tpu.utils import monitor
+
+    import jax
+    if len(jax.devices()) < 8:
+        print("FAIL: supervise scenario needs 8 devices "
+              "(XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+              file=sys.stderr)
+        return 1
+
+    own_tmp = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_supervise_")
+    was_static = paddle.in_static_mode() \
+        if hasattr(paddle, "in_static_mode") else False
+
+    def child_env(attempt):
+        ndev = 8 if attempt < 2 else 4   # the replacement pod is smaller
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={ndev}",
+            "FLAGS_fault_spec": "",
+        }
+        if attempt == 0:
+            # wedge one step for 600s: only the watchdog can clear it
+            env["FLAGS_fault_spec"] = (
+                f"executor.step_hang:count=1,after={hang_after},"
+                f"action=sleep,secs=600")
+        elif attempt == 1:
+            # hard crash: no boundary save, no SystemExit — just gone
+            env["FLAGS_fault_spec"] = (
+                f"executor.run:count=1,after={crash_after},"
+                f"action=exit,code=7")
+        return env
+
+    try:
+        # -- reference: uninterrupted run on the full mesh ----------------
+        from paddle_tpu.distributed.mesh import init_mesh
+        import numpy as np
+        paddle.enable_static()
+        init_mesh({"dp": 8})
+        main, loss, exe = _reshard_build()
+        init_mesh({"dp": 8})
+        feed = _reshard_feed()
+        ref_losses = [float(exe.run(main, feed=feed,
+                                    fetch_list=[loss])[0])
+                      for _ in range(steps)]
+        exe.close()
+        paddle.static.reset_default_programs()
+        if verbose:
+            print(f"reference (mesh dp=8): {ref_losses}")
+
+        # -- supervised chaos run -----------------------------------------
+        from paddle_tpu.distributed.supervisor import SupervisorGaveUp
+        monitor.stat_reset()
+        sv = TrainingSupervisor(
+            _supervised_entry, args=(workdir, steps, save_every),
+            name="chaos",
+            watchdog=StepWatchdog(multiplier=8.0, min_deadline_s=3.0,
+                                  max_deadline_s=240.0),
+            startup_timeout_s=240.0, hang_grace_s=2.0, poll_s=0.2,
+            backoff_s=0.1, backoff_max_s=1.0,
+            crash_window_s=600.0, crash_budget=4,
+            child_env=child_env, workdir=workdir)
+        try:
+            result = sv.run()
+        except SupervisorGaveUp as e:
+            print(f"FAIL: supervisor gave up instead of self-healing: "
+                  f"{e}", file=sys.stderr)
+            return 1
+
+        problems = []
+        if not result.clean_exit:
+            problems.append("supervised job did not end cleanly")
+        if result.attempts != 3:
+            problems.append(f"expected exactly 3 incarnations "
+                            f"(hang, crash, finish), got "
+                            f"{result.attempts}")
+        reasons = [r["reason"] for r in result.exit_history]
+        if not reasons or reasons[0] != "hang":
+            problems.append(f"first restart reason {reasons[:1]} != "
+                            f"'hang' (watchdog kill)")
+        if len(reasons) < 2 or "crash(exit=7)" not in reasons[1]:
+            problems.append(f"second restart reason {reasons[1:2]} != "
+                            f"crash(exit=7)")
+
+        # supervisor decisions must be observable in monitor stats
+        stats = monitor.all_stats()
+        if stats.get("supervisor.hang_kills", 0) < 1:
+            problems.append("supervisor.hang_kills stat missing")
+        if stats.get("supervisor.restarts", 0) != 2:
+            problems.append(f"supervisor.restarts="
+                            f"{stats.get('supervisor.restarts', 0)}, "
+                            f"expected 2")
+        if stats.get("supervisor.starts", 0) != 3:
+            problems.append(f"supervisor.starts="
+                            f"{stats.get('supervisor.starts', 0)}, "
+                            f"expected 3")
+
+        # the kill-time flight dump names the restart reason
+        kill_dump = os.path.join(workdir, "supervisor_kill_a0.json")
+        if not os.path.exists(kill_dump):
+            problems.append("watchdog kill left no flight dump")
+        else:
+            with open(kill_dump) as f:
+                box = json.load(f)
+            if box.get("reason") != "supervisor.hang":
+                problems.append(f"flight dump reason "
+                                f"{box.get('reason')!r} != "
+                                f"'supervisor.hang'")
+            extra = box.get("extra") or {}
+            if extra.get("restart_reason") != "hang" \
+                    or extra.get("attempt") != 0:
+                problems.append("flight dump extra lacks the annotated "
+                                "restart reason/attempt")
+
+        # the step log proves the resume path: three incarnations, the
+        # last one on 4 devices resuming from a NONZERO snapshot step
+        resumes, rows = [], {}
+        with open(os.path.join(workdir, "steps.jsonl")) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("event") == "resume":
+                    resumes.append(rec)
+                else:
+                    rows[rec["step"]] = rec   # last write wins
+        if len(resumes) != 3:
+            problems.append(f"expected 3 resume markers, got "
+                            f"{len(resumes)}: {resumes}")
+        else:
+            if resumes[0]["step"] != 0 or resumes[0]["devices"] != 8:
+                problems.append(f"first incarnation should start fresh "
+                                f"on 8 devices: {resumes[0]}")
+            if resumes[1]["devices"] != 8 or resumes[1]["step"] <= 0:
+                problems.append(f"post-hang incarnation should resume "
+                                f"a step snapshot on 8 devices: "
+                                f"{resumes[1]}")
+            if resumes[2]["devices"] != 4 or resumes[2]["step"] \
+                    <= resumes[1]["step"]:
+                problems.append(f"post-crash incarnation should "
+                                f"reshard-resume on 4 devices past the "
+                                f"previous snapshot: {resumes[2]}")
+        if verbose:
+            print(f"resumes: {resumes}")
+            print(f"exit history: {result.exit_history}")
+
+        # loss-trajectory parity with the fault-free run
+        missing = [s for s in range(steps) if s not in rows]
+        if missing:
+            problems.append(f"steps never completed: {missing}")
+        else:
+            got = [rows[s]["loss"] for s in range(steps)]
+            try:
+                np.testing.assert_allclose(got, ref_losses, rtol=1e-5)
+            except AssertionError as e:
+                problems.append(
+                    f"supervised loss trajectory diverged from the "
+                    f"fault-free run: {e}")
+            if verbose:
+                print(f"supervised:  {got}")
+
+        if problems:
+            for p in problems:
+                print(f"FAIL: {p}", file=sys.stderr)
+            return 1
+        print("chaos supervise OK: injected hang watchdog-killed "
+              "(SIGTERM->SIGKILL) and resumed from a step snapshot; "
+              "injected crash restarted onto mesh dp=4 via reshard "
+              "restore; loss trajectory matches the fault-free run "
+              "with zero manual intervention")
         return 0
     finally:
         if not was_static:

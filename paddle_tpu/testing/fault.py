@@ -24,9 +24,13 @@ mp.worker_batch:count=1,action=exit,code=43"
   default unlimited), ``after`` (skip the first N matching hits),
   ``exc`` (exception class name, default :class:`FaultInjected`),
   ``msg`` (message override), ``match`` (substring that must appear in
-  the point's detail args), ``action`` (``raise`` | ``exit``), ``code``
-  (exit status for ``action=exit``), ``respawn`` (1 = keep the rule
-  armed in *respawned* DataLoader workers; default 0 = kill-once).
+  the point's detail args), ``action`` (``raise`` | ``exit`` |
+  ``sleep``), ``code`` (exit status for ``action=exit``), ``secs``
+  (wedge duration for ``action=sleep`` — the point blocks in
+  ``time.sleep`` and then *returns*, so a short ``secs`` is a latency
+  injection and a long one is a real hang only a supervisor's watchdog
+  can clear), ``respawn`` (1 = keep the rule armed in *respawned*
+  DataLoader workers; default 0 = kill-once).
 
 * The RNG driving ``p`` is seeded (``seed=`` / ``FLAGS_fault_seed``) so
   a chaos run replays exactly.
@@ -86,8 +90,9 @@ class Rule:
     exc: Union[str, type] = "FaultInjected"
     msg: str = ""
     match: str = ""                  # substring required in detail args
-    action: str = "raise"            # raise | exit
+    action: str = "raise"            # raise | exit | sleep
     code: int = 43                   # exit status for action=exit
+    secs: float = 60.0               # wedge duration for action=sleep
     respawn: bool = False            # survive into respawned workers
     hits: int = field(default=0, compare=False)
     fires: int = field(default=0, compare=False)
@@ -112,6 +117,8 @@ class Rule:
             kv.append(f"action={self.action}")
         if self.code != 43:
             kv.append(f"code={self.code}")
+        if self.secs != 60.0:
+            kv.append(f"secs={self.secs}")
         if self.respawn:
             kv.append("respawn=1")
         return self.pattern + (":" + ",".join(kv) if kv else "")
@@ -134,6 +141,8 @@ def parse_spec(spec: str) -> List[Rule]:
                 v = v.strip()
                 if k == "p":
                     kw["prob"] = float(v)
+                elif k == "secs":
+                    kw["secs"] = float(v)
                 elif k in ("count", "after", "code"):
                     kw[k] = int(v)
                 elif k == "respawn":
@@ -242,6 +251,14 @@ def _hit(name: str, detail: Tuple) -> None:
                           if detail else ""))
     if rule.action == "exit":
         os._exit(rule.code)
+    if rule.action == "sleep":
+        # a real wedge: the calling thread blocks right here.  SIGTERM
+        # handlers run but the sleep resumes (PEP 475), so only SIGKILL
+        # — or the sleep expiring — unwedges the process, which is
+        # exactly the failure mode a hang watchdog exists to detect.
+        import time
+        time.sleep(rule.secs)
+        return
     raise _resolve_exc(rule.exc)(msg)
 
 

@@ -21,6 +21,18 @@ notice) requests a save at the next epoch boundary, publishes it, and
 exits cleanly — ``tools/chaos_smoke.py`` proves the round trip.
 Recovery events surface in ``monitor`` stats (``checkpoint.saves``,
 ``checkpoint.fallbacks``, ``checkpoint.preempt_saves``).
+
+Step-cadence tier (this is what makes supervised restarts cheap enough
+to be routine — ``distributed/supervisor.py``): ``TrainEpochRange``
+grows ``save_every_steps`` / ``save_every_s``; the training loop calls
+:meth:`TrainEpochRange.step` once per step, and due snapshots are
+*captured* on the step thread (state serialization — consistent even
+under the donated Executor, whose buffers the next step invalidates)
+but *published* (digests, atomic writes, meta) on a background thread,
+so the step loop never waits on the checkpoint store.  With a cadence
+configured, SIGTERM saves at the next **step** boundary, not epoch.
+Step snapshots ride the same digest-verified meta (``kind: "step"``);
+restore resumes mid-epoch and reports ``resume_step``.
 """
 from __future__ import annotations
 
@@ -28,6 +40,7 @@ import hashlib
 import json
 import signal
 import threading
+import time
 import warnings
 from typing import Dict, Iterator, List, Optional
 
@@ -99,6 +112,18 @@ class SnapshotStore:
         self.verify = verify
         self._fs = _fsmod.get_fs(directory)
         self._fs.mkdir(directory)
+        # the snapshot applied by the last restore() (meta entry dict),
+        # or None — step-cadence resume reads its "step" from here
+        self.last_restored: Optional[dict] = None
+        # background publisher (save_async): captured payloads queue
+        # here; ONE thread does digests + atomic writes + meta publish,
+        # so publish order — and therefore meta monotonicity — is the
+        # enqueue order
+        self._pub_cv = threading.Condition()
+        self._pub_queue = None
+        self._pub_thread: Optional[threading.Thread] = None
+        self._pub_pending = 0
+        self._pub_error: Optional[BaseException] = None
 
     def _join(self, *parts) -> str:
         return "/".join([self.dir.rstrip("/")] + list(parts))
@@ -120,12 +145,13 @@ class SnapshotStore:
         return meta
 
     # -- save --------------------------------------------------------------
-    def save(self, epoch: int, objects: Dict[str, object]) -> None:
-        fault.point("ckpt.save", self.dir, epoch)
-        snap = f"epoch_{epoch}"
-        sdir = self._join(snap)
-        self._fs.mkdir(sdir)
-        digests = {}
+    def _encode(self, objects: Dict[str, object]) -> Dict[str, bytes]:
+        """Capture every object's state as bytes — the *consistency*
+        half of a save.  Runs on the caller's thread: under the donated
+        Executor a later step invalidates the buffers a state_dict
+        refers to, so the capture cannot be deferred (the publish
+        can)."""
+        files: Dict[str, bytes] = {}
         for name, obj in objects.items():
             if hasattr(obj, "shard_state"):
                 # sharded protocol (distributed/sharding.ShardedState):
@@ -133,25 +159,38 @@ class SnapshotStore:
                 # — every file gets its own digest, so a single corrupt
                 # shard is caught without touching the others
                 manifest, payloads = obj.shard_state()
-                files = {f"{name}.manifest.json": json.dumps(
-                    manifest).encode("utf-8")}
+                files[f"{name}.manifest.json"] = json.dumps(
+                    manifest).encode("utf-8")
                 for fname, data in payloads.items():
                     files[f"{name}.{fname}"] = data
-                for fname, data in files.items():
-                    digests[fname] = hashlib.sha256(data).hexdigest()
-                    _fsmod.write_atomic(f"{sdir}/{fname}", data)
                 continue
-            payload = _dumps(obj.state_dict())
-            digests[f"{name}.pdparams"] = hashlib.sha256(
-                payload).hexdigest()
-            _fsmod.write_atomic(f"{sdir}/{name}.pdparams", payload)
+            files[f"{name}.pdparams"] = _dumps(obj.state_dict())
+        return files
+
+    def _publish(self, epoch: int, files: Dict[str, bytes],
+                 object_names: List[str], step: Optional[int] = None,
+                 kind: str = "epoch") -> None:
+        """Write payloads + digests and atomically publish the meta —
+        the *durability* half of a save."""
+        snap = f"step_{step}" if kind == "step" else f"epoch_{epoch}"
+        sdir = self._join(snap)
+        self._fs.mkdir(sdir)
+        digests = {}
+        for fname, data in files.items():
+            digests[fname] = hashlib.sha256(data).hexdigest()
+            _fsmod.write_atomic(f"{sdir}/{fname}", data)
         meta = self.load_meta() or {"snapshots": []}
         snaps = [s for s in meta["snapshots"] if s.get("dir") != snap]
-        snaps.append({"epoch": int(epoch), "dir": snap,
-                      "digests": digests})
+        entry = {"epoch": int(epoch), "dir": snap, "digests": digests,
+                 "kind": kind}
+        if step is not None:
+            entry["step"] = int(step)
+        snaps.append(entry)
         snaps = snaps[-self.keep_max:]
-        meta = {"finished_epoch": int(epoch), "snapshot": snap,
-                "objects": sorted(objects), "snapshots": snaps}
+        # a step snapshot mid-epoch E means E is NOT finished
+        finished = int(epoch) if kind == "epoch" else int(epoch) - 1
+        meta = {"finished_epoch": finished, "snapshot": snap,
+                "objects": sorted(object_names), "snapshots": snaps}
         fault.point("ckpt.publish", self.dir, epoch)
         _fsmod.write_atomic(self._meta_path(),
                             json.dumps(meta).encode("utf-8"))
@@ -159,14 +198,78 @@ class SnapshotStore:
         trc = _obs_hook._tracer
         if trc is not None:
             trc.emit("checkpoint", "save",
-                     args={"epoch": int(epoch), "dir": self.dir})
+                     args={"epoch": int(epoch), "step": step,
+                           "kind": kind, "dir": self.dir})
         keep = {s["dir"] for s in snaps}
         for d in self._fs.list(self.dir):
-            if d.startswith("epoch_") and d not in keep:
+            if (d.startswith("epoch_") or d.startswith("step_")) \
+                    and d not in keep:
                 try:
                     self._fs.remove(self._join(d))
                 except (RuntimeError, OSError):
                     pass  # prune is best-effort (shared dirs, perms)
+
+    def save(self, epoch: int, objects: Dict[str, object],
+             step: Optional[int] = None, kind: str = "epoch") -> None:
+        """Synchronous save: capture + publish on this thread.  Flushes
+        any queued background publishes first so the meta never moves
+        backwards past an already-captured snapshot."""
+        fault.point("ckpt.save", self.dir, epoch)
+        self.flush()
+        self._publish(epoch, self._encode(objects), sorted(objects),
+                      step=step, kind=kind)
+
+    # -- background publish ------------------------------------------------
+    def save_async(self, epoch: int, objects: Dict[str, object],
+                   step: Optional[int] = None,
+                   kind: str = "step") -> None:
+        """Capture now (caller thread), publish on the store's
+        background thread.  Failures are warned + counted
+        (``checkpoint.async_errors``) rather than raised into the step
+        loop; :meth:`flush` at sync points surfaces durability."""
+        fault.point("ckpt.save", self.dir, epoch)
+        job = {"epoch": int(epoch), "files": self._encode(objects),
+               "object_names": sorted(objects), "step": step,
+               "kind": kind}
+        with self._pub_cv:
+            if self._pub_thread is None or not self._pub_thread.is_alive():
+                import queue
+                self._pub_queue = queue.SimpleQueue()
+                self._pub_thread = threading.Thread(
+                    target=self._publish_loop, name="snapshot-publisher",
+                    daemon=True)
+                self._pub_thread.start()
+            self._pub_pending += 1
+        self._pub_queue.put(job)
+        monitor.stat_add("checkpoint.async_saves")
+
+    def _publish_loop(self) -> None:
+        while True:
+            job = self._pub_queue.get()
+            if job is None:
+                return
+            try:
+                self._publish(**job)
+            except BaseException as e:  # noqa: BLE001 - kept, not raised
+                with self._pub_cv:
+                    self._pub_error = e
+                monitor.stat_add("checkpoint.async_errors")
+                warnings.warn(
+                    f"checkpoint: background publish of "
+                    f"{job.get('kind')} snapshot (epoch {job.get('epoch')}"
+                    f", step {job.get('step')}) failed: {e}")
+            finally:
+                with self._pub_cv:
+                    self._pub_pending -= 1
+                    self._pub_cv.notify_all()
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every queued background publish has landed.
+        Returns False on timeout.  A failed publish was already warned;
+        the next *sync* save surfaces a persistently broken store."""
+        with self._pub_cv:
+            return self._pub_cv.wait_for(
+                lambda: self._pub_pending == 0, timeout)
 
     # -- restore -----------------------------------------------------------
     def _read_file_verified(self, snap: dict, fname: str,
@@ -241,9 +344,12 @@ class SnapshotStore:
 
     def restore(self, objects: Dict[str, object]) -> int:
         """Load the newest intact snapshot into ``objects`` and return
-        the next epoch to run.  Falls back across the retained history;
-        raises :class:`CheckpointError` when a checkpoint exists but no
+        the next epoch to run (for a mid-epoch *step* snapshot: the
+        epoch to re-enter — its ``step`` is on :attr:`last_restored`).
+        Falls back across the retained history; raises
+        :class:`CheckpointError` when a checkpoint exists but no
         snapshot verifies — never resumes half-initialized."""
+        self.last_restored = None
         meta = self.load_meta()
         if meta is None:
             return 0
@@ -292,7 +398,11 @@ class SnapshotStore:
                 trc.emit("checkpoint", "restore",
                          args={"epoch": int(snap["epoch"]),
                                "snapshot": str(snap["dir"]),
+                               "step": snap.get("step"),
                                "fell_back_past": attempts})
+            self.last_restored = dict(snap)
+            if snap.get("kind") == "step":
+                return int(snap["epoch"])       # re-enter mid-epoch
             return int(snap["epoch"]) + 1
         raise CheckpointError(
             f"checkpoint dir '{self.dir}' has a published meta but no "
@@ -314,23 +424,45 @@ class TrainEpochRange:
     falls back across them.  While iterating (main thread), SIGTERM —
     the cloud-TPU preemption notice — requests a snapshot at the next
     epoch boundary, publishes it, then exits via ``SystemExit(0)``
-    (disable with ``handle_preemption=False``)."""
+    (disable with ``handle_preemption=False``).
+
+    Step cadence: with ``save_every_steps`` and/or ``save_every_s``
+    set, call :meth:`step` once per training step.  Due snapshots are
+    captured on the step thread and published on the store's
+    background thread (``async_publish=False`` keeps them fully
+    synchronous); a pending SIGTERM then saves at the next **step**
+    boundary instead of waiting for the epoch to end.  After a
+    restart, :attr:`resume_step` is the global step to continue from
+    (the restored snapshot's step count)."""
 
     def __init__(self, max_epoch_num: int, checkpoint_dir: str,
                  save_checkpoint_inter: int = 1,
                  keep_checkpoint_max: Optional[int] = None,
                  verify: bool = True, handle_preemption: bool = True,
+                 save_every_steps: Optional[int] = None,
+                 save_every_s: Optional[float] = None,
+                 async_publish: bool = True,
                  **objects):
         self.max_epoch = int(max_epoch_num)
         self.dir = checkpoint_dir
         self.interval = max(1, int(save_checkpoint_inter))
         self.handle_preemption = handle_preemption
+        self.save_every_steps = (None if save_every_steps is None
+                                 else max(1, int(save_every_steps)))
+        self.save_every_s = (None if save_every_s is None
+                             else float(save_every_s))
+        self.async_publish = async_publish
         self._objects: Dict[str, object] = dict(objects)
         self._store = SnapshotStore(checkpoint_dir,
                                     keep_max=keep_checkpoint_max,
                                     verify=verify)
         self._fs = self._store._fs
         self._preempted = threading.Event()
+        self._global_step = 0
+        self._resume_step = 0
+        self._cur_epoch = 0
+        self._last_save_step = 0
+        self._last_save_t = time.monotonic()
 
     def register(self, name: str, obj):
         """Add a state_dict-bearing object to the snapshot set."""
@@ -340,12 +472,62 @@ class TrainEpochRange:
     # -- persistence -------------------------------------------------------
     def _save(self, epoch: int):
         self._store.save(epoch, self._objects)
+        self._last_save_step = self._global_step
+        self._last_save_t = time.monotonic()
 
     def _restore(self) -> int:
-        return self._store.restore(self._objects)
+        start = self._store.restore(self._objects)
+        snap = self._store.last_restored or {}
+        self._global_step = self._resume_step = int(snap.get("step") or 0)
+        self._last_save_step = self._global_step
+        self._last_save_t = time.monotonic()
+        return start
 
     def _load_meta(self) -> Optional[dict]:
         return self._store.load_meta()
+
+    # -- step cadence ------------------------------------------------------
+    @property
+    def resume_step(self) -> int:
+        """Global step to continue from after restore (0 = fresh)."""
+        return self._resume_step
+
+    @property
+    def global_step(self) -> int:
+        return self._global_step
+
+    def _save_step_snapshot(self, sync: bool) -> None:
+        if sync:
+            self._store.save(self._cur_epoch, self._objects,
+                             step=self._global_step, kind="step")
+        else:
+            self._store.save_async(self._cur_epoch, self._objects,
+                                   step=self._global_step, kind="step")
+        self._last_save_step = self._global_step
+        self._last_save_t = time.monotonic()
+        monitor.stat_add("checkpoint.step_saves")
+
+    def step(self) -> int:
+        """Mark one training step complete; returns the global step.
+
+        Drives the step-cadence snapshots and — when a SIGTERM arrived
+        — the step-boundary preemption save (synchronous publish, then
+        ``SystemExit(0)``), so a preempted or supervisor-killed run
+        loses at most the in-flight step instead of the epoch."""
+        self._global_step += 1
+        if self.handle_preemption and self._preempted.is_set():
+            self._save_step_snapshot(sync=True)
+            monitor.stat_add("checkpoint.preempt_saves")
+            raise SystemExit(0)
+        due = (self.save_every_steps is not None
+               and self._global_step - self._last_save_step
+               >= self.save_every_steps)
+        if not due and self.save_every_s is not None:
+            due = (time.monotonic() - self._last_save_t
+                   >= self.save_every_s)
+        if due:
+            self._save_step_snapshot(sync=not self.async_publish)
+        return self._global_step
 
     # -- preemption --------------------------------------------------------
     @property
@@ -368,6 +550,7 @@ class TrainEpochRange:
                            if self.handle_preemption else None)
         try:
             for epoch in range(start, self.max_epoch):
+                self._cur_epoch = epoch
                 yield epoch
                 # body finished without raising: snapshot this epoch
                 if (self._preempted.is_set()
@@ -378,6 +561,10 @@ class TrainEpochRange:
                     monitor.stat_add("checkpoint.preempt_saves")
                     raise SystemExit(0)
         finally:
+            # queued background publishes land before the loop returns
+            # (or unwinds) — a completed range never leaves a captured
+            # snapshot unpublished
+            self._store.flush()
             if restore_handler is not None:
                 restore_handler()
 

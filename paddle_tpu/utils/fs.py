@@ -57,6 +57,14 @@ _TRANSIENT_ERRNOS = frozenset({
     errno.EPIPE, errno.EIO,
 })
 
+# Errnos no amount of backoff can fix: a full disk, a read-only mount,
+# a blown quota.  These fail FAST as PermanentFSError — spending the
+# whole FLAGS_fs_retry_deadline_s on them just delays the operator
+# learning the volume is full.
+_PERMANENT_ERRNOS = frozenset({
+    errno.ENOSPC, errno.EROFS, errno.EDQUOT,
+})
+
 # Substrings of hadoop-CLI stderr that mark a retryable condition
 # (connection issues, HDFS safe mode, throttling) vs a semantic failure.
 _TRANSIENT_MARKERS = (
@@ -71,6 +79,8 @@ _PERMANENT_MARKERS = (
     "no such file", "file exists", "permission denied", "access denied",
     "is a directory", "not a directory", "invalid argument",
     "unsupported", "illegalargument", "filenotfound",
+    "no space left", "disk quota exceeded", "quota exceeded",
+    "read-only file system", "read only file system",
 )
 
 
@@ -87,6 +97,8 @@ def is_transient(exc: BaseException) -> bool:
     if isinstance(exc, (TimeoutError, ConnectionError)):
         return True
     if isinstance(exc, OSError):
+        if exc.errno in _PERMANENT_ERRNOS:     # disk full / read-only
+            return False
         return exc.errno in _TRANSIENT_ERRNOS
     return False
 
@@ -114,6 +126,17 @@ def retry_call(op_name: str, fn, *args, **kwargs):
         except BaseException as e:
             attempt += 1
             if not is_transient(e):
+                if isinstance(e, OSError) and not isinstance(e, FSError) \
+                        and e.errno in _PERMANENT_ERRNOS:
+                    # surface the classification: callers (and the
+                    # monitor) see an explicit PermanentFSError, not a
+                    # bare OSError they might be tempted to retry
+                    monitor.stat_add("fs.permanent")
+                    monitor.stat_add(f"fs.permanent.{op_name}")
+                    raise PermanentFSError(
+                        f"fs.{op_name}: unrecoverable "
+                        f"({errno.errorcode.get(e.errno, e.errno)}): {e}"
+                    ) from e
                 raise
             elapsed = time.monotonic() - start
             if attempt >= times or elapsed >= deadline:

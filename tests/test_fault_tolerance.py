@@ -630,3 +630,358 @@ def test_clean_run_has_no_fault_or_recovery_noise(tmp_path):
 def test_chaos_smoke_in_process(tmp_path):
     from paddle_tpu.testing import chaos
     assert chaos.main(epochs=3, workdir=str(tmp_path / "smoke")) == 0
+
+
+# -- ISSUE 13: self-healing training ----------------------------------------
+# sleep faults, permanent-errno fast fail, dataloader crash-loop budget,
+# step-cadence snapshots, heartbeat/watchdog, TrainingSupervisor.
+
+def test_sleep_fault_action_wedges_then_returns():
+    t0 = time.monotonic()
+    with fault.inject("slow.point:action=sleep,secs=0.15,count=1"):
+        fault.point("slow.point")            # wedges ~0.15s, returns
+        fault.point("slow.point")            # count exhausted: instant
+    assert time.monotonic() - t0 >= 0.15
+    assert monitor.get_stat("fault.fired.slow.point") == 1
+    r = fault.parse_spec("x.y:action=sleep,secs=2.5")[0]
+    assert r.action == "sleep" and r.secs == 2.5
+    assert "secs=2.5" in r.to_spec()         # survives child re-arming
+
+
+def test_enospc_erofs_fail_fast_as_permanent():
+    import errno as _errno
+    for eno in (_errno.ENOSPC, _errno.EROFS, _errno.EDQUOT):
+        assert not fs.is_transient(OSError(eno, os.strerror(eno)))
+    calls = []
+
+    def nospace():
+        calls.append(1)
+        raise OSError(_errno.ENOSPC, "No space left on device")
+
+    with pytest.raises(fs.PermanentFSError, match="ENOSPC"):
+        fs.retry_call("open_write", nospace)
+    assert len(calls) == 1                   # zero retries burned
+    assert monitor.get_stat("fs.retries") == 0
+    assert monitor.get_stat("fs.permanent") == 1
+    # ShellFS stderr classification: a full/read-only store is semantic
+    from paddle_tpu.utils.fs import (_PERMANENT_MARKERS)
+    assert any(m in "no space left on device" for m in _PERMANENT_MARKERS)
+    assert any(m in "read-only file system" for m in _PERMANENT_MARKERS)
+
+
+def test_dataloader_crash_loop_gives_up_with_exit_history():
+    from paddle_tpu.io.multiprocess import WorkerCrashLoop
+    from paddle_tpu.testing.chaos import SmokeDataset
+    old = paddle.get_flags(["dataloader_crashloop_budget",
+                            "dataloader_respawn_backoff_s"])
+    paddle.set_flags({"dataloader_crashloop_budget": 2,
+                      "dataloader_respawn_backoff_s": 0.01,
+                      "dataloader_batch_retries": 50})
+    loader = DataLoader(SmokeDataset(), batch_size=8, shuffle=False,
+                        num_workers=2)
+    # respawn=1: replacements die too — a poisoned dataset, not a flake
+    fault.arm("mp.worker_batch:action=exit,code=9,respawn=1")
+    try:
+        with pytest.raises(WorkerCrashLoop, match="crash-looping") as ei:
+            for _ in loader:
+                pass
+        # the ledger names what kept dying, bounded by the budget
+        assert len(ei.value.exit_history) >= 3
+        assert monitor.get_stat("dataloader.worker_restarts") <= 2
+    finally:
+        fault.disarm()
+        paddle.set_flags(old)
+        pool = getattr(loader, "_mp_pool", None)
+        if pool is not None:
+            pool.close()
+            loader._mp_pool = None
+
+
+def _cadence_build(seed=1234):
+    paddle.seed(seed)
+    net = nn.Linear(4, 1)
+    opt = optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+    return net, opt
+
+
+_CAD_X = np.random.RandomState(7).randn(32, 4).astype(np.float32)
+_CAD_Y = _CAD_X @ np.random.RandomState(8).randn(4, 1).astype(np.float32)
+
+
+def _cadence_step(net, opt):
+    import paddle_tpu.nn.functional as F
+    loss = F.mse_loss(net(_CAD_X), _CAD_Y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+
+
+def test_step_cadence_snapshots_resume_mid_epoch(tmp_path):
+    d = str(tmp_path / "acp")
+    net, opt = _cadence_build()
+    r = TrainEpochRange(1, d, save_every_steps=3, model=net, opt=opt)
+    weights = {}
+    for _epoch in r:
+        for _ in range(10):
+            _cadence_step(net, opt)
+            g = r.step()
+            weights[g] = net.weight.numpy().copy()
+            if g == 7:
+                break                        # simulated crash mid-epoch
+        break                                # (no epoch-boundary save)
+    # saves happened at the cadence, published in the background
+    assert monitor.get_stat("checkpoint.step_saves") == 2     # 3 and 6
+    assert monitor.get_stat("checkpoint.async_saves") == 2
+    # the meta records step snapshots with digests, newest = step 6
+    meta = SnapshotStore(d).load_meta()
+    assert meta["snapshots"][-1]["kind"] == "step"
+    assert meta["snapshots"][-1]["step"] == 6
+    assert meta["snapshots"][-1]["digests"]
+
+    net2, opt2 = _cadence_build(99)
+    r2 = TrainEpochRange(1, d, save_every_steps=3, model=net2, opt=opt2)
+    it = iter(r2)
+    assert next(it) == 0                     # mid-epoch: re-enter epoch 0
+    assert r2.resume_step == 6
+    np.testing.assert_array_equal(net2.weight.numpy(), weights[6])
+    # resumed training from step 6 reproduces the original trajectory
+    for g in range(r2.resume_step, 10):
+        _cadence_step(net2, opt2)
+        r2.step()
+        if g + 1 in weights:
+            np.testing.assert_array_equal(net2.weight.numpy(),
+                                          weights[g + 1])
+    it.close()
+
+
+def test_sigterm_saves_at_step_boundary_not_epoch(tmp_path):
+    d = str(tmp_path / "acp")
+    net, opt = _cadence_build()
+    r = TrainEpochRange(4, d, save_every_steps=100, model=net, opt=opt)
+    with pytest.raises(SystemExit) as ei:
+        for _epoch in r:
+            for i in range(10):
+                _cadence_step(net, opt)
+                if i == 4:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                r.step()                     # <- saves HERE, exits 0
+            pytest.fail("step() should have exited at the boundary")
+    assert ei.value.code == 0 and r.preempted
+    assert monitor.get_stat("checkpoint.preempt_saves") == 1
+    w_saved = net.weight.numpy().copy()
+
+    last = SnapshotStore(d).load_meta()["snapshots"][-1]
+    assert last["kind"] == "step" and last["step"] == 5
+
+    net2, opt2 = _cadence_build(99)
+    r2 = TrainEpochRange(4, d, model=net2, opt=opt2)
+    it = iter(r2)
+    assert next(it) == 0 and r2.resume_step == 5
+    np.testing.assert_array_equal(net2.weight.numpy(), w_saved)
+    it.close()
+
+
+def test_async_publish_failure_warns_and_keeps_older_snapshot(tmp_path):
+    d = str(tmp_path / "store")
+    net, opt = _cadence_build()
+    store = SnapshotStore(d)
+    store.save(0, {"model": net})            # intact epoch snapshot
+    w0 = net.weight.numpy().copy()
+    net.weight.data = net.weight.data + 1.0
+    with fault.inject(
+            "fs.open_write:count=1,exc=PermanentFSError,match=step_7"):
+        import warnings as _w
+        with _w.catch_warnings(record=True):
+            _w.simplefilter("always")
+            store.save_async(0, {"model": net}, step=7)
+            assert store.flush(timeout=10)
+    # the failed publish is counted, not raised into the step loop
+    assert monitor.get_stat("checkpoint.async_errors") == 1
+    # and the store still restores the older intact snapshot
+    net2, _ = _cadence_build(99)
+    assert store.restore({"model": net2}) == 1
+    assert store.last_restored["dir"] == "epoch_0"
+    np.testing.assert_array_equal(net2.weight.numpy(), w0)
+
+
+def test_heartbeat_roundtrip_and_torn_write_guard(tmp_path):
+    from paddle_tpu.distributed.supervisor import (HeartbeatReader,
+                                                   HeartbeatWriter)
+    p = str(tmp_path / "hb")
+    w = HeartbeatWriter(p)
+    rd = HeartbeatReader(p)
+    assert HeartbeatReader(str(tmp_path / "missing")).read() is None
+    w.beat(-1)
+    hb = rd.read()
+    assert hb.step == -1 and hb.interval_s == 0.0
+    w.beat(1, {"predicted_step_s": 0.25})
+    time.sleep(0.02)
+    w.beat(2, {"predicted_step_s": 0.25})
+    hb = rd.read()
+    assert hb.step == 2 and hb.predicted_step_s == 0.25
+    assert 0.0 < hb.interval_s < 5.0
+    # a compile run's interval is excluded (marked unknown)
+    w.beat(3, fresh_compile=True)
+    assert rd.read().interval_s == 0.0
+    # torn/garbage record: reader returns None instead of nonsense
+    with open(p, "r+b") as f:
+        f.write(b"\xff" * 17)
+    assert rd.read() is None
+    w.close()
+    rd.close()
+
+
+def test_watchdog_deadline_predicted_drift_and_p99_fallback():
+    from paddle_tpu.distributed.supervisor import Heartbeat, StepWatchdog
+
+    def hb(step, pred, interval):
+        return Heartbeat(time.time(), step, pred, interval)
+
+    # predicted path, no drift: deadline = predicted * multiplier
+    wd = StepWatchdog(multiplier=10.0, min_deadline_s=0.001,
+                      max_deadline_s=1000.0, drift_cap=4.0)
+    wd.observe(hb(1, 0.5, 0.5))
+    assert wd.deadline_s() == pytest.approx(0.5 * 1.0 * 10.0)
+    # observed steps 3x slower than priced: drift widens the deadline
+    for i in range(2, 12):
+        wd.observe(hb(i, 0.5, 1.5))
+    assert wd.drift() == pytest.approx(3.0)
+    assert wd.deadline_s() == pytest.approx(0.5 * 3.0 * 10.0)
+    # drift clamps at the cap — a wildly slow run is a hang, not drift
+    for i in range(12, 40):
+        wd.observe(hb(i, 0.5, 50.0))
+    assert wd.drift() == 4.0
+
+    # no prediction: rolling p99 of observed intervals * multiplier
+    wd = StepWatchdog(multiplier=4.0, min_deadline_s=0.001,
+                      max_deadline_s=1000.0)
+    # nearest-rank p99 over 100 samples = the 99th smallest
+    for i, dt in enumerate([0.1] * 98 + [0.3] * 2):
+        wd.observe(hb(i, None, dt))
+    assert wd.deadline_s() == pytest.approx(0.3 * 4.0)
+    # duplicate reads of one step don't pollute the window
+    n = len(wd._intervals)
+    wd.observe(hb(99, None, 0.3))
+    assert len(wd._intervals) == n
+
+    # nothing known yet: the (clamped) max budget covers first compile
+    wd = StepWatchdog(min_deadline_s=1.0, max_deadline_s=30.0)
+    assert wd.deadline_s() == 30.0
+    # clamping floors a micro-second prediction at min_deadline_s
+    wd = StepWatchdog(multiplier=8.0, min_deadline_s=5.0)
+    wd.observe(hb(1, 1e-6, 1e-6))
+    assert wd.deadline_s() == 5.0
+    with pytest.raises(ValueError):
+        StepWatchdog(min_deadline_s=2.0, max_deadline_s=1.0)
+
+
+def test_executor_stamps_heartbeat_per_step(tmp_path):
+    from paddle_tpu.core import obs_hook
+    from paddle_tpu.distributed.supervisor import (HeartbeatReader,
+                                                   HeartbeatWriter)
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = paddle.static.nn.fc(x, 2)
+        exe = paddle.static.Executor()
+        w = HeartbeatWriter(str(tmp_path / "hb"))
+        obs_hook.set_heartbeat(w)
+        try:
+            feed = {"x": np.zeros((2, 4), np.float32)}
+            for _ in range(3):
+                exe.run(main, feed=feed, fetch_list=[y])
+            hb = HeartbeatReader(str(tmp_path / "hb")).read()
+            assert hb is not None and hb.step == 3
+            assert hb.interval_s > 0.0       # post-compile steps measure
+        finally:
+            obs_hook.set_heartbeat(None)
+            exe.close()
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_supervisor_restarts_until_clean_exit(tmp_path):
+    from paddle_tpu.distributed.supervisor import TrainingSupervisor
+    from paddle_tpu.testing.chaos import _sv_flaky_entry
+    sv = TrainingSupervisor(
+        _sv_flaky_entry, args=(str(tmp_path / "state"), 2, 5),
+        backoff_s=0.05, backoff_max_s=0.2, crash_budget=5,
+        workdir=str(tmp_path))
+    res = sv.run()
+    assert res.clean_exit and res.attempts == 3 and res.restarts == 2
+    assert [r["exit_code"] for r in res.exit_history] == [5, 5]
+    assert all(r["reason"] == "crash(exit=5)" for r in res.exit_history)
+    assert monitor.get_stat("supervisor.starts") == 3
+    assert monitor.get_stat("supervisor.restarts") == 2
+    assert monitor.get_stat("supervisor.clean_exits") == 1
+
+
+def test_supervisor_crash_loop_gives_up_with_history(tmp_path):
+    from paddle_tpu.distributed.supervisor import (SupervisorGaveUp,
+                                                   TrainingSupervisor)
+    from paddle_tpu.testing.chaos import _sv_flaky_entry
+    sv = TrainingSupervisor(
+        _sv_flaky_entry, args=(str(tmp_path / "state"), 10 ** 9, 3),
+        backoff_s=0.01, crash_window_s=600.0, crash_budget=1,
+        workdir=str(tmp_path))
+    with pytest.raises(SupervisorGaveUp, match="giving up") as ei:
+        sv.run()
+    assert len(ei.value.exit_history) == 2   # budget 1 -> 2nd crash ends it
+    assert all(r["exit_code"] == 3 for r in ei.value.exit_history)
+    assert monitor.get_stat("supervisor.gave_up") == 1
+
+
+def test_supervisor_watchdog_kills_hang_and_dumps_flight(tmp_path):
+    import json as _json
+
+    from paddle_tpu.distributed.supervisor import (StepWatchdog,
+                                                   TrainingSupervisor)
+    from paddle_tpu.testing.chaos import _sv_hang_entry
+    sv = TrainingSupervisor(
+        _sv_hang_entry, args=(str(tmp_path / "state"),),
+        watchdog=StepWatchdog(multiplier=6.0, min_deadline_s=0.6,
+                              max_deadline_s=8.0),
+        hang_grace_s=0.5, poll_s=0.1, backoff_s=0.05, crash_budget=5,
+        workdir=str(tmp_path))
+    res = sv.run()
+    assert res.clean_exit and res.hang_kills == 1 and res.restarts == 1
+    assert res.exit_history[0]["reason"] == "hang"
+    assert monitor.get_stat("supervisor.hang_kills") == 1
+    # the kill-time flight dump names the restart reason
+    box = _json.load(open(str(tmp_path / "supervisor_kill_a0.json")))
+    assert box["reason"] == "supervisor.hang"
+    assert box["extra"]["restart_reason"] == "hang"
+    assert box["extra"]["attempt"] == 0
+    assert box["extra"]["last_step"] is not None
+
+
+def test_supervisor_restart_recompile_not_judged_at_step_scale(tmp_path):
+    """A restarted child recompiles from scratch: until it produces a
+    STEP beat, only startup_timeout_s applies — the interval window
+    retained from the previous incarnation (steps of ~0.02s here) must
+    not get its quiet 2s start killed as a hang."""
+    from paddle_tpu.distributed.supervisor import (StepWatchdog,
+                                                   TrainingSupervisor)
+    from paddle_tpu.testing.chaos import _sv_slow_start_entry
+    sv = TrainingSupervisor(
+        _sv_slow_start_entry, args=(str(tmp_path / "state"),),
+        watchdog=StepWatchdog(multiplier=2.0, min_deadline_s=0.3,
+                              max_deadline_s=5.0),
+        startup_timeout_s=60.0, hang_grace_s=0.5, poll_s=0.05,
+        backoff_s=0.05, crash_budget=5, workdir=str(tmp_path))
+    res = sv.run()
+    assert res.clean_exit and res.hang_kills == 0
+    assert [r["exit_code"] for r in res.exit_history] == [3]
+    assert monitor.get_stat("supervisor.hang_kills") == 0
+
+
+def test_chaos_supervise_scenario_in_process(tmp_path):
+    """tools/chaos_smoke.py --scenario supervise, in-process: injected
+    mid-step hang -> watchdog kill -> resume from a step snapshot, then
+    injected hard crash -> restart onto mesh dp=4 of 8 via reshard
+    restore, loss-trajectory parity with the fault-free run."""
+    from paddle_tpu.testing import chaos
+    assert chaos.supervise_main(workdir=str(tmp_path)) == 0

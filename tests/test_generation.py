@@ -508,3 +508,89 @@ def test_serve_smoke_decode_gate_in_process():
 def test_generation_chaos_in_process(capsys):
     from paddle_tpu.testing import chaos
     assert chaos.generation_main(requests=8, clients=2) == 0
+
+
+# ---------------------------------------------- SIGTERM mid-stream ----
+def test_sigterm_mid_stream_finishes_accepted_and_reclaims_pages(lm):
+    """ISSUE 13 satellite: SIGTERM (the preemption notice) arriving
+    while streams are mid-generation.  The handler drains + closes the
+    engine: every accepted stream either finishes its budget or ends
+    with an in-band error — never a hang — no future is stranded, and
+    the page pool is fully reclaimed."""
+    import os
+    import signal
+
+    from paddle_tpu.utils.checkpoint import install_preemption_handler
+
+    eng = serving.GenerationEngine(lm, num_slots=2, page_size=4,
+                                   max_context=64, max_queue=32)
+    eng.warmup()
+    terminated = threading.Event()
+
+    def on_term():
+        terminated.set()
+        eng.drain(timeout=60)   # accepted work finishes...
+        eng.close()             # ...then the engine shuts down
+
+    restore = install_preemption_handler(on_term)
+    assert restore is not None
+    try:
+        streams = [eng.generate([i + 1], max_new_tokens=6, seed=i)
+                   for i in range(5)]
+        # demonstrably mid-stream: first token of stream 0 consumed
+        it = streams[0].tokens(timeout=30)
+        first = next(it)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert terminated.is_set()
+        outcomes = []
+        for s in streams:
+            try:
+                toks = s.result(timeout=30)    # no stranded futures
+                assert len(toks) == 6
+                outcomes.append("finished")
+            except (serving.EngineClosed, GenerationError):
+                outcomes.append("in-band-error")
+        # close-after-drain semantics: accepted streams FINISH here
+        assert outcomes.count("finished") == len(streams), outcomes
+        # the mid-consumption iterator also runs to its clean end
+        rest = [t for t in it]
+        assert [first] + rest == streams[0].result(0)
+    finally:
+        restore()
+        eng.close()
+    stats = eng.stats()
+    assert eng.page_pool.in_use == 0           # pool fully reclaimed
+    assert stats["counters"]["pages_allocated"] \
+        == stats["counters"]["pages_freed"]
+    with pytest.raises(serving.EngineClosed):
+        eng.generate([1], max_new_tokens=1)    # post-SIGTERM admission
+
+
+def test_sigterm_mid_stream_close_without_drain_fails_in_band(lm):
+    """The harsher variant: the handler closes immediately.  Accepted
+    streams may finish (close drains what it can) or fail — but always
+    in-band, with the pool reclaimed; nothing hangs or leaks."""
+    import os
+    import signal
+
+    from paddle_tpu.utils.checkpoint import install_preemption_handler
+
+    eng = serving.GenerationEngine(lm, num_slots=1, page_size=4,
+                                   max_context=64, max_queue=32)
+    eng.warmup()
+    eng.pause()                                # queue builds up
+    restore = install_preemption_handler(lambda: eng.close(timeout=30))
+    try:
+        streams = [eng.generate([i + 1], max_new_tokens=4)
+                   for i in range(4)]
+        os.kill(os.getpid(), signal.SIGTERM)
+        for s in streams:
+            try:
+                toks = s.result(timeout=30)    # resolves either way
+                assert len(toks) == 4
+            except (serving.EngineClosed, GenerationError):
+                pass                           # in-band error is legal
+    finally:
+        restore()
+        eng.close()
+    assert eng.page_pool.in_use == 0

@@ -26,10 +26,19 @@ Scenarios (``--scenario``, default ``all``):
   ``{dp: 2}``; fails unless the restore is bitwise and the
   post-restore loss trajectory matches the uninterrupted run
   (ROADMAP item 1's success criterion).
+- ``supervise`` — :func:`paddle_tpu.testing.chaos.supervise_main`: one
+  TrainingSupervisor-managed job survives an injected mid-step hang
+  (watchdog misses heartbeats → SIGTERM→SIGKILL → resume from the
+  step-cadence snapshot) and then an injected hard crash whose
+  replacement sees only 4 of the original 8 devices (reshard-restore
+  restart); fails unless the assembled loss trajectory matches the
+  fault-free run with zero manual intervention and the kill, restart
+  reasons and snapshot resumes are visible in ``supervisor.*`` stats,
+  the exit history and the kill-time flight dump.
 
 Usage::
 
-    python tools/chaos_smoke.py [--scenario all|training|serving|generation|reshard]
+    python tools/chaos_smoke.py [--scenario all|training|serving|generation|reshard|supervise]
                                 [--epochs 4] [--verbose]
 
 CI treats a non-zero exit as a robustness regression.  The same flows
@@ -51,15 +60,15 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     ap.add_argument("--scenario", default="all",
                     choices=["all", "training", "serving", "generation",
-                             "reshard"])
+                             "reshard", "supervise"])
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
-    if args.scenario == "reshard":
-        # the reshard drill needs a multi-device mesh; set env BEFORE
-        # anything initialises jax.  Scoped to this scenario only — the
-        # other drills must keep exercising the host's real device
-        # config (under --scenario all the drill runs in a subprocess).
+    if args.scenario in ("reshard", "supervise"):
+        # these drills need a multi-device mesh; set env BEFORE
+        # anything initialises jax.  Scoped to these scenarios only —
+        # the other drills must keep exercising the host's real device
+        # config (under --scenario all each drill runs in a subprocess).
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
@@ -76,13 +85,16 @@ def main(argv=None) -> int:
         rc |= chaos.generation_main(verbose=args.verbose)
     if args.scenario == "reshard":
         rc |= chaos.reshard_main(verbose=args.verbose)
-    elif args.scenario == "all":
+    if args.scenario == "supervise":
+        rc |= chaos.supervise_main(verbose=args.verbose)
+    if args.scenario == "all":
         import subprocess
-        sub = [sys.executable, os.path.abspath(__file__),
-               "--scenario", "reshard"]
-        if args.verbose:
-            sub.append("--verbose")
-        rc |= subprocess.run(sub).returncode
+        for sub_scenario in ("reshard", "supervise"):
+            sub = [sys.executable, os.path.abspath(__file__),
+                   "--scenario", sub_scenario]
+            if args.verbose:
+                sub.append("--verbose")
+            rc |= subprocess.run(sub).returncode
     return rc
 
 

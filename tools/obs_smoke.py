@@ -112,6 +112,11 @@ def _check_disabled_contract(failures: list) -> None:
     if "obs_hook" not in run_names or "_perf" not in run_names:
         failures.append("Executor._run lost its obs_hook._perf "
                         "disabled-path check")
+    # supervised-training heartbeat rides the same contract: one
+    # module-attribute check per step, nothing more, when unsupervised
+    if "_heartbeat" not in run_names:
+        failures.append("Executor._run lost its obs_hook._heartbeat "
+                        "disabled-path check")
 
 
 def run_checks(verbose: bool = False) -> list:
