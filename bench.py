@@ -1295,12 +1295,16 @@ def bench_lenet_dygraph(args):
 
 
 def bench_multichip(args):
-    """Multichip GPT-tiny collective-efficiency run (ISSUE 10 gate):
-    tools/comm_smoke.py on 8 virtual CPU devices in a subprocess (this
-    process's jax is already initialised with its own device count),
-    comparing int8 block-scaled grad_comm against the fp32 wire
-    baseline — wire bytes/step (measured == cost-model prediction),
-    loss-trajectory parity under error feedback, recompiles."""
+    """Multichip GPT-tiny collective-efficiency + overlap run (ISSUE
+    10/14 gates): tools/comm_smoke.py on 8 virtual CPU devices in a
+    subprocess (this process's jax is already initialised with its own
+    device count), comparing int8 block-scaled grad_comm against the
+    fp32 wire baseline — wire bytes/step (measured == cost-model
+    prediction), loss-trajectory parity under error feedback,
+    recompiles — and overlap=auto against overlap=none: step time vs
+    the max(compute, comm) bound, with the perf observatory's
+    exposed-vs-hidden comm split embedded next to the wire-byte ratio
+    (result key ``overlap_gate``)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:

@@ -29,6 +29,15 @@ if "JAX_DEFAULT_PRNG_IMPL" not in _os.environ:
         _jax.config.update("jax_default_prng_impl",
                            _os.environ.get("FLAGS_prng_impl", "rbg"))
 
+# latency-hiding scheduler knob: XLA_FLAGS is parsed exactly once, at
+# backend creation, so FLAGS_xla_latency_hiding must act HERE — before
+# the first device query anywhere below (core/xla_env.py appends only
+# the target platform's scheduler flags; unknown flags are fatal to
+# XLA's parser, so a CPU process never gets TPU flags appended)
+from .core import xla_env as _xla_env  # noqa: E402
+
+_xla_env.apply_latency_hiding_flags()
+
 from .core import (Parameter, Tensor, enable_grad, get_default_dtype,  # noqa
                    get_flags, get_rng_state, grad, no_grad, seed,
                    set_default_dtype, set_flags, set_rng_state, to_tensor)

@@ -220,6 +220,24 @@ define_flag("pallas_interpret", False,
             "TPU kernel dataflow under JAX_PLATFORMS=cpu, never as a "
             "CPU performance path.  On a real TPU backend the tier "
             "needs only FLAGS_use_pallas_kernels.")
+define_flag("xla_latency_hiding", False,
+            "Enable XLA's latency-hiding scheduler by appending the "
+            "backend's scheduler flags to XLA_FLAGS at import, BEFORE "
+            "backend initialisation (core/xla_env.py; set it as the "
+            "FLAGS_xla_latency_hiding environment variable — a "
+            "set_flags() call after jax's backend exists is too late "
+            "and is ignored with a warning).  With it on, the per-"
+            "bucket grad_comm collectives (strategy.grad_comm.overlap="
+            "'auto') are split into async start/done pairs the "
+            "scheduler hoists across backward compute — comm hides "
+            "behind backward instead of adding to it; without it, "
+            "overlap='auto' falls back to the ppermute-chunked ring "
+            "lowering on TPU/GPU.  TPU/GPU only: the CPU backend has "
+            "no such scheduler (and rejects unknown XLA flags "
+            "fatally), so CPU processes never get flags appended and "
+            "auto keeps the fused per-bucket collectives there — a "
+            "serial backend overlaps nothing; force overlap='ring' to "
+            "exercise the chunked lowering on CPU.")
 define_flag("pallas_attention_dropout_min_seqlen", 512,
             "Flash threshold when attention dropout is active: the XLA "
             "path must materialize [B,H,L,L] dropout masks in HBM, so "

@@ -149,11 +149,25 @@ class GradCommConfig:
     whose quantized payload is at least this large take the
     bandwidth-optimal psum_scatter/all_to_all + all_gather route;
     smaller (latency-bound) buckets take a single fused psum.
-    Bucket sizing itself is ``strategy.fuse_grad_size_in_MB``."""
+    Bucket sizing itself is ``strategy.fuse_grad_size_in_MB``.
+
+    ``overlap``: how aggressively bucket collectives hide behind the
+    backward pass (T3-style fine-grained overlap) — ``"auto"`` picks
+    per backend (grad_comm.resolve_overlap_path: fused async
+    collectives under the latency-hiding scheduler on TPU/GPU with
+    ``FLAGS_xla_latency_hiding``, the explicit ppermute-chunked ring
+    on TPU/GPU without it, the fused form on CPU where nothing
+    overlaps anyway), ``"ring"`` forces the chunked ring lowering,
+    ``"none"`` barriers the whole comm stage after backward (the
+    measured no-overlap baseline: step time = compute + comm instead
+    of approaching max(compute, comm)).  Flipping it recompiles (the
+    plan fingerprint carries it) and re-zeroes the error-feedback
+    residuals."""
     dtype: Optional[str] = None       # None=off | 'fp32' | 'bf16' | 'int8'
     block_size: int = 256
     error_feedback: bool = True
     scatter_threshold_KB: float = 32.0
+    overlap: str = "auto"             # 'none' | 'auto' | 'ring'
 
 
 class DistributedStrategy:
@@ -308,6 +322,15 @@ def validate_toggles(strategy: "DistributedStrategy",
             f"{gc.scatter_threshold_KB!r} must be >= 0 (buckets at least "
             f"this large take psum_scatter+all_gather; smaller take one "
             f"fused psum).")
+    from .grad_comm import OVERLAP_MODES
+    if gc.overlap not in OVERLAP_MODES:
+        raise InvalidArgumentError(
+            f"strategy.grad_comm.overlap={gc.overlap!r}: must be 'none' "
+            f"(comm strictly after backward — the measured no-overlap "
+            f"baseline), 'auto' (per-backend: async collectives under "
+            f"the latency-hiding scheduler, chunked ring when the "
+            f"compiler won't schedule them, fused on CPU) or 'ring' "
+            f"(force the ppermute-chunked ring lowering).")
     if strategy.fp16_allreduce and gc.dtype not in (None, "bf16"):
         raise InvalidArgumentError(
             f"strategy.fp16_allreduce is an alias for grad_comm.dtype="

@@ -52,7 +52,8 @@ def _freeze(v):
 
 def record_compile(component: str, identity, signature: Dict[str, object],
                    note: str = "", predicted: Optional[dict] = None,
-                   kernels: Optional[List[str]] = None) -> dict:
+                   kernels: Optional[List[str]] = None,
+                   comm: Optional[dict] = None) -> dict:
     """Report one compile.
 
     ``component``: "executor" | "jit" | "predictor" | ... .
@@ -73,6 +74,11 @@ def record_compile(component: str, identity, signature: Dict[str, object],
     the tier recompiles via its own cache-key field, never as an
     attribution mystery, and the perf observatory can attribute a
     step-time delta to kernel on/off by reading the record.
+    ``comm``: the grad-comm bucket schedule this executable lowered
+    (per-bucket size/algorithm/wire/issue point + the resolved overlap
+    path) — on the record, OUT of the signature (knob flips recompile
+    through the plan fingerprint's ``sharding`` field), so overlap
+    decisions are auditable from ``explain_compiles()``.
     """
     sig = {k: _freeze(v) for k, v in signature.items()}
     now = time.time()
@@ -103,6 +109,8 @@ def record_compile(component: str, identity, signature: Dict[str, object],
             rec["predicted"] = dict(predicted)
         if kernels:
             rec["kernels"] = list(kernels)
+        if comm:
+            rec["comm"] = dict(comm)
         _records.append(rec)
         _totals[(component, cause)] += 1
     monitor.stat_add(f"compiles.{component}.{cause}")

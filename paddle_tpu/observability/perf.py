@@ -23,6 +23,16 @@ closes the loop at runtime:
   prediction (the ``predicted`` dict ``record_compile`` carries);
   :func:`perf_report` renders totals, per-identity drift %% and the
   worst offenders (``tools/perf_report.py`` is the CLI).
+- **Exposed-vs-hidden comm split** — when the compile record predicts
+  gradient-collective seconds (grad_comm under a sharding plan), each
+  fenced step is split into comm that hid behind backward and comm
+  that extended the step (``comm.exposed_ms`` / ``comm.hidden_ms``
+  histograms + a per-identity ``comm`` block in the report).  Under
+  ``overlap='none'`` the split is structural (hidden == 0 — the
+  lowering barriers comm after backward); on overlapping paths the
+  exposed share is learned from the fence, so a *scheduling*
+  regression (collectives sliding out from behind backward) moves
+  drift even when every kernel is as fast as ever.
 
 Disabled-path contract (the PR-5 rule): when the observatory is off,
 every instrumented site pays ONE module-attribute None-check
@@ -75,6 +85,42 @@ def device_memory() -> Dict[str, dict]:
             slot["live_bytes"] += int(nbytes)
             slot["arrays"] += 1
     return per
+
+
+def _comm_split_s(predicted: Optional[dict], measured_s: Optional[float]
+                  ) -> Optional[dict]:
+    """Exposed-vs-hidden comm split of one step, in seconds, from the
+    compile record's overlap prediction plus (when available) a fenced
+    measurement.
+
+    ``overlap == 'none'`` is structural: the lowering barriers the comm
+    stage after backward, so exposed == total and hidden == 0 by
+    construction, never by measurement.  On an overlapping path the
+    exposed share is *learned* from the fence: whatever the measured
+    step ran beyond the compute-only prediction is attributed to
+    exposed comm, clamped to [0, total comm] — an upper bound (queue
+    backlog and model error land in it too, which is exactly what
+    drift tracking wants to catch: a scheduling regression shows up as
+    exposed comm growing toward total).  Without a measurement the
+    predicted split is reported."""
+    if not predicted:
+        return None
+    comm_s = predicted.get("predicted_comm_s")
+    if not comm_s:
+        return None
+    path = predicted.get("comm_overlap", "none")
+    exposed_pred = predicted.get("predicted_exposed_comm_s", comm_s)
+    if path == "none":
+        exposed = comm_s
+    elif measured_s is not None:
+        compute_s = max(0.0, (predicted.get("predicted_step_s") or 0.0)
+                        - exposed_pred)
+        exposed = min(comm_s, max(0.0, measured_s - compute_s))
+    else:
+        exposed = min(comm_s, exposed_pred)
+    return {"comm_s": comm_s, "exposed_s": exposed,
+            "hidden_s": comm_s - exposed, "overlap": path,
+            "predicted_exposed_s": exposed_pred}
 
 
 def _predicted_step_s(predicted: Optional[dict]) -> Optional[float]:
@@ -153,6 +199,19 @@ class _IdentityPerf:
             drift["peak_bytes_pct"] = (
                 (self.peak_bytes - ppeak) / ppeak * 100.0)
         out["drift"] = drift
+        split = _comm_split_s(
+            self.predicted,
+            (measured["step_ms_p50"] / 1e3
+             if measured.get("step_ms_p50") is not None else None))
+        if split is not None:
+            out["comm"] = {
+                "overlap": split["overlap"],
+                "comm_ms": split["comm_s"] * 1e3,
+                "exposed_ms": split["exposed_s"] * 1e3,
+                "hidden_ms": split["hidden_s"] * 1e3,
+                "predicted_exposed_ms":
+                    split["predicted_exposed_s"] * 1e3,
+            }
         return out
 
 
@@ -232,6 +291,16 @@ class PerfObservatory:
             idp.device_s.append(device_s)
         monitor.stat_observe("step.device_ms", device_s * 1e3)
         monitor.stat_add("perf.fences")
+        # exposed-vs-hidden comm split per fenced step: when the compile
+        # record predicted gradient-collective seconds, attribute this
+        # step's wall beyond the compute-only prediction to exposed comm
+        # (structurally all-exposed under overlap='none')
+        split = _comm_split_s(idp.predicted, device_s)
+        if split is not None:
+            monitor.stat_observe("comm.exposed_ms",
+                                 split["exposed_s"] * 1e3)
+            monitor.stat_observe("comm.hidden_ms",
+                                 split["hidden_s"] * 1e3)
         if trc is not None:
             # device lane: dispatch start -> results ready.  Includes
             # any queue backlog the async pipeline had built — the
@@ -396,6 +465,13 @@ def render_perf_report(rep: Optional[dict] = None) -> str:
                     f"(drift {_fmt_pct(d.get('peak_bytes_pct'))})"
                     if ppeak else "")
             lines.append(f"    peak live bytes {m['peak_bytes']}{pred}")
+        c = r.get("comm")
+        if c is not None:
+            lines.append(
+                f"    comm {c['comm_ms']:.3f} ms "
+                f"(exposed {c['exposed_ms']:.3f} / hidden "
+                f"{c['hidden_ms']:.3f}, overlap={c['overlap']}, "
+                f"predicted exposed {c['predicted_exposed_ms']:.3f})")
     for dev, slot in sorted(rep.get("devices", {}).items()):
         lines.append(f"  device {dev}: peak live "
                      f"{slot['peak_live_bytes']} bytes")

@@ -311,7 +311,10 @@ class SpmdTrainStep(TrainStep):
                 # carries the plan's dtype (bf16 subsumes the old
                 # fp16_allreduce cast/recast pair,
                 # fp16_allreduce_optimizer.py:18); residual-less — the
-                # error-feedback carry lives on the Executor path
+                # error-feedback carry lives on the Executor path.
+                # The overlap lowering follows the plan's resolved
+                # path (strategy.grad_comm.overlap), same as the
+                # Executor — ring/none/xla are numerics-compatible
                 grads, _ = _gc.reduce_gradients(
                     grads, plan=plan, axis_name=DP_AXIS, residuals=None)
                 loss = jax.lax.pmean(loss, DP_AXIS)

@@ -45,28 +45,34 @@ __all__ = ["ChipSpec", "CHIP_SPECS", "OpCost", "ProgramReport",
 # ---------------------------------------------------------------------------
 
 class ChipSpec:
-    """Roofline corner of one accelerator."""
+    """Roofline corner of one accelerator.  ``ici_bw`` is the nominal
+    per-chip interconnect bandwidth (bytes/s through one device's
+    links) that turns the grad-comm plan's wire bytes into seconds —
+    the exposed-comm model divides per-bucket wire bytes by it."""
 
-    __slots__ = ("name", "peak_flops", "hbm_bw", "hbm_bytes")
+    __slots__ = ("name", "peak_flops", "hbm_bw", "hbm_bytes", "ici_bw")
 
     def __init__(self, name: str, peak_flops: float, hbm_bw: float,
-                 hbm_bytes: int):
+                 hbm_bytes: int, ici_bw: float = 0.0):
         self.name = name
         self.peak_flops = float(peak_flops)
         self.hbm_bw = float(hbm_bw)
         self.hbm_bytes = int(hbm_bytes)
+        self.ici_bw = float(ici_bw)
 
     def to_dict(self) -> dict:
         return {"name": self.name, "peak_flops": self.peak_flops,
-                "hbm_bw": self.hbm_bw, "hbm_bytes": self.hbm_bytes}
+                "hbm_bw": self.hbm_bw, "hbm_bytes": self.hbm_bytes,
+                "ici_bw": self.ici_bw}
 
 
 CHIP_SPECS: Dict[str, ChipSpec] = {
-    # nominal host CPU: AVX-512-ish core complex + DDR5 channel pair
-    "cpu": ChipSpec("cpu", 200e9, 40e9, 16 << 30),
-    "v4": ChipSpec("v4", 275e12, 1228e9, 32 << 30),
-    "v5e": ChipSpec("v5e", 197e12, 819e9, 16 << 30),
-    "v5p": ChipSpec("v5p", 459e12, 2765e9, 95 << 30),
+    # nominal host CPU: AVX-512-ish core complex + DDR5 channel pair;
+    # 'interconnect' between virtual devices is a memcpy
+    "cpu": ChipSpec("cpu", 200e9, 40e9, 16 << 30, 20e9),
+    "v4": ChipSpec("v4", 275e12, 1228e9, 32 << 30, 300e9),
+    "v5e": ChipSpec("v5e", 197e12, 819e9, 16 << 30, 186e9),
+    "v5p": ChipSpec("v5p", 459e12, 2765e9, 95 << 30, 600e9),
 }
 
 
@@ -348,7 +354,8 @@ def _optimizer_flops(program: Program, trainable_bytes: int,
 # gradient-collective prediction (grad_comm wire bytes)
 # ---------------------------------------------------------------------------
 
-def _comm_block(program: Program, plan) -> Optional[dict]:
+def _comm_block(program: Program, plan,
+                graph: Optional[DefUseGraph] = None) -> Optional[dict]:
     """Predicted per-step gradient-communication cost of a training
     program under a sharding plan: per-collective wire bytes (quantized
     payload + scales), latency-vs-bandwidth classification, and the
@@ -379,6 +386,10 @@ def _comm_block(program: Program, plan) -> Optional[dict]:
         return {
             "enabled": False, "dp": dp, "dtype": "fp32",
             **({"error": err} if err else {}),
+            # GSPMD's default grad psum sits after backward in the
+            # schedule the compiler emits without a latency-hiding
+            # scheduler — modeled as fully exposed (issue_frac 1)
+            "overlap": "none", "overlap_path": "none",
             "wire_bytes_per_step": fp32_wire,
             "fp32_wire_bytes_per_step": fp32_wire,
             "collectives": ([] if dp <= 1 else [{
@@ -386,19 +397,53 @@ def _comm_block(program: Program, plan) -> Optional[dict]:
                 "numel": grad_bytes // 4, "algorithm": "gspmd_psum",
                 "wire_dtype": "fp32", "wire_bytes": fp32_wire,
                 "collectives": 1, "classification": "bandwidth",
-                "error_feedback": False}]),
+                "error_feedback": False, "issue_frac": 1.0}]),
         }
     cfg = plan.grad_comm
-    gplan = _gc.plan_reduction(shapes, dp=dp, cfg=cfg)
+    # the SAME production order the Executor buckets with (backward
+    # levels over the DefUseGraph) — bucket contents, and therefore
+    # per-bucket wire bytes and issue points, cannot drift apart
+    pack = program._optimizer
+    order = _gc.production_order(program, trainable,
+                                 pack[1] if pack is not None else None,
+                                 graph=graph)
+    gplan = _gc.plan_reduction(shapes, dp=dp, cfg=cfg, order=order)
     return {
         "enabled": True, "dp": dp, "dtype": cfg.dtype,
         "block_size": cfg.block_size,
         "error_feedback": cfg.error_feedback,
+        "overlap": cfg.overlap,
+        "overlap_path": gplan.overlap_path,
         "wire_bytes_per_step": gplan.wire_bytes_per_step,
         "fp32_wire_bytes_per_step": gplan.fp32_wire_bytes_per_step,
         "collectives_per_step": gplan.collectives_per_step,
         "collectives": [b.to_dict() for b in gplan.buckets],
     }
+
+
+def _comm_seconds(comm: dict, backward_s: float, ici_bw: float
+                  ) -> Tuple[float, float]:
+    """(total comm seconds, predicted EXPOSED comm seconds) of one
+    comm block on a chip with ``ici_bw`` interconnect bandwidth.
+
+    The exposed share follows the bucket schedule: bucket i's grads
+    are complete at ``backward_s * issue_frac_i``, its collective then
+    occupies the link after any earlier bucket's finishes, and
+    whatever the link is still moving when backward ends is exposed —
+    ``max(0, link_end - backward_s)``.  For a single bucket this is
+    exactly ``max(0, comm_s - overlappable_backward_s)``.  With
+    ``overlap_path == 'none'`` (or no overlap info) the whole stage is
+    serialized after backward: exposed == total."""
+    if ici_bw <= 0:
+        return 0.0, 0.0
+    total = comm["wire_bytes_per_step"] / ici_bw
+    if not comm.get("enabled") or comm.get("overlap_path") == "none":
+        return total, total
+    link_end = 0.0
+    for b in comm.get("collectives", ()):
+        ready = backward_s * float(b.get("issue_frac", 1.0))
+        link_end = max(link_end, ready) + b["wire_bytes"] / ici_bw
+    return total, max(0.0, link_end - backward_s)
 
 
 # ---------------------------------------------------------------------------
@@ -621,13 +666,24 @@ class ProgramReport:
                 f"{'grad_comm ' + str(comm['dtype']) if comm['enabled'] else 'gspmd fp32'}): "
                 f"{_fmt_bytes(comm['wire_bytes_per_step'])}/step wire "
                 f"({ratio:.2f}x fp32), "
-                f"{len(comm['collectives'])} collective group(s)")
+                f"{len(comm['collectives'])} collective group(s), "
+                f"overlap {comm.get('overlap', 'none')}"
+                f"->{comm.get('overlap_path', 'none')}")
         if self.roofline:
             lines.append("  roofline (predicted):")
             for name, r in self.roofline.items():
+                split = ""
+                if r.get("predicted_comm_s") is not None:
+                    split = (
+                        f", comm {r['predicted_comm_s'] * 1e3:.3f} ms "
+                        f"(exposed "
+                        f"{r['predicted_exposed_comm_s'] * 1e3:.3f} / "
+                        f"hidden "
+                        f"{r['predicted_hidden_comm_s'] * 1e3:.3f})")
                 lines.append(
                     f"    {name:>4}: step {r['predicted_step_s'] * 1e3:.3f} ms, "
-                    f"MFU {r['predicted_mfu']:.3f}, {r['bound']}-bound")
+                    f"MFU {r['predicted_mfu']:.3f}, {r['bound']}-bound"
+                    + split)
         if self.fusion_candidates:
             n_real = sum(1 for c in self.fusion_candidates
                          if c.get("realized"))
@@ -775,6 +831,9 @@ def analyze(program: Program, fetch_list: Optional[Sequence] = None,
         roof_flops = flops_fwd
     intensity = roof_flops / max(min_traffic, 1)
 
+    comm = _comm_block(program, sharding, graph=graph) \
+        if sharding is not None else None
+
     if chip is not None:
         if chip not in CHIP_SPECS:
             raise KeyError(
@@ -787,7 +846,7 @@ def analyze(program: Program, fetch_list: Optional[Sequence] = None,
         t_comp = roof_flops / spec.peak_flops
         t_mem = min_traffic / spec.hbm_bw
         step = max(t_comp, t_mem)
-        roofline[name] = {
+        entry = {
             "peak_flops": spec.peak_flops,
             "hbm_bw": spec.hbm_bw,
             "predicted_step_s": step,
@@ -795,6 +854,23 @@ def analyze(program: Program, fetch_list: Optional[Sequence] = None,
             "bound": "compute" if t_comp >= t_mem else "memory",
             "fits_hbm": memory.peak_bytes_donated <= spec.hbm_bytes,
         }
+        if comm is not None and training and comm.get("dp", 1) > 1:
+            # overlap-aware step time: only the EXPOSED share of the
+            # gradient collectives adds to the step — comm that hides
+            # behind backward costs nothing.  Backward's window is its
+            # FLOP share of the compute-only step (2x the forward of
+            # the 3x-fwd training total).
+            backward_s = step * (2.0 * flops_fwd / max(roof_flops, 1))
+            comm_s, exposed_s = _comm_seconds(comm, backward_s,
+                                              spec.ici_bw)
+            entry["predicted_comm_s"] = comm_s
+            entry["predicted_exposed_comm_s"] = exposed_s
+            entry["predicted_hidden_comm_s"] = comm_s - exposed_s
+            entry["predicted_step_s"] = step + exposed_s
+            if entry["predicted_step_s"] > 0:
+                entry["predicted_mfu"] = (
+                    t_comp / entry["predicted_step_s"])
+        roofline[name] = entry
 
     fetched_ids = {id(v) for v in fetch_vars}
     cands = _fusion_candidates(graph, costs, avals, fetched_ids, top_k)
@@ -823,8 +899,6 @@ def analyze(program: Program, fetch_list: Optional[Sequence] = None,
     rep.batch_hint = batch_size
     rep.per_op = costs
     rep.memory_per_shard = memory_per_shard
-    comm = _comm_block(program, sharding) if sharding is not None \
-        else None
     rep.totals = {
         **({"mesh_devices": sharding.n_devices}
            if sharding is not None else {}),
@@ -935,4 +1009,13 @@ def compile_summary(program: Program, donate: bool = True,
         # comm.wire_bytes stat is compared against
         out["predicted_wire_bytes"] = comm["wire_bytes_per_step"]
         out["comm_enabled"] = comm["enabled"]
+        # the overlap prediction (total/exposed/hidden comm seconds on
+        # the running chip + the resolved path) — what the perf
+        # observatory's exposed-vs-hidden split reads per step
+        out["comm_overlap"] = comm.get("overlap_path", "none")
+        r = rep.roofline[chip]
+        for k in ("predicted_comm_s", "predicted_exposed_comm_s",
+                  "predicted_hidden_comm_s"):
+            if k in r:
+                out[k] = r[k]
     return out

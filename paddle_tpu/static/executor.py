@@ -131,7 +131,7 @@ class _ExecState:
     __slots__ = ("serial", "version", "params", "p_arrays", "opt_state",
                  "aux", "t_idx", "escaped", "gen", "lr_value", "lr_device",
                  "seed_val", "base_key", "no_seed", "synced_step",
-                 "__weakref__")
+                 "gc_key", "__weakref__")
 
     def __init__(self, program, params):
         self.serial = program._serial
@@ -149,6 +149,7 @@ class _ExecState:
         self.base_key = None
         self.no_seed = None
         self.synced_step = None
+        self.gc_key = None   # plan fingerprint the residual carry is for
         self._bind_all()
 
     # -- binding -----------------------------------------------------------
@@ -727,7 +728,8 @@ class Executor:
                 "donate": donate,
                 "pallas": pallas_on,
             }, predicted=predicted,
-                kernels=getattr(compiled, "_pallas_kernels", None))
+                kernels=getattr(compiled, "_pallas_kernels", None),
+                comm=getattr(compiled, "_comm_record", None))
 
         state = self._state_for(program, params)
 
@@ -763,19 +765,25 @@ class Executor:
                 opt._static_state_provider = weakref.ref(state)
             # grad_comm error-feedback residuals ride the donated aux
             # carry (one device-varying [dp, numel] array per quantized
-            # bucket); (re)zero them when the compiled plan's residual
-            # structure differs from what the carry holds (first train
-            # run, or a grad_comm knob change recompiled the program)
+            # bucket); (re)zero them when the compiled plan differs from
+            # the one the carry was accumulated under (first train run,
+            # or ANY grad_comm knob recompile — keyed on the plan
+            # fingerprint, not just the flat shapes, so an overlap flip
+            # that keeps bucket sizes still starts from a clean carry)
             rs = getattr(compiled, "_residual_shapes", None)
+            rk = getattr(compiled, "_residual_key", None)
             cur = state.aux.get("grad_comm")
             if rs:
-                if (cur is None or [tuple(a.shape) for a in cur]
+                if (cur is None or state.gc_key != rk
+                        or [tuple(a.shape) for a in cur]
                         != [tuple(s) for s in rs]):
                     state.aux = dict(state.aux, grad_comm=[
                         jnp.zeros(s, jnp.float32) for s in rs])
+                    state.gc_key = rk
             elif cur is not None:
                 state.aux = {k: v for k, v in state.aux.items()
                              if k != "grad_comm"}
+                state.gc_key = None
             opt._step_count += 1
             if state.synced_step != opt._step_count - 1:
                 # the optimizer counter moved outside this loop
@@ -812,14 +820,14 @@ class Executor:
             # wire-byte accounting: the grad_comm plan's per-step bytes
             # and collective choices are static, so the measured stat is
             # the plan total per dispatched step (predict == measure by
-            # construction; the cost model reports the same number)
+            # construction; the cost model reports the same numbers) —
+            # including the per-bucket (comm.bucket.<i>.*) and
+            # per-algorithm breakdown precomputed at compile
             cs = getattr(compiled, "_comm_stats", None)
             if cs is not None:
                 from ..utils import monitor
-                monitor.stat_add("comm.wire_bytes", cs[0])
-                monitor.stat_add("comm.collectives", cs[1])
-                for algo, cnt in cs[2].items():
-                    monitor.stat_add(f"comm.algo.{algo}", cnt)
+                for name, val in cs:
+                    monitor.stat_add(name, val)
         else:
             rng_key = jax.random.fold_in(
                 state.base_key, run_i if seed is None else int(seed))
@@ -884,13 +892,17 @@ class Executor:
         return (p_sh, s_sh, aux_sh, rep, feed_sh, fetch_sh)
 
     # -- grad_comm (quantized/bucketed gradient collectives) ---------------
-    def _grad_comm_plan(self, plan, params, t_idx):
+    def _grad_comm_plan(self, program, plan, params, t_idx, loss_var):
         """Reduction plan for the explicit grad-comm stage, or None when
         the mesh makes it a no-op (dp <= 1).  Raises loudly on meshes /
         param shardings the shard_map grad path cannot carry — the
         activation predicate is grad_comm.plan_status, SHARED with the
         cost model so prediction and runtime agree about which path
-        runs."""
+        runs.  Buckets assemble in the TRUE backward production order
+        (grad_comm.production_order over the DefUseGraph — also shared
+        with the cost model), so a bucket's collective is issued at the
+        point in backward where its last gradient materializes, not at
+        the reverse-creation-order proxy position."""
         from ..distributed import grad_comm as _gc
         from ..distributed.mesh import DP_AXIS
         from .analysis.liveness import param_array
@@ -900,9 +912,11 @@ class Executor:
         if status == "error":
             raise NotImplementedError(msg)
         shapes = [tuple(param_array(params[i]).shape) for i in t_idx]
+        order = _gc.production_order(
+            program, [params[i] for i in t_idx], loss_var)
         return _gc.plan_reduction(shapes,
                                   dp=plan.mesh.shape[DP_AXIS],
-                                  cfg=plan.grad_comm)
+                                  cfg=plan.grad_comm, order=order)
 
     def _build_grad_comm(self, params, fetch_names, donate, plan, gplan,
                          feed_arrays, opt, loss_var, t_idx, params_meta,
@@ -912,11 +926,13 @@ class Executor:
         over dp (params replicated and device-varied, batch feeds
         sharded), gradients are reduced by grad_comm.reduce_gradients —
         bucketed in backward production order so each bucket's
-        collective is independently schedulable against the remaining
-        backward compute, quantized per the plan, with the per-device
-        error-feedback residual carried (and donated) in the aux tree —
-        and the optimizer update runs outside on the replicated mean
-        grads."""
+        collective is issued where its last gradient materializes and
+        overlaps the backward still producing later buckets (the
+        lowering follows the plan's resolved overlap path: barriered
+        'none', scheduler-split 'xla', or ppermute-chunked 'ring'),
+        quantized per the plan, with the per-device error-feedback
+        residual carried (and donated) in the aux tree — and the
+        optimizer update runs outside on the replicated mean grads."""
         from jax.sharding import PartitionSpec
         from ..core import rng as _rng
         from ..core.jax_compat import pvary, shard_map
@@ -1121,9 +1137,31 @@ class Executor:
         compiled._gc_plan = gplan
         compiled._residual_shapes = [(dp, b.numel)
                                      for b in gplan.residual_buckets]
-        compiled._comm_stats = (gplan.wire_bytes_per_step,
-                                gplan.collectives_per_step,
-                                gplan.algo_counts())
+        # residuals are only meaningful for the exact bucket layout they
+        # were accumulated under: a knob recompile (overlap flip, dtype
+        # change, re-bucketing) re-zeroes them even when the flat shapes
+        # happen to coincide
+        compiled._residual_key = plan.fingerprint()
+        # per-step wire accounting, precomputed once per compile: the
+        # totals, the per-algorithm split, and the per-bucket breakdown
+        # (comm.bucket.<i>.*) — every number is static plan state, so
+        # measured == predicted per bucket too
+        stat_items = [("comm.wire_bytes", gplan.wire_bytes_per_step),
+                      ("comm.collectives", gplan.collectives_per_step)]
+        for algo, cnt in gplan.algo_counts().items():
+            stat_items.append((f"comm.algo.{algo}", cnt))
+        for i, b in enumerate(gplan.buckets):
+            stat_items.append((f"comm.bucket.{i}.wire_bytes",
+                               b.wire_bytes))
+            stat_items.append((f"comm.bucket.{i}.collectives",
+                               b.collectives))
+            stat_items.append((f"comm.algo.{b.algorithm}.wire_bytes",
+                               b.wire_bytes))
+        compiled._comm_stats = stat_items
+        # the bucket schedule (size, algo, wire, issue point) + resolved
+        # overlap path ride the compile record so overlap decisions are
+        # auditable from explain_compiles()
+        compiled._comm_record = gplan.schedule()
         return compiled
 
     def _build(self, program: Program, params, feed_names, fetch_names,
@@ -1225,7 +1263,8 @@ class Executor:
         # error-feedback residual carried in the donated aux tree.
         gplan = None
         if plan is not None and plan.grad_comm is not None:
-            gplan = self._grad_comm_plan(plan, params, t_idx)
+            gplan = self._grad_comm_plan(program, plan, params, t_idx,
+                                         loss_var)
         if gplan is not None:
             return self._build_grad_comm(
                 params, fetch_names, donate, plan, gplan, feed_arrays,

@@ -1220,3 +1220,341 @@ def test_grad_comm_rejects_sum_reduced_loss():
     finally:
         paddle.disable_static()
         paddle.static.reset_default_programs()
+
+
+def test_grad_comm_ring_reduction_bitwise_parity():
+    """ISSUE 14: the ppermute-chunked ring lowering is numerics-safe —
+    at fp32 wire its ascending-absolute-order accumulation is BITWISE
+    identical to the psum_scatter route (and to the barriered 'none'
+    lowering), so an overlap-path flip can never change fp32 training;
+    the int8 ring stays within the one-step quantization bound of the
+    fused all_to_all route."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.jax_compat import shard_map
+    dp = 8
+    mesh = dist.get_mesh()
+    shapes = [(33, 7), (130,), (9,)]
+    rng = np.random.RandomState(5)
+    g = [jnp.asarray((rng.standard_normal((dp,) + s) * 10 ** (i - 1))
+                     .astype(np.float32)) for i, s in enumerate(shapes)]
+
+    def run(dtype, mode, ef):
+        plan = gcx.plan_reduction(shapes, dp=dp, cfg=_spec(
+            dtype=dtype, block=32, ef=ef, thresh=0.0))
+
+        def local(*rows):
+            grads = [r[0] for r in rows]
+            res = ([jnp.zeros((b.numel,), jnp.float32)
+                    for b in plan.residual_buckets] if ef else None)
+            out, _ = gcx.reduce_gradients(grads, plan=plan,
+                                          residuals=res, mode=mode)
+            return tuple(out)
+
+        f = shard_map(local, mesh=mesh,
+                      in_specs=tuple(P("dp") for _ in g),
+                      out_specs=tuple(P() for _ in g), check_vma=False)
+        return [np.asarray(o) for o in jax.jit(f)(*g)]
+
+    base = run("fp32", "xla", ef=False)
+    for mode in ("ring", "none"):
+        for a, b in zip(base, run("fp32", mode, ef=False)):
+            np.testing.assert_array_equal(a, b)
+    ai = run("int8", "xla", ef=True)
+    bi = run("int8", "ring", ef=True)
+    bound = max(float(np.abs(np.asarray(x)).max()) for x in g) / 127.0
+    for a, b in zip(ai, bi):
+        assert np.abs(a - b).max() < bound
+
+
+def test_grad_comm_production_order_skip_architecture():
+    """Regression (ISSUE 14 satellite): reverse creation order was only
+    a proxy for backward production order.  When a shallow skip branch
+    is recorded BEFORE the deep trunk, its params' grads are finalized
+    early in backward (their VJP sits one level from the loss) even
+    though reverse creation order would put them last.
+    production_order must follow the DefUseGraph's backward levels."""
+    import paddle_tpu.nn.functional as F
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 8], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            skip = paddle.static.nn.fc(x, 1)    # shallow, recorded first
+            h = paddle.static.nn.fc(x, 16)      # deep trunk
+            out = paddle.static.nn.fc(h, 1)
+            loss = F.mse_loss(out + skip, y)
+        params = main.parameters()
+        # params in first-use order: [skip_w, skip_b, w1, b1, w2, b2]
+        assert len(params) == 6
+        order = gcx.production_order(main, params, loss)
+        assert sorted(order) == list(range(6))
+        old_proxy = list(reversed(range(6)))
+        assert order != old_proxy
+        pos = {i: k for k, i in enumerate(order)}
+        # the skip branch's grads (params 0, 1) are ready one VJP level
+        # from the loss — before the trunk's FIRST layer (params 2, 3),
+        # whose grads need the whole trunk backward chain
+        assert max(pos[0], pos[1]) < min(pos[2], pos[3])
+        # the trunk's last layer (4, 5) produces before its first (2, 3)
+        assert max(pos[4], pos[5]) < min(pos[2], pos[3])
+        # params on no backward path sort last
+        with paddle.static.program_guard(main):
+            dead = paddle.static.nn.fc(x, 1)  # noqa: F841 - not in loss
+        params2 = main.parameters()
+        order2 = gcx.production_order(main, params2, loss)
+        assert set(order2[-2:]) == {6, 7}
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_grad_comm_overlap_knob_recompile_rezero_and_bucket_stats():
+    """Flipping strategy.grad_comm.overlap recompiles (attributed as
+    new_sharding), re-zeroes the error-feedback residual carry even
+    though the bucket shapes are unchanged, records the bucket schedule
+    on the compile record, and the per-bucket wire stats
+    (comm.bucket.<i>.*) match the plan exactly."""
+    import jax.numpy as jnp
+    from paddle_tpu.observability import explain_compiles
+    from paddle_tpu.utils import monitor
+    paddle.enable_static()
+    try:
+        rng = np.random.RandomState(1)
+        xs = rng.standard_normal((64, 8)).astype(np.float32)
+        ys = (xs @ rng.standard_normal((8, 1))).astype(np.float32)
+        feed = {"x": xs, "y": ys}
+        gc = {"dtype": "int8", "scatter_threshold_KB": 0.01,
+              "block_size": 64, "overlap": "auto"}
+
+        def fresh(overlap):
+            init_mesh({"dp": 8})
+            paddle.seed(7)
+            main, loss = _grad_comm_fc_program(dict(gc, overlap=overlap))
+            init_mesh({"dp": 8})
+            return main, loss, paddle.static.Executor()
+
+        # run A: train 1 step at 'auto', poison the residual carry with
+        # a sentinel, flip the knob to 'none' -> the flip must recompile
+        # AND restart the carry from zeros (ignoring the sentinel)
+        main, loss, exe = fresh("auto")
+        w0 = {k: monitor.get_stat(k) or 0
+              for k in ("comm.bucket.0.wire_bytes",
+                        "comm.algo.scatter.wire_bytes")}
+        la1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        plan = exe._plan_for(main, main.parameters())
+        rep = main.analyze(fetch_list=[loss], sharding=plan)
+        comm = rep.totals["comm"]
+        b0 = comm["collectives"][0]
+        got = (monitor.get_stat("comm.bucket.0.wire_bytes") or 0) \
+            - w0["comm.bucket.0.wire_bytes"]
+        assert got == b0["wire_bytes"]
+        assert ((monitor.get_stat("comm.algo.scatter.wire_bytes") or 0)
+                - w0["comm.algo.scatter.wire_bytes"]
+                == comm["wire_bytes_per_step"])
+        assert all("issue_frac" in c for c in comm["collectives"])
+        state = exe._states[main._serial]
+        k1 = state.gc_key
+        assert k1 is not None
+        state.aux = dict(state.aux, grad_comm=[
+            jnp.ones_like(r) for r in state.aux["grad_comm"]])
+        # flip: a NEW strategy object (the plan cache keys on identity)
+        opt = main._optimizer[0]
+        strat2 = dist.DistributedStrategy()
+        strat2.grad_comm = dict(gc, overlap="none")
+        opt._dist_strategy = strat2
+        c_before = exe.compile_count
+        la2 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        # step 2's fetched loss reflects step 1's update only; the
+        # residuals consumed by step 2's reduction show up in step 3
+        la3 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        assert exe.compile_count == c_before + 1
+        assert exe._states[main._serial].gc_key != k1
+        recs = [r for r in explain_compiles("executor")["records"]
+                if r["identity"] == main._serial]
+        assert recs[-1]["cause"] == "new_sharding"
+        assert recs[-1]["comm"]["path"] == "none"
+        assert recs[-1]["comm"]["buckets"] == comm["collectives"]
+        exe.close()
+        paddle.static.reset_default_programs()
+
+        # oracle C: same training, 'none' from scratch, residuals
+        # hand-zeroed after step 1 — what run A must equal if the flip
+        # really re-zeroed (auto and none are bitwise-equal lowerings
+        # of the same math on this backend)
+        main, loss, exe = fresh("none")
+        lc1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        st = exe._states[main._serial]
+        st.aux = dict(st.aux, grad_comm=[
+            jnp.zeros_like(r) for r in st.aux["grad_comm"]])
+        lc2 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        lc3 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        exe.close()
+        paddle.static.reset_default_programs()
+
+        # control D: residuals forced to the SENTINEL instead — step 3
+        # must diverge (residuals demonstrably feed step 2's update)
+        main, loss, exe = fresh("none")
+        float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        st = exe._states[main._serial]
+        st.aux = dict(st.aux, grad_comm=[
+            jnp.ones_like(r) for r in st.aux["grad_comm"]])
+        float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        ld3 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        exe.close()
+        paddle.static.reset_default_programs()
+
+        assert la1 == lc1
+        assert la2 == lc2
+        assert la3 == lc3      # sentinel ignored: carry restarted at 0
+        assert ld3 != lc3      # sentinel NOT ignored without the flip
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_grad_comm_exposed_hidden_split_sanity():
+    """Cost model + perf observatory overlap accounting: hidden == 0 is
+    STRUCTURAL at overlap='none'; an overlapping schedule hides the
+    share of comm the backward window covers (link simulation over the
+    bucket issue points); the observatory's split is well-formed."""
+    import jax.numpy as jnp
+    import time as _t
+    from paddle_tpu.observability.perf import PerfObservatory
+    from paddle_tpu.static.analysis.cost import _comm_seconds
+
+    # barriered: everything exposed
+    one = {"enabled": True, "overlap_path": "none",
+           "wire_bytes_per_step": 2_000_000,
+           "collectives": [{"wire_bytes": 2_000_000, "issue_frac": 1.0}]}
+    total, exposed = _comm_seconds(one, backward_s=0.01, ici_bw=1e9)
+    assert total == exposed == 0.002
+    # two buckets, issued mid-backward: each 1 ms collective starts at
+    # its issue point (5 ms / 10 ms of a 10 ms backward); only the
+    # last one's tail sticks out
+    two = {"enabled": True, "overlap_path": "ring",
+           "wire_bytes_per_step": 2_000_000,
+           "collectives": [
+               {"wire_bytes": 1_000_000, "issue_frac": 0.5},
+               {"wire_bytes": 1_000_000, "issue_frac": 1.0}]}
+    total2, exposed2 = _comm_seconds(two, backward_s=0.01, ici_bw=1e9)
+    assert total2 == 0.002 and abs(exposed2 - 0.001) < 1e-12
+    # single early bucket fully covered by the remaining backward:
+    # exposed = max(0, comm_s - overlappable_backward_s) = 0
+    cov = {"enabled": True, "overlap_path": "xla",
+           "wire_bytes_per_step": 1_000_000,
+           "collectives": [{"wire_bytes": 1_000_000,
+                            "issue_frac": 0.25}]}
+    total3, exposed3 = _comm_seconds(cov, backward_s=0.01, ici_bw=1e9)
+    assert total3 == 0.001 and exposed3 == 0.0
+    # link contention: buckets queue behind each other even when their
+    # grads are ready
+    q = {"enabled": True, "overlap_path": "ring",
+         "wire_bytes_per_step": 3_000_000,
+         "collectives": [
+             {"wire_bytes": 2_000_000, "issue_frac": 0.9},
+             {"wire_bytes": 1_000_000, "issue_frac": 1.0}]}
+    t4, e4 = _comm_seconds(q, backward_s=0.01, ici_bw=1e9)
+    assert abs(e4 - 0.002) < 1e-12   # 9+2 then +1 => ends 12, bwd 10
+
+    # observatory: structural split at 'none', learned split elsewhere
+    def one_step(obs, ident, pred):
+        t0 = _t.perf_counter()
+        obs.step("executor", ident, t0, 0.0, t0, 0.0,
+                 jnp.zeros(()), predicted=pred)
+
+    obs = PerfObservatory(sample_every=1, memory=False)
+    one_step(obs, "idA", {"predicted_step_s": 1e-3,
+                          "predicted_comm_s": 5e-4,
+                          "predicted_exposed_comm_s": 5e-4,
+                          "comm_overlap": "none"})
+    c = obs.report()["identities"][0]["comm"]
+    assert c["overlap"] == "none"
+    assert c["hidden_ms"] == 0.0
+    assert c["exposed_ms"] == c["comm_ms"]
+    obs2 = PerfObservatory(sample_every=1, memory=False)
+    one_step(obs2, "idB", {"predicted_step_s": 1e-3,
+                           "predicted_comm_s": 5e-4,
+                           "predicted_exposed_comm_s": 0.0,
+                           "comm_overlap": "ring"})
+    c2 = obs2.report()["identities"][0]["comm"]
+    assert 0.0 <= c2["exposed_ms"] <= c2["comm_ms"] + 1e-9
+    assert abs(c2["exposed_ms"] + c2["hidden_ms"] - c2["comm_ms"]) < 1e-9
+    # no comm prediction -> no comm block (single None-check contract
+    # stays: the split is derived, never measured on unfenced steps)
+    obs3 = PerfObservatory(sample_every=1, memory=False)
+    one_step(obs3, "idC", {"predicted_step_s": 1e-3})
+    assert "comm" not in obs3.report()["identities"][0]
+
+
+def test_grad_comm_overlap_path_resolution_and_xla_env(monkeypatch):
+    """resolve_overlap_path policy + the FLAGS_xla_latency_hiding env
+    knob: platform-gated flags (unknown XLA flags are fatal, so CPU
+    never gets TPU flags), idempotent, and a too-late call only
+    warns."""
+    import os
+    import warnings
+    from paddle_tpu.core import xla_env
+
+    auto = _spec()
+    assert auto.overlap == "auto"
+    monkeypatch.setenv("XLA_FLAGS", "--prior=1")
+    # CPU: fused form — a serial backend overlaps nothing, chunking is
+    # pure rendezvous overhead
+    assert gcx.resolve_overlap_path(auto, backend="cpu") == "xla"
+    # TPU/GPU without the latency-hiding scheduler ACTUALLY in
+    # XLA_FLAGS: the compiler won't schedule collectives
+    # asynchronously -> explicit ring fallback (the raw knob being
+    # requested-but-never-applied must not count)
+    assert gcx.resolve_overlap_path(auto, backend="tpu") == "ring"
+    assert gcx.resolve_overlap_path(auto, backend="gpu") == "ring"
+    paddle.set_flags({"xla_latency_hiding": True})
+    try:
+        assert gcx.resolve_overlap_path(auto, backend="tpu") == "ring"
+        # with the scheduler flag really in the env (ours or the
+        # user's own), the fused async path wins
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_tpu_enable_latency_hiding_scheduler=true")
+        assert gcx.resolve_overlap_path(auto, backend="tpu") == "xla"
+        assert gcx.resolve_overlap_path(auto, backend="gpu") == "ring"
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_gpu_enable_latency_hiding_scheduler=true")
+        assert gcx.resolve_overlap_path(auto, backend="gpu") == "xla"
+        assert gcx.resolve_overlap_path(auto, backend="cpu") == "xla"
+    finally:
+        paddle.set_flags({"xla_latency_hiding": False})
+    monkeypatch.setenv("XLA_FLAGS", "--prior=1")
+    ring = gcx.CommSpec("int8", 64, True, 0.0, 32.0, "grad_comm", "ring")
+    none = gcx.CommSpec("int8", 64, True, 0.0, 32.0, "grad_comm", "none")
+    for backend in ("cpu", "tpu", "gpu"):
+        assert gcx.resolve_overlap_path(ring, backend) == "ring"
+        assert gcx.resolve_overlap_path(none, backend) == "none"
+
+    # env application: flag off -> no-op
+    monkeypatch.setenv("XLA_FLAGS", "--prior=1")
+    assert xla_env.apply_latency_hiding_flags(platform="tpu") == []
+    paddle.set_flags({"xla_latency_hiding": True})
+    try:
+        # the real backend of this process is initialised: warns, no-op
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert xla_env.apply_latency_hiding_flags(
+                platform="tpu") == []
+        assert any("backend initialised" in str(x.message) for x in w)
+        # pre-init path (hooked): appends ONLY the platform's flags
+        monkeypatch.setattr(xla_env, "_backend_initialized",
+                            lambda: False)
+        added = xla_env.apply_latency_hiding_flags(platform="tpu")
+        assert added == \
+            ["--xla_tpu_enable_latency_hiding_scheduler=true"]
+        assert added[0] in os.environ["XLA_FLAGS"]
+        assert "--prior=1" in os.environ["XLA_FLAGS"]
+        assert "xla_gpu" not in os.environ["XLA_FLAGS"]
+        # idempotent
+        assert xla_env.apply_latency_hiding_flags(platform="tpu") == []
+        # unknown platform: nothing appended (fatal-flag safety)
+        assert xla_env.apply_latency_hiding_flags(platform="cpu") == []
+    finally:
+        paddle.set_flags({"xla_latency_hiding": False})

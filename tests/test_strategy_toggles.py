@@ -558,3 +558,26 @@ def test_fp16_allreduce_zero3_still_raises():
     opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
     with pytest.raises(NotImplementedError, match="fp16_allreduce"):
         SpmdTrainStep(net, loss_fn, opt, strategy=strat)
+
+
+def test_grad_comm_overlap_knob_validation():
+    """ISSUE 14: the overlap knob validates like every other grad_comm
+    knob — a typo'd mode fails loudly, every real mode passes."""
+    from paddle_tpu.core.enforce import InvalidArgumentError
+    from paddle_tpu.distributed.strategy import validate_toggles
+    s = DistributedStrategy()
+    s.grad_comm = {"dtype": "int8", "overlap": "eager"}
+    with pytest.raises(InvalidArgumentError, match="overlap"):
+        validate_toggles(s)
+    for ov in ("none", "auto", "ring"):
+        s = DistributedStrategy()
+        s.grad_comm = {"dtype": "int8", "overlap": ov}
+        validate_toggles(s)
+    # the knob rides the spec fingerprint: flips must recompile
+    from paddle_tpu.distributed import grad_comm as gcx
+    fps = set()
+    for ov in ("none", "auto", "ring"):
+        s = DistributedStrategy()
+        s.grad_comm = {"dtype": "int8", "overlap": ov}
+        fps.add(gcx.resolve(s).fingerprint())
+    assert len(fps) == 3

@@ -1,23 +1,39 @@
 #!/usr/bin/env python
 """Gradient-collective smoke gate: quantized grad_comm on multichip GPT.
 
-The collective-efficiency promise of ``paddle_tpu.distributed.grad_comm``
-(ISSUE 10 / ROADMAP item 2), executably: the GPT-tiny causal LM from
-``tools/shard_smoke.py``, trained through ``fleet.distributed_optimizer``
-+ the static ``Executor`` on an 8-device dp mesh, once with fp32 wire
-(the measured baseline — same math as GSPMD's default, but with the
-explicit bucketed stage so ``comm.*`` stats exist) and once with
-block-scaled int8 + error feedback:
+The collective-efficiency AND compute-collective-overlap promises of
+``paddle_tpu.distributed.grad_comm`` (ISSUE 10 + ISSUE 14 / ROADMAP
+item 2), executably: the GPT-tiny causal LM from ``tools/shard_smoke``,
+trained through ``fleet.distributed_optimizer`` + the static
+``Executor`` on an 8-device dp mesh, four configurations — fp32 wire
+(the measured baseline), block-scaled int8 + error feedback with
+``overlap="auto"``, the same int8 config with ``overlap="none"``
+(comm barriered after backward), and with ``overlap="ring"`` (the
+ppermute-chunked lowering forced, so the explicit fallback path is
+exercised end-to-end even on backends where auto picks the fused
+form):
 
 - **wire bytes**: int8 ``comm.wire_bytes``/step < 0.35x the fp32 run's
   (quantized payload + scales, both measured from monitor stats);
 - **prediction closes**: measured wire bytes == the static cost model's
-  ``predicted_wire_bytes`` (``Program.analyze(sharding=plan)`` comm
-  block) exactly — the plan is the single source of both numbers;
-- **loss parity**: int8-with-error-feedback loss trajectory within
+  ``predicted_wire_bytes`` exactly, in EVERY overlap mode — the plan is
+  the single source of both numbers and the overlap lowering moves the
+  same bytes;
+- **loss parity**: int8-with-error-feedback trajectories (ALL overlap
+  modes — the ring's ascending accumulation keeps numerics) within
   2e-3 of the fp32 baseline after every step;
-- **0 steady-state recompiles** (one XLA compile per run) and
-  ``explain_compiles()`` reports no unexplained executor compiles;
+- **overlap**: median step time with ``overlap="auto"`` is at most
+  1.15x max(compute, comm) estimated from the ``overlap="none"`` run's
+  anatomy (compute = its measured step minus its predicted comm
+  seconds) — at `none` the step pays compute + comm, at `auto` the
+  wire hides behind backward;
+- **exposed-vs-hidden split sanity**: the perf observatory reports
+  hidden == 0 for the ``overlap="none"`` run (structural: the lowering
+  barriers the stage) and a well-formed split for ``auto``;
+- **0 steady-state recompiles** (one XLA compile per knob config),
+  ``explain_compiles()`` reports no unexplained executor compiles, and
+  every grad_comm compile record carries the auditable bucket schedule
+  (size, algorithm, issue point, resolved overlap path);
 - **bucketing + algorithm selection**: the small fuse budget forces
   multiple buckets, and every bucket records a psum/scatter choice.
 
@@ -26,13 +42,15 @@ Usage::
     python tools/comm_smoke.py [--steps 8] [--json] [--verbose]
 
 ``--json`` prints one JSON line (consumed by ``bench.py --suite
-multichip``).  CI treats a non-zero exit as a regression.
+multichip``, which embeds the exposed-vs-hidden split next to the
+wire-byte ratio).  CI treats a non-zero exit as a regression.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -50,12 +68,15 @@ if "xla_force_host_platform_device_count" not in _flags:
 from tools.shard_smoke import _feeds, build_gpt_tiny  # noqa: E402
 
 
-def _train(dtype, steps, verbose=False):
-    """GPT-tiny on mesh {dp: 8} with the given grad_comm wire dtype.
-    Returns a result dict (losses, wire stats, prediction, timing)."""
+def _train(dtype, steps, overlap="auto", verbose=False):
+    """GPT-tiny on mesh {dp: 8} with the given grad_comm wire dtype and
+    overlap mode.  Returns a result dict (losses, wire stats,
+    prediction, per-step timing, perf-observatory comm split)."""
     import paddle_tpu as paddle
     from paddle_tpu import distributed as dist, optimizer
     from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.observability import (disable_perf, enable_perf,
+                                          perf_report)
     from paddle_tpu.utils import monitor
 
     init_mesh({"dp": 8})
@@ -69,26 +90,39 @@ def _train(dtype, steps, verbose=False):
         strategy.fuse_grad_size_in_MB = 0.05
         strategy.grad_comm = {"dtype": dtype, "error_feedback": True,
                               "block_size": 256,
-                              "scatter_threshold_KB": 4.0}
+                              "scatter_threshold_KB": 4.0,
+                              "overlap": overlap}
         f.init(is_collective=True, strategy=strategy)
         opt = f.distributed_optimizer(optimizer.AdamW(learning_rate=1e-3))
         opt.minimize(loss)
     init_mesh({"dp": 8})  # fleet.init infers over ALL devices; pin it
     exe = paddle.static.Executor()
     feed = _feeds("gpt")
+    # fence every step: exposed-vs-hidden needs the device wall, and
+    # this harness reads the fetch per step anyway
+    enable_perf(sample_every=1, memory=False)
     w0 = monitor.get_stat("comm.wire_bytes") or 0
     c0 = monitor.get_stat("comm.collectives") or 0
     losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])]
-    t0 = time.perf_counter()
+    step_s = []
     for _ in range(steps - 1):
+        t0 = time.perf_counter()
         losses.append(float(exe.run(main, feed=feed,
                                     fetch_list=[loss])[0]))
-    dt = time.perf_counter() - t0
+        step_s.append(time.perf_counter() - t0)
     wire = ((monitor.get_stat("comm.wire_bytes") or 0) - w0) / steps
     colls = ((monitor.get_stat("comm.collectives") or 0) - c0) / steps
     plan = exe._plan_for(main, main.parameters())
     rep = main.analyze(fetch_list=[loss], sharding=plan)
     comm = rep.totals["comm"]
+    from paddle_tpu.static.analysis.cost import compile_summary
+    cs = compile_summary(main, sharding=plan)
+    # the executor identity's comm split as the observatory learned it
+    perf = perf_report()
+    split = next((r.get("comm") for r in perf.get("identities", [])
+                  if r["component"] == "executor" and r.get("comm")),
+                 None)
+    disable_perf()
     state = exe._states[main._serial]
     out = {
         "losses": losses,
@@ -97,28 +131,47 @@ def _train(dtype, steps, verbose=False):
         "collectives_per_step": colls,
         "predicted_wire_bytes": comm["wire_bytes_per_step"],
         "predicted_fp32_wire_bytes": comm["fp32_wire_bytes_per_step"],
+        "predicted_comm_s": cs.get("predicted_comm_s", 0.0),
+        "overlap": overlap,
+        "overlap_path": comm.get("overlap_path"),
         "buckets": len(comm["collectives"]),
         "algorithms": sorted({c["algorithm"]
                               for c in comm["collectives"]}),
         "residual_buckets": len(state.aux.get("grad_comm", [])),
-        "steps_per_sec": (steps - 1) / max(dt, 1e-9),
+        "step_ms_median": statistics.median(step_s) * 1e3,
+        # the overlap gate compares MINIMA: on oversubscribed CI hosts
+        # the 8 virtual devices' thread scheduling adds multi-ms noise
+        # to individual steps (measured +-35% between identical runs);
+        # additive noise never makes a step faster, so the min is the
+        # honest estimate of what the schedule costs
+        "step_ms_min": min(step_s) * 1e3,
+        "steps_per_sec": (steps - 1) / max(sum(step_s), 1e-9),
+        "perf_comm": split,
     }
     if verbose:
-        print(f"  {dtype}: losses {['%.4f' % v for v in losses]} "
-              f"wire {wire:.0f}B/step ({out['buckets']} buckets, "
-              f"{out['algorithms']}), {out['steps_per_sec']:.1f} steps/s")
+        print(f"  {dtype}/{overlap}->{out['overlap_path']}: losses "
+              f"{['%.4f' % v for v in losses]} wire {wire:.0f}B/step "
+              f"({out['buckets']} buckets, {out['algorithms']}), "
+              f"step {out['step_ms_median']:.2f} ms")
     exe.close()
     paddle.static.reset_default_programs()
     return out
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--steps", type=int, default=8)
+    ap = argparse.ArgumentParser(
+        description="Gradient-collective smoke gate: quantized grad_comm"
+                    " + compute-collective overlap on multichip GPT.")
+    ap.add_argument("--steps", type=int, default=16,
+                    help="steps per config (>= 2: the first run compiles"
+                         " and is excluded from the step timings)")
     ap.add_argument("--json", action="store_true",
                     help="print one JSON result line on stdout")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
+    if args.steps < 2:
+        ap.error("--steps must be >= 2 (step 1 compiles; the timing "
+                 "gates need at least one steady-state step)")
 
     import paddle_tpu as paddle
     from paddle_tpu.observability import explain_compiles
@@ -126,12 +179,17 @@ def main(argv=None) -> int:
     problems = []
     paddle.enable_static()
     try:
-        fp32 = _train("fp32", args.steps, args.verbose)
-        int8 = _train("int8", args.steps, args.verbose)
+        fp32 = _train("fp32", args.steps, verbose=args.verbose)
+        int8 = _train("int8", args.steps, verbose=args.verbose)
+        none = _train("int8", args.steps, overlap="none",
+                      verbose=args.verbose)
+        ring = _train("int8", args.steps, overlap="ring",
+                      verbose=args.verbose)
     finally:
         paddle.disable_static()
 
-    for name, r in (("fp32", fp32), ("int8", int8)):
+    for name, r in (("fp32", fp32), ("int8", int8),
+                    ("int8/none", none), ("int8/ring", ring)):
         if r["compiles"] != 1:
             problems.append(f"{name}: {r['compiles']} compiles for one "
                             f"feed signature — recompiles after warmup")
@@ -146,21 +204,64 @@ def main(argv=None) -> int:
     if ratio >= 0.35:
         problems.append(f"int8 wire bytes are {ratio:.3f}x of fp32 "
                         f"(gate: < 0.35x)")
-    delta = max(abs(a - b) for a, b in zip(fp32["losses"],
-                                           int8["losses"]))
+    delta = max(abs(a - b) for run in (int8, none, ring)
+                for a, b in zip(fp32["losses"], run["losses"]))
     if delta > 2e-3:
         problems.append(f"int8+error-feedback loss trajectory diverges "
-                        f"{delta:.2e} from fp32 (gate: <= 2e-3)")
+                        f"{delta:.2e} from fp32 (gate: <= 2e-3, all "
+                        f"overlap modes)")
     if int8["buckets"] < 2:
         problems.append("fuse_grad_size_in_MB did not produce multiple "
                         "buckets — bucketing is inert")
     if int8["residual_buckets"] < 1:
         problems.append("error feedback on but no residual carry in the "
                         "donated state")
+
+    # overlap gate: auto approaches max(compute, comm) estimated from
+    # the none run's anatomy (its step = compute + comm by construction)
+    comm_s = none["predicted_comm_s"]
+    none_s = none["step_ms_min"] / 1e3
+    auto_s = int8["step_ms_min"] / 1e3
+    compute_est = max(none_s - comm_s, 0.0)
+    bound_s = 1.15 * max(compute_est, comm_s)
+    if auto_s > bound_s:
+        problems.append(
+            f"overlap=auto step {auto_s * 1e3:.2f} ms exceeds 1.15x "
+            f"max(compute {compute_est * 1e3:.2f}, comm "
+            f"{comm_s * 1e3:.2f}) = {bound_s * 1e3:.2f} ms from the "
+            f"overlap=none anatomy — the wire is not hiding")
+    if none["overlap_path"] != "none":
+        problems.append(f"overlap='none' resolved to path "
+                        f"{none['overlap_path']!r}")
+    if int8["overlap_path"] not in ("xla", "ring"):
+        problems.append(f"overlap='auto' resolved to path "
+                        f"{int8['overlap_path']!r} — no overlap lowering")
+    if ring["overlap_path"] != "ring":
+        problems.append(f"overlap='ring' resolved to path "
+                        f"{ring['overlap_path']!r} — the forced chunked "
+                        f"lowering did not run")
+    ns = none.get("perf_comm")
+    if not ns:
+        problems.append("perf observatory reported no comm split for "
+                        "the overlap=none run")
+    elif ns["hidden_ms"] != 0.0:
+        problems.append(f"overlap=none hidden comm {ns['hidden_ms']} ms "
+                        f"!= 0 — the split must be structural at none")
+    if not int8.get("perf_comm"):
+        problems.append("perf observatory reported no comm split for "
+                        "the overlap=auto run")
+
     ec = explain_compiles("executor")
     unex = ec["by_cause"].get("executor.unexplained", 0)
     if unex:
         problems.append(f"{unex} unexplained executor compile(s)")
+    scheduled = [r for r in ec["records"]
+                 if r.get("comm", {}).get("buckets")]
+    if len(scheduled) < 4:
+        problems.append(f"only {len(scheduled)} executor compile "
+                        f"record(s) carry the grad_comm bucket schedule "
+                        f"(expected 4 — overlap decisions must be "
+                        f"auditable)")
 
     result = {
         "metric": "multichip_gpt_int8_wire_ratio_vs_fp32",
@@ -170,6 +271,19 @@ def main(argv=None) -> int:
         "steps": args.steps,
         "fp32": {k: v for k, v in fp32.items() if k != "losses"},
         "int8": {k: v for k, v in int8.items() if k != "losses"},
+        "int8_overlap_none": {k: v for k, v in none.items()
+                              if k != "losses"},
+        "int8_overlap_ring": {k: v for k, v in ring.items()
+                              if k != "losses"},
+        "overlap_gate": {
+            "auto_step_ms": round(auto_s * 1e3, 3),  # min over steps
+            "none_step_ms": round(none_s * 1e3, 3),
+            "predicted_comm_ms": round(comm_s * 1e3, 6),
+            "bound_ms": round(bound_s * 1e3, 3),
+            "auto_path": int8["overlap_path"],
+            "exposed_hidden_auto": int8.get("perf_comm"),
+            "exposed_hidden_none": none.get("perf_comm"),
+        },
         "ok": not problems,
     }
     if args.json:
@@ -182,10 +296,13 @@ def main(argv=None) -> int:
         print(f"comm_smoke OK: int8 grad_comm wire bytes {ratio:.3f}x "
               f"of fp32 ({int8['wire_bytes_per_step']:.0f} vs "
               f"{fp32['wire_bytes_per_step']:.0f} B/step, predicted "
-              f"exactly), loss parity {delta:.1e} <= 2e-3 with error "
-              f"feedback, {int8['buckets']} buckets "
-              f"{int8['algorithms']}, 1 compile each, all compiles "
-              f"attributed")
+              f"exactly in every overlap mode), loss parity {delta:.1e} "
+              f"<= 2e-3 with error feedback, {int8['buckets']} buckets "
+              f"{int8['algorithms']}, overlap auto->"
+              f"{int8['overlap_path']} step {auto_s * 1e3:.2f} ms <= "
+              f"{bound_s * 1e3:.2f} ms bound (none: "
+              f"{none_s * 1e3:.2f} ms), hidden==0 at none, 1 compile "
+              f"each, schedules on all records")
     return 0
 
 
