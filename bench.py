@@ -697,6 +697,51 @@ def bench_static(args, dev, on_tpu):
             for _ in range(steps):
                 exe2._run_legacy(main2, feed=np_feed, fetch_list=[loss2])
             dt_leg += time.perf_counter() - t0
+
+        # anomaly-sentry counters (ISSUE 15 gate): the fast loop above
+        # ran with the default sentry-less step — time the identical
+        # program with FLAGS_anomaly_sentry compiled IN, interleaved
+        # round-for-round so machine noise hits both, and report the
+        # overhead plus the device-side skipped-step counter (must be
+        # 0 on clean data).  This micro is the sentry's WORST case:
+        # host+tiny-device work dominates, so the per-grad finiteness
+        # scans are visible here while they vanish under real model
+        # math — which is exactly why the number is worth recording.
+        main3, loss3 = build_mlp(7)
+        exe3 = paddle.static.Executor()
+        paddle.set_flags({"anomaly_sentry": True})
+        try:
+            for _ in range(3):
+                last3 = exe3.run(main3, feed=feed, fetch_list=[loss3],
+                                 return_numpy=False)[0]
+            float(np.asarray(last3.data))
+        finally:
+            paddle.set_flags({"anomaly_sentry": False})
+        dt_on = dt_off = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                last = exe.run(main, feed=feed, fetch_list=[loss],
+                               return_numpy=False)[0]
+            float(np.asarray(last.data))
+            dt_off += time.perf_counter() - t0
+            paddle.set_flags({"anomaly_sentry": True})
+            try:
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    last3 = exe3.run(main3, feed=feed,
+                                     fetch_list=[loss3],
+                                     return_numpy=False)[0]
+                float(np.asarray(last3.data))
+                dt_on += time.perf_counter() - t0
+            finally:
+                paddle.set_flags({"anomaly_sentry": False})
+        sentry_block = {
+            "skipped_steps": exe3.sentry_stats(main3)["skipped_steps"],
+            "overhead_pct": round(100.0 * (dt_on / dt_off - 1.0), 2),
+            "step_time_ms_on": round(1e3 * dt_on / (reps * steps), 3),
+            "step_time_ms_off": round(1e3 * dt_off / (reps * steps), 3),
+        }
         steps *= reps
 
         # conv entry: absolute static-path throughput tracking
@@ -752,6 +797,7 @@ def bench_static(args, dev, on_tpu):
         "compile_count": compiles,           # must be 1 (one feed sig)
         "host_feed_converts": converts,      # must be 0 (jax feeds)
         "donated": True,
+        "sentry": sentry_block,              # anomaly sentry (ISSUE 15)
         "analyzer": mlp_pred,                # static cost model (ISSUE 6)
         "config": {"hidden": hidden, "depth": depth, "batch": batch,
                    "optimizer": "adam"},

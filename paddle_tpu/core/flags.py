@@ -238,6 +238,41 @@ define_flag("xla_latency_hiding", False,
             "auto keeps the fused per-bucket collectives there — a "
             "serial backend overlaps nothing; force overlap='ring' to "
             "exercise the chunked lowering on CPU.")
+define_flag("anomaly_sentry", False,
+            "Fuse the data-plane anomaly sentry into the static "
+            "Executor's compiled train step: per-bucket gradient "
+            "finiteness checks + grad-norm stats collapse to one scalar "
+            "anomaly flag (psum'd over the dp axis so every replica "
+            "takes the same branch), and the parameter/optimizer/"
+            "step-counter/error-feedback update is applied through a "
+            "jnp.where select — a flagged step is a bitwise no-op "
+            "instead of a silent weight corruption.  The production "
+            "analog of the reference's FLAGS_check_nan_inf (also "
+            "opt-in), but one reduction per existing bucket view "
+            "instead of per kernel launch: negligible next to real "
+            "model math, measurable on micro-benchmarks (bench.py's "
+            "static suite reports the measured overhead_pct).  "
+            "Supervised production training should run with it on.  "
+            "Flipping it recompiles (the executable either carries the "
+            "sentry or it doesn't; attribution names the flip).")
+define_flag("anomaly_skip_budget", 2,
+            "AnomalyPolicy: consecutive sentry-flagged (skipped) steps "
+            "tolerated before escalating — first past the budget "
+            "quarantines the blamed batch, the next escalates to a "
+            "snapshot rollback.")
+define_flag("anomaly_rollback_budget", 1,
+            "AnomalyPolicy: snapshot rollbacks attempted before the "
+            "policy gives up and raises AnomalyEscalation (handing the "
+            "incarnation to the TrainingSupervisor's restart path).")
+define_flag("anomaly_spike_window", 32,
+            "AnomalyPolicy rolling window (clean steps) for the "
+            "loss-spike detector's median.")
+define_flag("anomaly_spike_factor", 10.0,
+            "AnomalyPolicy: a finite loss above median * factor over "
+            "the rolling window counts as an anomaly (catches finite "
+            "corruption — e.g. a bitflipped wire payload — that the "
+            "non-finite sentry cannot flag).  <= 0 disables the "
+            "spike detector.")
 define_flag("pallas_attention_dropout_min_seqlen", 512,
             "Flash threshold when attention dropout is active: the XLA "
             "path must materialize [B,H,L,L] dropout masks in HBM, so "

@@ -18,6 +18,8 @@ _perf = None        # paddle_tpu.observability.perf.PerfObservatory
                     # when the runtime performance observatory is on
 _heartbeat = None   # paddle_tpu.distributed.supervisor.HeartbeatWriter
                     # when this process runs under a TrainingSupervisor
+_anomaly = None     # paddle_tpu.distributed.anomaly.AnomalyPolicy when
+                    # a data-plane anomaly policy is installed
 
 
 def set_tracer(tracer) -> None:
@@ -54,3 +56,12 @@ def set_heartbeat(hb) -> None:
 
 def current_heartbeat():
     return _heartbeat
+
+
+def set_anomaly_policy(policy) -> None:
+    global _anomaly
+    _anomaly = policy
+
+
+def current_anomaly_policy():
+    return _anomaly

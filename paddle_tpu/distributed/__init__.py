@@ -21,10 +21,14 @@ from .strategy import DistributedStrategy  # noqa: F401
 # `paddle_tpu.distributed.grad_comm` is the quantized/bucketed
 # gradient-collective stage (strategy.grad_comm knobs);
 # `paddle_tpu.distributed.supervisor` is the self-healing layer that
-# keeps a training entrypoint alive (hang watchdog, elastic restart)
+# keeps a training entrypoint alive (hang watchdog, elastic restart);
+# `paddle_tpu.distributed.anomaly` is its data-plane counterpart (the
+# escalation ladder over the in-graph anomaly sentry)
+from . import anomaly  # noqa: F401
 from . import grad_comm  # noqa: F401
 from . import sharding  # noqa: F401
 from . import supervisor  # noqa: F401
+from .anomaly import AnomalyEscalation, AnomalyPolicy  # noqa: F401
 from .sharding import (ShardedState, ShardingPlan,  # noqa: F401
                        SpecLayout, gather_tree, match_partition_rules,
                        plan_for_params, shard_tree, spec_divisor,

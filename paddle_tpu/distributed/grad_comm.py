@@ -673,18 +673,47 @@ def _rs_ag_ring(x, axis_name: str, dp: int):
 
 
 def _reduce_int8_scatter(carry, axis_name: str, dp: int, block: int,
-                         error_feedback: bool, ring: bool = False):
+                         error_feedback: bool, ring: bool = False,
+                         sentry: bool = False, step=None,
+                         bucket_label: str = ""):
     """The two-shot block-scaled int8 reduction.  ``carry`` is the
     residual-corrected local gradient (flat f32).  Returns (reduced sum
-    as f32, per-device residual or None).  ``ring=True`` decomposes
-    both shots into single-chunk ppermutes (same wire bytes, ascending
-    accumulation order) so each step is independently schedulable."""
+    as f32, per-device residual or None, nonfinite-block count or
+    None).  ``ring=True`` decomposes both shots into single-chunk
+    ppermutes (same wire bytes, ascending accumulation order) so each
+    step is independently schedulable.
+
+    ``sentry=True`` is the quantize-time guard: a single non-finite
+    value would otherwise poison its whole block's max-abs scale (the
+    failure class EQuARX's scale handling exists to avoid) AND the
+    error-feedback residual, which then carries the corruption into
+    future steps.  With the sentry on, non-finite values are detected
+    *before* quantization — the count of poisoned blocks feeds the
+    anomaly flag — and masked to zero so the wire payload and the
+    residual stay finite (the flagged step's update is discarded by
+    the sentry select anyway, so masking never changes training
+    numerics).  ``step``/``bucket_label`` feed the in-graph
+    ``grad_comm.wire`` corruption point (testing/fault.py)."""
     n = carry.shape[0]
     np_ = _padded_numel(n, dp * block)
     chunk = np_ // dp
     cb = chunk // block
+    nonfinite_blocks = None
+    if sentry:
+        finite = jnp.isfinite(carry)
+        padded_bad = jnp.pad(~finite, (0, np_ - n))
+        nonfinite_blocks = jnp.sum(
+            jnp.any(padded_bad.reshape(-1, block), axis=1)
+            .astype(jnp.int32))
+        carry = jnp.where(finite, carry, 0.0)
     # shot 1: quantize local, exchange chunks (int8 + scales on wire)
     q, s = quantize_int8_blocks(jnp.pad(carry, (0, np_ - n)), block)
+    if step is not None:
+        from ..testing import fault
+        q = fault.corrupt_in_graph("grad_comm.wire", q, step,
+                                   tensor=f"{bucket_label}.q")
+        s = fault.corrupt_in_graph("grad_comm.wire", s, step,
+                                   tensor=f"{bucket_label}.scales")
     if ring:
         qq = _chunked_all_to_all(q.reshape(dp, cb, block), axis_name, dp)
         ss = _chunked_all_to_all(s.reshape(dp, cb, 1), axis_name, dp)
@@ -696,6 +725,18 @@ def _reduce_int8_scatter(carry, axis_name: str, dp: int, block: int,
         # dequantize per peer, sum in f32: my chunk of the global sum
         red_chunk = jnp.sum(qq.astype(jnp.float32) * ss,
                             axis=0).reshape(-1)
+    wire_nf = None
+    if sentry:
+        # guard the RECEIVED payload too: a corrupted wire value would
+        # otherwise be laundered by the requantize below (NaN absmax
+        # reads as scale 1 and int8-casts to 0 — silently wrong, and
+        # its requantize error would poison the residual forever).
+        # Count it (device-varying chunk -> psum so the flag agrees)
+        # and mask it; the flagged step's update is discarded anyway.
+        bad = ~jnp.isfinite(red_chunk)
+        wire_nf = jax.lax.psum(jnp.sum(bad.astype(jnp.int32)),
+                               axis_name)
+        red_chunk = jnp.where(bad, 0.0, red_chunk)
     # shot 2: requantize the reduced chunk, gather (int8 + scales)
     q2, s2 = quantize_int8_blocks(red_chunk, block)
     if ring:
@@ -707,7 +748,7 @@ def _reduce_int8_scatter(carry, axis_name: str, dp: int, block: int,
     total = dequantize_int8_blocks(qg.reshape(-1, block),
                                    sg.reshape(-1, 1), n)
     if not error_feedback:
-        return total, None
+        return total, None, nonfinite_blocks, wire_nf
     # residual: my local quantize error everywhere, PLUS the requantize
     # error on the chunk I own (I am the only device that knows it; the
     # next step's psum recovers it exactly once)
@@ -716,19 +757,22 @@ def _reduce_int8_scatter(carry, axis_name: str, dp: int, block: int,
     idx = jax.lax.axis_index(axis_name)
     own = jax.lax.dynamic_slice(e1, (idx * chunk,), (chunk,))
     e1 = jax.lax.dynamic_update_slice(e1, own + e2, (idx * chunk,))
-    return total, e1[:n]
+    return total, e1[:n], nonfinite_blocks, wire_nf
 
 
 def _reduce_bucket(flat, residual, axis_name: str, bucket: Bucket,
-                   plan: GradCommPlan, ring: bool = False):
+                   plan: GradCommPlan, ring: bool = False,
+                   sentry: bool = False, step=None,
+                   bucket_label: str = ""):
     """Reduce one flat bucket over the dp axis following the plan.
-    Returns (mean-reduced f32 vector, new residual or None).  ``ring``
-    lowers the bandwidth route as ppermute chunks; latency-bound psum
-    buckets stay one fused psum on every path (chunking a small bucket
-    would multiply its latency, the thing the threshold protects)."""
+    Returns (mean-reduced f32 vector, new residual or None,
+    nonfinite-block count or None).  ``ring`` lowers the bandwidth
+    route as ppermute chunks; latency-bound psum buckets stay one
+    fused psum on every path (chunking a small bucket would multiply
+    its latency, the thing the threshold protects)."""
     dp = plan.dp
     if bucket.algorithm == "none":
-        return flat, residual
+        return flat, residual, None, None
     carry = flat + residual if residual is not None else flat
     wire = bucket.wire_dtype
     rs = _rs_ag_ring if ring else _rs_ag
@@ -739,7 +783,7 @@ def _reduce_bucket(flat, residual, axis_name: str, bucket: Bucket,
         new_res = residual
         if residual is not None:  # fp32 wire is exact: residual drains
             new_res = jnp.zeros_like(residual)
-        return total / dp, new_res
+        return total / dp, new_res, None, None
     if wire == "bf16":
         sent = carry.astype(jnp.bfloat16)
         total = (jax.lax.psum(sent, axis_name)
@@ -748,17 +792,19 @@ def _reduce_bucket(flat, residual, axis_name: str, bucket: Bucket,
         new_res = (carry - sent.astype(jnp.float32)
                    if bucket.carries_residual and residual is not None
                    else None)
-        return total / dp, new_res
-    total, new_res = _reduce_int8_scatter(
+        return total / dp, new_res, None, None
+    total, new_res, nfb, wire_nf = _reduce_int8_scatter(
         carry, axis_name, dp, plan.cfg.block_size,
-        bucket.carries_residual and residual is not None, ring=ring)
-    return total / dp, new_res
+        bucket.carries_residual and residual is not None, ring=ring,
+        sentry=sentry, step=step, bucket_label=bucket_label)
+    return total / dp, new_res, nfb, wire_nf
 
 
 def reduce_gradients(grads: Sequence, *, plan: GradCommPlan,
                      axis_name: str = DP_AXIS,
                      residuals: Optional[Sequence] = None,
-                     mode: Optional[str] = None):
+                     mode: Optional[str] = None,
+                     sentry: bool = False, step=None):
     """Reduce per-shard gradients to their dp-mean following ``plan``.
 
     Must be called INSIDE a ``shard_map`` over ``axis_name``: ``grads``
@@ -778,11 +824,26 @@ def reduce_gradients(grads: Sequence, *, plan: GradCommPlan,
     additionally chunks the bandwidth-route collectives into
     single-chunk ppermute steps any scheduler can interleave.
 
-    Returns ``(reduced grads, new residuals)``; reduced grads come back
-    replicated (every device holds the same mean), in the original
-    order/shape/dtype.  Buckets are emitted in backward production
-    order, each as an independent collective, so bucket N's reduction
-    can overlap the producers of the buckets after it."""
+    ``sentry=True`` additionally returns the in-graph anomaly sentry's
+    per-bucket scan — one reduction per bucket over the already-built
+    flat views, never one per param: ``{"pre": [nb] int32`` (non-finite
+    elements in the *local* pre-reduction grads, psum'd over dp so
+    every replica agrees), ``"post": [nb] int32`` (non-finite in the
+    reduced result — a corrupted wire payload lands here), ``"blocks":
+    int32`` (int8 blocks whose max-abs scale a non-finite value would
+    have poisoned, psum'd; the quantizer masks them — see
+    ``_reduce_int8_scatter``), ``"norm2": f32}`` (sum of squared
+    reduced grads — the global grad-norm stat, and an overflow canary:
+    a finite-but-huge corruption drives it to inf).  ``step`` (the
+    executable's traced step counter) activates the in-graph
+    ``grad_comm.wire`` corruption point for chaos drills.
+
+    Returns ``(reduced grads, new residuals)`` — plus the sentry dict
+    when ``sentry=True``; reduced grads come back replicated (every
+    device holds the same mean), in the original order/shape/dtype.
+    Buckets are emitted in backward production order, each as an
+    independent collective, so bucket N's reduction can overlap the
+    producers of the buckets after it."""
     mode = plan.overlap_path if mode is None else mode
     if mode == "none":
         # all buckets depend on ALL grads: the comm stage cannot start
@@ -790,18 +851,47 @@ def reduce_gradients(grads: Sequence, *, plan: GradCommPlan,
         grads = list(jax.lax.optimization_barrier(tuple(grads)))
     out = list(grads)
     new_res: List = []
+    pre_nf: List = []
+    post_nf: List = []
+    blocks = jnp.asarray(0, jnp.int32) if sentry else None
+    norm2 = jnp.asarray(0.0, jnp.float32) if sentry else None
     ri = 0
-    for bucket in plan.buckets:
+    for bi, bucket in enumerate(plan.buckets):
         res = None
         if residuals is not None and bucket.carries_residual:
             res = residuals[ri]
         flat = flatten_bucket(grads, bucket)
-        red, r2 = _reduce_bucket(flat, res, axis_name, bucket, plan,
-                                 ring=(mode == "ring"))
+        if sentry:
+            pre_nf.append(jnp.sum(
+                (~jnp.isfinite(flat)).astype(jnp.int32)))
+        red, r2, nfb, wire_nf = _reduce_bucket(
+            flat, res, axis_name, bucket, plan, ring=(mode == "ring"),
+            sentry=sentry, step=step, bucket_label=f"bucket.{bi}")
+        if sentry:
+            # the reduced flat is replicated, so one count per bucket
+            # is already mesh-agreed (wire_nf — corruption caught in
+            # the received int8 chunks before the requantize launders
+            # it — arrives already psum'd); pre counts + block counts
+            # are device-varying and psum below
+            post = jnp.sum((~jnp.isfinite(red)).astype(jnp.int32))
+            if wire_nf is not None:
+                post = post + wire_nf
+            post_nf.append(post)
+            norm2 = norm2 + jnp.sum(red * red)
+            if nfb is not None:
+                blocks = blocks + nfb
         if residuals is not None and bucket.carries_residual:
             new_res.append(r2 if r2 is not None
                            else jnp.zeros_like(flat))
             ri += 1
         for i, g in unflatten_bucket(red, bucket, grads):
             out[i] = g
-    return out, new_res
+    if not sentry:
+        return out, new_res
+    info = {
+        "pre": jax.lax.psum(jnp.stack(pre_nf), axis_name),
+        "post": jnp.stack(post_nf),
+        "blocks": jax.lax.psum(blocks, axis_name),
+        "norm2": norm2,
+    }
+    return out, new_res, info

@@ -421,6 +421,27 @@ class TrainingSupervisor:
             import warnings
             warnings.warn(f"supervisor: kill-time flight dump failed: {e}")
 
+    def _dump_giveup_flight(self, attempts: int,
+                            recent_failures: int) -> None:
+        if not self.dump_flight_on_kill:
+            return
+        from ..observability.flight import dump_flight
+        path = os.path.join(self._dir(), "supervisor_giveup.json")
+        try:
+            dump_flight(path, reason="supervisor.give_up", extra={
+                "supervisor": self.name,
+                "attempts": attempts,
+                "recent_failures": recent_failures,
+                "crash_window_s": self.crash_window_s,
+                "crash_budget": self.crash_budget,
+                "max_restarts": self.max_restarts,
+                "exit_history": list(self.exit_history),
+            })
+        except Exception as e:  # noqa: BLE001 - give-up must proceed
+            import warnings
+            warnings.warn(
+                f"supervisor: give-up flight dump failed: {e}")
+
     def _kill(self, proc, reason: str, attempt: int,
               hb: Optional[Heartbeat], deadline: float) -> None:
         """SIGTERM → grace → SIGKILL.  SIGTERM first on purpose: a slow
@@ -583,6 +604,13 @@ class TrainingSupervisor:
                            recent_failures=len(recent))
                 summary = [(r["reason"], r["exit_code"])
                            for r in self.exit_history]
+                # the final black box: a crash-loop give-up is the one
+                # exit that leaves NO incarnation behind to explain
+                # itself (watchdog kills dump per-attempt, but a crash
+                # that exhausts the budget has no kill-time dump) —
+                # annotate a last flight dump with the full exit
+                # history so the post-mortem starts with evidence
+                self._dump_giveup_flight(attempt, len(recent))
                 raise SupervisorGaveUp(
                     f"supervisor '{self.name}' giving up after "
                     f"{attempt} attempt(s): {len(recent)} failure(s) "

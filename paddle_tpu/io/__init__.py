@@ -262,6 +262,20 @@ def default_collate_fn(batch: List[Any]):
     raise TypeError(f"cannot collate type {type(sample)}")
 
 
+def _emit_batch(batch, index: int):
+    """Every DataLoader path funnels emitted batches through here — the
+    ``dataloader.batch`` fault point.  An armed ``action=corrupt`` rule
+    (testing/fault.py) poisons the emitted copy (nan/inf/bitflip) so
+    chaos drills can prove the data-plane anomaly sentry catches a bad
+    batch before it reaches the weights; disarmed, this is one bool
+    check."""
+    from ..testing import fault
+    if fault.is_armed():
+        batch = fault.corrupt_host("dataloader.batch", batch,
+                                   f"batch={index}")
+    return batch
+
+
 class _PrefetchIterator:
     """Background-thread batch producer (double buffering).
 
@@ -317,7 +331,7 @@ class _PrefetchIterator:
                 if self.next_emit in self.out:
                     b = self.out.pop(self.next_emit)
                     self.next_emit += 1
-                    return b
+                    return _emit_batch(b, self.next_emit - 1)
             try:
                 i, batch = self.q.get(timeout=timeout)
             except queue.Empty:
@@ -391,19 +405,43 @@ class DataLoader:
 
     def _iter_sync(self):
         collate = self.collate_fn or default_collate_fn
-        for idxs in self.batch_sampler:
-            yield collate([self.dataset[i] for i in idxs])
+        for i, idxs in enumerate(self.batch_sampler):
+            yield _emit_batch(collate([self.dataset[j] for j in idxs]),
+                              i)
 
     def _iter_iterable(self):
         collate = self.collate_fn or default_collate_fn
         batch = []
+        i = 0
         for sample in self.dataset:
             batch.append(sample)
             if len(batch) == self.batch_size:
-                yield collate(batch)
+                yield _emit_batch(collate(batch), i)
+                i += 1
                 batch = []
         if batch and not self.drop_last:
-            yield collate(batch)
+            yield _emit_batch(collate(batch), i)
+
+    def fetch_batch(self, i: int):
+        """Assemble batch ``i`` of this (map-style, batch-sampled)
+        loader on demand — the **re-delivery** path: after the anomaly
+        sentry skips a corrupted delivery, or a quarantine advances
+        past a blamed batch, the loop re-pulls through the same
+        ``dataloader.batch`` fault/corruption point the iterators use,
+        so a transient corruption clears on retry exactly like the
+        worker batch-retry path.  Note a ``shuffle=True`` sampler is
+        re-drawn per call; deterministic re-delivery wants
+        ``shuffle=False`` or a fixed ``batch_sampler``."""
+        if self.batch_sampler is None:
+            raise TypeError("fetch_batch needs a map-style dataset "
+                            "with a batch sampler")
+        from itertools import islice
+        idxs = next(islice(iter(self.batch_sampler), i, i + 1), None)
+        if idxs is None:
+            raise IndexError(f"fetch_batch({i}): the sampler yields "
+                             f"fewer than {i + 1} batches")
+        collate = self.collate_fn or default_collate_fn
+        return _emit_batch(collate([self.dataset[j] for j in idxs]), i)
 
 
 def get_worker_info():

@@ -586,4 +586,6 @@ class MultiprocessIterator:
         name, spec, metas = self.pending.pop(self.next_emit)
         self.next_emit += 1
         self._fill()
-        return self._tensorize(name, spec, metas)
+        from . import _emit_batch
+        return _emit_batch(self._tensorize(name, spec, metas),
+                           self.next_emit - 1)

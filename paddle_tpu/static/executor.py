@@ -131,7 +131,7 @@ class _ExecState:
     __slots__ = ("serial", "version", "params", "p_arrays", "opt_state",
                  "aux", "t_idx", "escaped", "gen", "lr_value", "lr_device",
                  "seed_val", "base_key", "no_seed", "synced_step",
-                 "gc_key", "__weakref__")
+                 "gc_key", "last_sentry", "__weakref__")
 
     def __init__(self, program, params):
         self.serial = program._serial
@@ -150,6 +150,7 @@ class _ExecState:
         self.no_seed = None
         self.synced_step = None
         self.gc_key = None   # plan fingerprint the residual carry is for
+        self.last_sentry = None  # (run_i, [flag, nf, extra, norm2])
         self._bind_all()
 
     # -- binding -----------------------------------------------------------
@@ -345,6 +346,30 @@ class Executor:
         self._verified.clear()
         self._plans.clear()
 
+    def sentry_stats(self, program=None) -> Optional[dict]:
+        """The anomaly sentry's device-side counters for a program's
+        live state (one sync), or None when no sentry-compiled step has
+        run: ``skipped_steps`` (total sentry-skipped steps, carried in
+        the donated aux tree — maintained with zero per-step host
+        syncs) and the last step's flag/non-finite counts/grad norm."""
+        if program is None:
+            program = default_main_program()
+        state = self._states.get(program._serial)
+        if state is None or state.aux is None \
+                or "skipped" not in state.aux:
+            return None
+        out = {"skipped_steps": int(np.asarray(state.aux["skipped"]))}
+        if state.last_sentry is not None:
+            run_i, (flag, nf, extra, norm2) = state.last_sentry
+            out.update({
+                "last_step": run_i,
+                "last_flag": int(np.asarray(flag)),
+                "last_nonfinite": np.asarray(nf).tolist(),
+                "last_nonfinite_extra": int(np.asarray(extra)),
+                "last_grad_norm": float(np.sqrt(np.asarray(norm2))),
+            })
+        return out
+
     # -- sharding ----------------------------------------------------------
     def _plan_for(self, program, params):
         """ShardingPlan for this program, or None.  A plan exists when
@@ -434,6 +459,18 @@ class Executor:
                     out["aux"] = {
                         "run": np.asarray(state.aux["run"]),
                         "step": np.asarray(state.aux["step"])}
+                    # grad_comm error-feedback residuals ride the
+                    # snapshot so a SAME-mesh rollback replays exactly
+                    # (without them, the replayed quantized steps would
+                    # correct against a later carry).  The restore side
+                    # applies them only when the live carry's shapes
+                    # match — a reshard (the [dp, numel] rows are
+                    # per-OLD-device state) starts from a fresh carry,
+                    # exactly as before.
+                    ef = state.aux.get("grad_comm")
+                    if ef:
+                        out["ef"] = {_key(i): a
+                                     for i, a in enumerate(ef)}
             else:
                 for i, p in enumerate(params):
                     out["params"][_key(i)] = param_array(p)
@@ -499,6 +536,25 @@ class Executor:
                             str(int(k)): {sk: np.asarray(v)
                                           for sk, v in sl.items()}
                             for k, sl in slots.items()}
+                ef = tree.get("ef", {})
+                if ef:
+                    cur = (state.aux.get("grad_comm")
+                           if state.aux is not None else None)
+                    if (cur and len(ef) == len(cur)
+                            and all(tuple(np.asarray(ef[_key(i)]).shape)
+                                    == tuple(a.shape)
+                                    for i, a in enumerate(cur))):
+                        state.aux = dict(state.aux, grad_comm=[
+                            jnp.asarray(ef[_key(i)])
+                            for i in range(len(cur))])
+                    else:
+                        import warnings
+                        warnings.warn(
+                            "sharded checkpoint restore: snapshot "
+                            "carries grad_comm error-feedback "
+                            "residuals that do not match the live "
+                            "carry (mesh or bucket layout changed) — "
+                            "starting from a fresh residual carry")
                 if aux and state.aux is not None:
                     step = int(np.asarray(aux["step"]))
                     run = int(np.asarray(aux.get(
@@ -528,6 +584,15 @@ class Executor:
 
         def specs(name):
             parts = name.split("/")
+            if parts[0] == "ef" and len(parts) >= 2:
+                # error-feedback residuals are [dp, numel] rows, one
+                # per device — sharded over the dp axis by construction
+                plan = self._plan_for(program, program.parameters())
+                if plan is None:
+                    return None
+                from jax.sharding import PartitionSpec
+                from ..distributed.mesh import DP_AXIS
+                return PartitionSpec(DP_AXIS)
             if parts[0] not in ("params", "slots") or len(parts) < 2:
                 return None
             plan = self._plan_for(program, program.parameters())
@@ -648,10 +713,15 @@ class Executor:
         # executable built with the other tier baked in
         from ..ops.pallas.support import tier_enabled
         pallas_on = tier_enabled() and plan is None
+        # the anomaly sentry is baked into the executable (select +
+        # per-bucket scans): flipping FLAGS_anomaly_sentry must
+        # recompile, never reuse a step compiled the other way
+        sentry_on = (bool(get_flag("anomaly_sentry"))
+                     and program._optimizer is not None)
         key = (program._serial, program._version, feed_names,
                tuple((a.shape, str(a.dtype)) for a in feed_arrays),
                tuple(fetch_names), program._optimizer is not None, donate,
-               pallas_on,
+               pallas_on, sentry_on,
                None if plan is None else plan.fingerprint())
         compiled = self._cache.get(key)
         compiled_this_run = compiled is None
@@ -671,7 +741,8 @@ class Executor:
                     self._verified.add(vkey)
             compiled = self._build(program, params, feed_names, fetch_names,
                                    donate, plan=plan,
-                                   feed_arrays=feed_arrays)
+                                   feed_arrays=feed_arrays,
+                                   sentry=sentry_on)
             self._cache[key] = compiled
             if plan is not None:
                 # replacing the mesh while this executable lives would
@@ -727,6 +798,7 @@ class Executor:
                 "optimizer": program._optimizer is not None,
                 "donate": donate,
                 "pallas": pallas_on,
+                "sentry": sentry_on,
             }, predicted=predicted,
                 kernels=getattr(compiled, "_pallas_kernels", None),
                 comm=getattr(compiled, "_comm_record", None))
@@ -784,6 +856,18 @@ class Executor:
                 state.aux = {k: v for k, v in state.aux.items()
                              if k != "grad_comm"}
                 state.gc_key = None
+            # the sentry carries a device-side skipped-step counter in
+            # the donated aux tree (no host sync to maintain it); the
+            # aux structure must match what this executable compiled
+            # against, so add/drop the key on a sentry flip
+            n_sentry = getattr(compiled, "_n_sentry", 0)
+            if n_sentry:
+                if "skipped" not in state.aux:
+                    state.aux = dict(state.aux,
+                                     skipped=jnp.asarray(0, jnp.int32))
+            elif "skipped" in state.aux:
+                state.aux = {k: v for k, v in state.aux.items()
+                             if k != "skipped"}
             opt._step_count += 1
             if state.synced_step != opt._step_count - 1:
                 # the optimizer counter moved outside this loop
@@ -817,6 +901,22 @@ class Executor:
             state.p_arrays = list(new_p)
             state.opt_state = new_s
             state.aux = new_aux
+            # host mirror of the compiled-in corruption schedule (stats
+            # only; the corruption itself already ran in-graph)
+            gc_sites = getattr(compiled, "_graph_corrupts", None)
+            if gc_sites:
+                fault.mirror_graph_fires(gc_sites, run_i)
+            if n_sentry:
+                sentry_vals = fetches[-n_sentry:]
+                fetches = fetches[:-n_sentry]
+                state.last_sentry = (run_i, sentry_vals)
+                pol = obs_hook._anomaly
+                if pol is not None:
+                    # the policy may sync, skip-count, quarantine, roll
+                    # the state back through SnapshotStore, or raise
+                    # AnomalyEscalation (the supervisor-restart rung)
+                    pol.on_step(self, program, run_i, sentry_vals,
+                                fetch_names, fetches)
             # wire-byte accounting: the grad_comm plan's per-step bytes
             # and collective choices are static, so the measured stat is
             # the plan total per dispatched step (predict == measure by
@@ -920,7 +1020,7 @@ class Executor:
 
     def _build_grad_comm(self, params, fetch_names, donate, plan, gplan,
                          feed_arrays, opt, loss_var, t_idx, params_meta,
-                         forward_env):
+                         forward_env, sentry=False):
         """Compile the training step with the explicit gradient-
         communication stage: forward+backward run inside a shard_map
         over dp (params replicated and device-varied, batch feeds
@@ -932,7 +1032,19 @@ class Executor:
         'none', scheduler-split 'xla', or ppermute-chunked 'ring'),
         quantized per the plan, with the per-device error-feedback
         residual carried (and donated) in the aux tree — and the
-        optimizer update runs outside on the replicated mean grads."""
+        optimizer update runs outside on the replicated mean grads.
+
+        ``sentry`` (FLAGS_anomaly_sentry) fuses the data-plane anomaly
+        sentry into the same executable: reduce_gradients scans each
+        bucket's existing flat view for non-finite values (one
+        reduction per bucket, pre- and post-wire, plus the int8
+        quantize-time block guard), the counts collapse to ONE scalar
+        anomaly flag that is psum'd over dp — every replica takes the
+        same branch, so a skip can never diverge or deadlock the mesh
+        — and the param/slot/step-counter/EF-residual update is
+        applied through a jnp.where select: a flagged step is a
+        bitwise no-op on all carried state while donation and the
+        0-recompile contract stay intact."""
         from jax.sharding import PartitionSpec
         from ..core import rng as _rng
         from ..core.jax_compat import pvary, shard_map
@@ -1059,6 +1171,7 @@ class Executor:
                     f"or mean-reduced tensors, or disable grad_comm.")
 
         n_res = len(gplan.residual_buckets)
+        from ..testing import fault as _fault
 
         def train_fn(p_arrays, opt_state, aux, lr, base_key, sflag,
                      rseed, *feed_arrays):
@@ -1088,39 +1201,87 @@ class Executor:
 
                 (loss, env), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(t_var)
+                # chaos hook: pre-reduction grad corruption (identity
+                # unless a corrupt rule is armed at compile time)
+                grads = [_fault.corrupt_in_graph(
+                    "executor.grads", g, run_i, tensor=p.name)
+                    for g, p in zip(grads, params_meta)]
+                res_arg = ([r[0] for r in res_rows]
+                           if res_rows else None)
+                if sentry:
+                    grads, new_res, sinfo = _gc.reduce_gradients(
+                        grads, plan=gplan, axis_name=DP_AXIS,
+                        residuals=res_arg, sentry=True, step=run_i)
+                    # ONE mesh-agreed scalar drives the branch:
+                    # non-finite anywhere (local grads, wire, block
+                    # scales, loss) or an overflowed grad norm.  The
+                    # loss count feeds only the flag — never the
+                    # per-bucket or block-guard stat channels
+                    loss_nf = jax.lax.psum(
+                        (~jnp.isfinite(loss)).astype(jnp.int32),
+                        DP_AXIS)
+                    nf_bucket = sinfo["pre"] + sinfo["post"]
+                    anom = jnp.logical_or(
+                        (jnp.sum(nf_bucket) + sinfo["blocks"]
+                         + loss_nf) > 0,
+                        ~jnp.isfinite(sinfo["norm2"]))
+                    sleaves = (anom.astype(jnp.int32), nf_bucket,
+                               sinfo["blocks"], sinfo["norm2"])
+                else:
+                    grads, new_res = _gc.reduce_gradients(
+                        grads, plan=gplan, axis_name=DP_AXIS,
+                        residuals=res_arg)
+                    sleaves = ()
                 del loss
-                grads, new_res = _gc.reduce_gradients(
-                    grads, plan=gplan, axis_name=DP_AXIS,
-                    residuals=([r[0] for r in res_rows]
-                               if res_rows else None))
                 outs = []
                 for name, rule in zip(fetch_names, fetch_rules):
                     v = env[name]
                     outs.append(jax.lax.pmean(v, DP_AXIS)
                                 if rule == "mean" else v)
                 return (tuple(outs), tuple(grads),
-                        tuple(r[None] for r in new_res))
+                        tuple(r[None] for r in new_res), sleaves)
 
-            fetch_vals, grads, new_res = shard_map(
+            fetch_vals, grads, new_res, sleaves = shard_map(
                 local, mesh=mesh,
                 in_specs=((tuple(P(DP_AXIS) for _ in residuals),)
                           + feed_specs),
                 out_specs=(tuple(P(DP_AXIS) if r == "batch" else P()
                                  for r in fetch_rules),
                            tuple(P() for _ in t_idx),
-                           tuple(P(DP_AXIS) for _ in residuals)),
+                           tuple(P(DP_AXIS) for _ in residuals),
+                           (P(), P(), P(), P()) if sentry else ()),
                 check_vma=False)(residuals, *feed_arrays)
 
             new_t, new_s = opt.functional_update(
                 t_arrays, list(grads), opt_state, lr, step_i,
                 params_meta=params_meta)
+            if sentry:
+                anom_i, nf_bucket, nf_extra, norm2 = sleaves
+                # the select is elementwise, so an un-flagged step is
+                # bit-identical to the sentry-less lowering
+                anom = anom_i > 0
+                ok = jnp.logical_not(anom)
+                new_t = [jnp.where(ok, n, o)
+                         for n, o in zip(new_t, t_arrays)]
+                new_s = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o), new_s, opt_state)
+                new_res = [jnp.where(ok, n, o)
+                           for n, o in zip(new_res, residuals)]
+                step_next = jnp.where(ok, aux["step"] + 1, aux["step"])
             new_p = list(p_arrays)
             for j, a in zip(t_idx, new_t):
                 new_p[j] = a
-            new_aux = {"run": run_i, "step": aux["step"] + 1}
+            new_aux = {"run": run_i,
+                       "step": step_next if sentry else aux["step"] + 1}
+            if sentry:
+                new_aux["skipped"] = (aux["skipped"]
+                                      + anom.astype(jnp.int32))
             if n_res:
                 new_aux["grad_comm"] = list(new_res)
-            return (list(fetch_vals), new_p, new_s, new_aux)
+            fetch_out = list(fetch_vals)
+            if sentry:
+                fetch_out += [anom_i, nf_bucket, nf_extra, norm2]
+            return (fetch_out, new_p, new_s, new_aux)
 
         jit_kw = dict(donate_argnums=(0, 1, 2)) if donate else {}
         p_sh, s_sh, aux_sh, rep, feed_sh, fetch_sh = self._shardings(
@@ -1128,12 +1289,33 @@ class Executor:
         if n_res:
             aux_sh = dict(aux_sh,
                           grad_comm=[plan._ns(P(DP_AXIS))] * n_res)
+        if sentry:
+            aux_sh = dict(aux_sh, skipped=rep)
+            fetch_sh = list(fetch_sh) + [rep] * 4
         jit_kw["in_shardings"] = (p_sh, s_sh, aux_sh, rep, rep, rep,
                                   rep, *feed_sh)
         jit_kw["out_shardings"] = (fetch_sh, p_sh, s_sh, aux_sh)
         compiled = _no_persistent_cache_first_call(
             jax.jit(train_fn, **jit_kw))
         compiled._t_idx = t_idx
+        if sentry:
+            compiled._n_sentry = 4
+            compiled._sentry_buckets = len(gplan.buckets)
+        # in-graph corruption sites with an armed rule at compile time:
+        # the host mirrors their deterministic fire schedule per run so
+        # fault.fired.* stats stay truthful (the graph never calls back)
+        sites = [("executor.grads", p.name) for p in params_meta]
+        if sentry:
+            # the wire corruption point only lowers when the sentry
+            # passes `step` into reduce_gradients — mirroring sites
+            # that never compiled in would report fires that never
+            # happened
+            for i, b in enumerate(gplan.buckets):
+                if b.wire_dtype == "int8":
+                    sites.append(("grad_comm.wire", f"bucket.{i}.q"))
+                    sites.append(("grad_comm.wire",
+                                  f"bucket.{i}.scales"))
+        compiled._graph_corrupts = _fault.graph_corrupt_sites(sites)
         compiled._gc_plan = gplan
         compiled._residual_shapes = [(dp, b.numel)
                                      for b in gplan.residual_buckets]
@@ -1165,7 +1347,7 @@ class Executor:
         return compiled
 
     def _build(self, program: Program, params, feed_names, fetch_names,
-               donate, plan=None, feed_arrays=()):
+               donate, plan=None, feed_arrays=(), sentry=False):
         nodes = list(program.nodes)
         opt_pack = program._optimizer
 
@@ -1268,7 +1450,10 @@ class Executor:
         if gplan is not None:
             return self._build_grad_comm(
                 params, fetch_names, donate, plan, gplan, feed_arrays,
-                opt, loss_var, t_idx, params_meta, forward_env)
+                opt, loss_var, t_idx, params_meta, forward_env,
+                sentry=sentry)
+
+        from ..testing import fault as _fault
 
         def train_fn(p_arrays, opt_state, aux, lr, base_key, sflag, rseed,
                      *feed_arrays):
@@ -1292,16 +1477,53 @@ class Executor:
             t_arrays = [p_arrays[i] for i in t_idx]
             (loss, env), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(t_arrays)
+            # chaos hook: pre-update grad corruption (identity unless a
+            # corrupt rule is armed at compile time)
+            grads = [_fault.corrupt_in_graph(
+                "executor.grads", g, run_i, tensor=p.name)
+                for g, p in zip(grads, params_meta)]
             update = (fused_update if fused_update is not None
                       else opt.functional_update)
             new_t, new_s = update(
                 t_arrays, grads, opt_state, lr, step_i,
                 params_meta=params_meta)
+            new_aux = {"run": run_i, "step": aux["step"] + 1}
+            fetch_out = [env[n] for n in fetch_names]
+            if sentry:
+                # no buckets on this path: the scan is one fused
+                # reduction per gradient (still never per element on
+                # the host), collapsed to the same one-scalar flag +
+                # jnp.where select as the grad_comm lowering.  Under a
+                # GSPMD plan the flag is a global reduction over the
+                # logical arrays, so every device agrees by
+                # construction — mesh-agreed without an explicit psum.
+                loss_nf = (~jnp.isfinite(loss)).astype(jnp.int32)
+                nf = jnp.asarray(0, jnp.int32)
+                norm2 = jnp.asarray(0.0, jnp.float32)
+                for g in grads:
+                    nf = nf + jnp.sum((~jnp.isfinite(g))
+                                      .astype(jnp.int32))
+                    norm2 = norm2 + jnp.sum(
+                        jnp.asarray(g, jnp.float32) ** 2)
+                # the loss count feeds only the flag, never the
+                # gradient nonfinite stat channel
+                anom = jnp.logical_or(nf + loss_nf > 0,
+                                      ~jnp.isfinite(norm2))
+                ok = jnp.logical_not(anom)
+                new_t = [jnp.where(ok, n, o)
+                         for n, o in zip(new_t, t_arrays)]
+                new_s = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o), new_s, opt_state)
+                new_aux["step"] = jnp.where(ok, aux["step"] + 1,
+                                            aux["step"])
+                new_aux["skipped"] = (aux["skipped"]
+                                      + anom.astype(jnp.int32))
+                fetch_out += [anom.astype(jnp.int32), nf[None],
+                              jnp.asarray(0, jnp.int32), norm2]
             new_p = list(p_arrays)
             for j, a in zip(t_idx, new_t):
                 new_p[j] = a
-            new_aux = {"run": run_i, "step": aux["step"] + 1}
-            return ([env[n] for n in fetch_names], new_p, new_s, new_aux)
+            return (fetch_out, new_p, new_s, new_aux)
 
         # donate params, optimizer slots and the aux carry — NOT lr /
         # base_key / seed args (cached and reused across runs) and NOT
@@ -1316,6 +1538,9 @@ class Executor:
             # compiler
             p_sh, s_sh, aux_sh, rep, feed_sh, fetch_sh = self._shardings(
                 plan, params, t_idx, opt, feed_arrays, fetch_names)
+            if sentry:
+                aux_sh = dict(aux_sh, skipped=rep)
+                fetch_sh = list(fetch_sh) + [rep] * 4
             jit_kw["in_shardings"] = (p_sh, s_sh, aux_sh, rep, rep, rep,
                                       rep, *feed_sh)
             jit_kw["out_shardings"] = (fetch_sh, p_sh, s_sh, aux_sh)
@@ -1329,6 +1554,11 @@ class Executor:
 
         compiled._t_idx = t_idx
         compiled._pallas_kernels = realized_kernels
+        if sentry:
+            compiled._n_sentry = 4
+            compiled._sentry_buckets = 1
+        compiled._graph_corrupts = _fault.graph_corrupt_sites(
+            [("executor.grads", p.name) for p in params_meta])
         return compiled
 
     # -- pre-change reference path (bench comparison + oracle) -------------

@@ -738,6 +738,308 @@ def reshard_main(steps=12, save_every=4, kill_after=6, verbose=False,
 
 
 # ---------------------------------------------------------------------------
+# Data-plane anomaly (ISSUE 15): NaN feeds, non-finite grad buckets and a
+# corrupted int8 wire payload -> sentry skip -> quarantine -> rollback
+# ---------------------------------------------------------------------------
+
+# One rule per corruption class, all replayable (host rules via hit
+# accounting, in-graph rules via deterministic run windows baked into
+# the compiled step):
+#  - a NaN batch from the loader (cleared by one skip+re-delivery);
+#  - an inf gradient before reduction (in-graph, run 7);
+#  - a NaN int8 block-scale on the wire (in-graph, run 9);
+#  - a poisoned-feed burst right after the step-8 snapshot: batch 9
+#    keeps flagging past the skip budget (quarantine), batch 10 flags
+#    immediately after (rollback to the snapshot).
+ANOMALY_CHAOS_SPEC = (
+    "dataloader.batch:action=corrupt,mode=nan,count=1,match=batch=2;"
+    "executor.grads:action=corrupt,mode=inf,count=1,after=6;"
+    "grad_comm.wire:action=corrupt,mode=nan,count=1,after=8,"
+    "tensor=*scales*;"
+    "dataloader.batch:action=corrupt,mode=nan,count=3,match=batch=9;"
+    "dataloader.batch:action=corrupt,mode=inf,count=1,match=batch=10")
+
+AN_BATCH = 32          # rows per batch (divisible by dp=8)
+
+
+class AnomalyDataset:
+    """12 deterministic regression batches (module-level so any loader
+    path can pickle it)."""
+
+    def __init__(self, n_batches=12, batch=AN_BATCH, dim=8):
+        rng = np.random.RandomState(13)
+        self.x = rng.standard_normal(
+            (n_batches * batch, dim)).astype(np.float32)
+        self.y = (self.x @ rng.standard_normal((dim, 1))
+                  ).astype(np.float32)
+
+    def __len__(self):
+        return self.x.shape[0]
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _anomaly_build(lr=0.05):
+    """Fleet-sharded static program with int8+error-feedback grad_comm
+    — the configuration whose block scales and residual carry a single
+    NaN would poison without the sentry."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import distributed as dist, optimizer
+
+    paddle.seed(1234)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        pred = paddle.static.nn.fc(x, 8)
+        pred = paddle.static.nn.fc(F.relu(pred), 1)
+        loss = F.mse_loss(pred, y)
+        f = dist.fleet
+        strat = dist.DistributedStrategy()
+        strat.grad_comm = {"dtype": "int8", "error_feedback": True,
+                           "scatter_threshold_KB": 0.01,
+                           "block_size": 64}
+        f.init(is_collective=True, strategy=strat)
+        opt = f.distributed_optimizer(optimizer.Adam(learning_rate=lr))
+        opt.minimize(loss)
+    return main, loss, paddle.static.Executor()
+
+
+def _anomaly_run(loader, exe, main, loss, steps, policy=None,
+                 store=None, objects=None, save_every=4, verbose=False):
+    """The training loop both the reference and chaos runs share:
+    batch ``k`` drives applied step ``k``; the chaos run additionally
+    reacts to the policy's ladder (retry / advance / rewind)."""
+    import numpy as np
+
+    losses = {}
+    applied = cursor = 0
+    while applied < steps:
+        xb, yb = loader.fetch_batch(cursor)
+        if policy is not None:
+            policy.note_batch(cursor)
+        val = float(exe.run(main, feed={"x": np.asarray(xb),
+                                        "y": np.asarray(yb)},
+                            fetch_list=[loss])[0])
+        act = policy.poll() if policy is not None else "ok"
+        if verbose:
+            print(f"  step {applied} batch {cursor}: {act} "
+                  f"loss={val:.6f}")
+        if act == "ok":
+            losses[applied] = val
+            applied += 1
+            cursor += 1
+            if store is not None and applied % save_every == 0 \
+                    and applied < steps:
+                store.save(0, objects, step=applied, kind="step")
+        elif act == "skip":
+            continue                      # re-deliver the same batch
+        elif act == "quarantine":
+            cursor += 1                   # blamed: move past it
+        elif act == "rollback":
+            applied = cursor = policy.resume_step
+    return [losses[s] for s in range(steps)]
+
+
+def anomaly_main(steps=12, save_every=4, verbose=False, workdir=None):
+    """Data-plane fault-tolerance gate; returns 0 on success, 1 on
+    failure.  Under injected NaN feeds, a non-finite gradient bucket,
+    one corrupted int8 wire payload, and a poisoned-feed burst, an
+    int8+error-feedback training run must finish with its applied-step
+    loss trajectory matching the fault-free run — via in-graph sentry
+    skips, one batch quarantine and one snapshot rollback, with zero
+    manual intervention, and every decision auditable from
+    ``anomaly.*`` stats and the rollback flight dump."""
+    import json
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability
+    from paddle_tpu.distributed import AnomalyPolicy
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.testing import fault
+    from paddle_tpu.utils import monitor
+    from paddle_tpu.utils.checkpoint import SnapshotStore
+
+    import jax
+    if len(jax.devices()) < 8:
+        print("FAIL: anomaly scenario needs 8 devices "
+              "(XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+              file=sys.stderr)
+        return 1
+
+    own_tmp = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_anomaly_")
+    loader = DataLoader(AnomalyDataset(), batch_size=AN_BATCH,
+                        shuffle=False)
+    was_static = paddle.in_static_mode() \
+        if hasattr(paddle, "in_static_mode") else False
+    paddle.enable_static()
+    old_sentry = paddle.get_flags("anomaly_sentry")
+    paddle.set_flags({"anomaly_sentry": True})
+    policy = None
+    try:
+        # -- reference: fault-free run, same batch schedule ---------------
+        init_mesh({"dp": 8})
+        main, loss, exe = _anomaly_build()
+        init_mesh({"dp": 8})
+        ref = _anomaly_run(loader, exe, main, loss, steps)
+        ref_params = {k: np.asarray(v).copy() for k, v in
+                      exe.sharded_state(main)._getter()
+                      ["params"].items()}
+        exe.close()
+        paddle.static.reset_default_programs()
+        if verbose:
+            print(f"reference: {ref}")
+
+        # -- chaos run ----------------------------------------------------
+        monitor.stat_reset()
+        flight_path = os.path.join(workdir, "anomaly_flight.json")
+        observability.enable(capacity=4096)
+        observability.install_flight_recorder(path=flight_path,
+                                             catch_sigterm=False)
+        store = SnapshotStore(f"{workdir}/ckpt")
+        # arm BEFORE the build: in-graph corrupt rules are baked into
+        # the compiled step at its (single) compile
+        fault.arm(ANOMALY_CHAOS_SPEC, seed=0)
+        init_mesh({"dp": 8})
+        main, loss, exe = _anomaly_build()
+        init_mesh({"dp": 8})
+        objects = {"train": exe.sharded_state(main)}
+        policy = AnomalyPolicy(store=store, objects=objects,
+                               skip_budget=2, rollback_budget=1,
+                               sync=True).install()
+        try:
+            got = _anomaly_run(loader, exe, main, loss, steps,
+                               policy=policy, store=store,
+                               objects=objects, save_every=save_every,
+                               verbose=verbose)
+        finally:
+            fault.disarm()
+        sentry = exe.sentry_stats(main)
+        compiles = exe.compile_count
+        got_params = {k: np.asarray(v).copy() for k, v in
+                      exe.sharded_state(main)._getter()
+                      ["params"].items()}
+        exe.close()
+        paddle.static.reset_default_programs()
+        if verbose:
+            print(f"chaos:     {got}")
+            print(f"policy:    {policy.result()}")
+            print(f"sentry:    {sentry}")
+
+        # -- gates --------------------------------------------------------
+        problems = []
+        stats = monitor.all_stats()
+        res = policy.result()
+        try:
+            np.testing.assert_allclose(got, ref, rtol=1e-5)
+        except AssertionError as e:
+            problems.append(f"applied-step loss trajectory diverged "
+                            f"from the fault-free run: {e}")
+        if compiles != 1:
+            problems.append(f"sentry/chaos run compiled {compiles}x "
+                            f"(want 1 — no recompiles after warmup)")
+        # the ladder must have exercised every rung exactly as staged
+        if res["skips"] != 5:
+            problems.append(f"anomaly skips={res['skips']}, expected 5 "
+                            f"(NaN feed, inf grads, wire NaN, 2 burst "
+                            f"skips)")
+        if res["quarantines"] != 1 or not res["ledger"] \
+                or res["ledger"][0]["batch"] != 9:
+            problems.append(f"quarantine ledger wrong: "
+                            f"{res['ledger']} (expected batch 9 "
+                            f"blamed once)")
+        if res["rollbacks"] != 1 or res["resume_step"] != 8:
+            problems.append(f"expected 1 rollback to step 8, got "
+                            f"{res['rollbacks']} to "
+                            f"{res['resume_step']}")
+        # ...and be visible in monitor stats
+        for stat, want in (("anomaly.skips", 5),
+                           ("anomaly.quarantines", 1),
+                           ("anomaly.rollbacks", 1)):
+            if stats.get(stat, 0) != want:
+                problems.append(f"{stat}={stats.get(stat, 0)}, "
+                                f"expected {want}")
+        if not stats.get("grad_comm.nonfinite_blocks", 0):
+            problems.append("grad_comm.nonfinite_blocks never counted "
+                            "(quantize-time guard untested)")
+        # every injected corruption actually fired (in-graph points
+        # count one fire per matched tensor site, so >= 1)
+        if stats.get("fault.fired.dataloader.batch", 0) != 5:
+            problems.append(
+                f"fault.fired.dataloader.batch="
+                f"{stats.get('fault.fired.dataloader.batch', 0)}, "
+                f"expected 5")
+        for point in ("fault.fired.executor.grads",
+                      "fault.fired.grad_comm.wire"):
+            if stats.get(point, 0) < 1:
+                problems.append(f"{point} never fired")
+        # device-side skipped counter = every flagged step (5 skips +
+        # the quarantine fire + the rollback fire); it rides the aux
+        # carry as a diagnostic and the restore deliberately keeps it
+        # (like the EF residuals, it is an accumulator, not state)
+        if sentry is None or sentry["skipped_steps"] != 7:
+            problems.append(f"sentry skipped_steps="
+                            f"{None if sentry is None else sentry['skipped_steps']}"
+                            f", expected 7 (one per flagged step)")
+        # final weights match the fault-free run
+        for k in ref_params:
+            if not np.allclose(got_params[k], ref_params[k],
+                               rtol=1e-5, atol=0):
+                problems.append(
+                    f"final param {k} diverged from the fault-free "
+                    f"run (max |d|="
+                    f"{np.abs(got_params[k] - ref_params[k]).max():.3e})")
+        # the rollback must have left an annotated flight dump
+        if not os.path.exists(flight_path):
+            problems.append("rollback left no flight dump")
+        else:
+            with open(flight_path) as f:
+                box = json.load(f)
+            if box.get("reason") != "anomaly.rollback":
+                problems.append(f"flight dump reason "
+                                f"{box.get('reason')!r} != "
+                                f"'anomaly.rollback'")
+            extra = box.get("extra") or {}
+            led = extra.get("ledger") or []
+            if not led or led[0].get("batch") != 9:
+                problems.append(f"flight dump ledger {led} does not "
+                                f"blame batch 9")
+            if extra.get("anomaly", {}).get("resume_step") != 8:
+                problems.append("flight dump lacks the rollback's "
+                                "resume_step annotation")
+        if problems:
+            for p in problems:
+                print(f"FAIL: {p}", file=sys.stderr)
+            return 1
+        print("chaos anomaly OK: NaN feed, inf grad bucket and a "
+              "corrupted int8 wire payload were sentry-skipped "
+              "(bitwise no-ops), the poisoned-feed burst was "
+              "quarantined then rolled back to the step-8 snapshot, "
+              "and the applied-step loss trajectory matches the "
+              "fault-free run with zero manual intervention")
+        return 0
+    finally:
+        if policy is not None:
+            policy.uninstall()
+        paddle.set_flags(old_sentry)
+        from paddle_tpu import observability as _obs
+        _obs.uninstall_flight_recorder()
+        _obs.disable()
+        if not was_static:
+            paddle.disable_static()
+        import paddle_tpu.static as _st
+        _st.reset_default_programs()
+        if own_tmp:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # Supervised self-healing (ISSUE 13): hang -> watchdog kill -> resume;
 # crash -> restart onto a SMALLER mesh via reshard restore
 # ---------------------------------------------------------------------------

@@ -25,12 +25,37 @@ mp.worker_batch:count=1,action=exit,code=43"
   ``exc`` (exception class name, default :class:`FaultInjected`),
   ``msg`` (message override), ``match`` (substring that must appear in
   the point's detail args), ``action`` (``raise`` | ``exit`` |
-  ``sleep``), ``code`` (exit status for ``action=exit``), ``secs``
-  (wedge duration for ``action=sleep`` — the point blocks in
+  ``sleep`` | ``corrupt``), ``code`` (exit status for ``action=exit``),
+  ``secs`` (wedge duration for ``action=sleep`` — the point blocks in
   ``time.sleep`` and then *returns*, so a short ``secs`` is a latency
   injection and a long one is a real hang only a supervisor's watchdog
   can clear), ``respawn`` (1 = keep the rule armed in *respawned*
   DataLoader workers; default 0 = kill-once).
+
+* **Data corruption** (``action=corrupt``) — instead of raising, the
+  point *poisons the payload* flowing through it: ``mode`` picks the
+  corruption (``nan`` | ``inf`` | ``bitflip``), ``n`` how many leading
+  elements are hit (default 1), and ``tensor`` a glob that must match
+  the tensor's label (e.g. ``tensor=*scales*`` corrupts only the int8
+  block scales of the quantized wire payload).  Corruption points come
+  in two kinds:
+
+  - **host points** (``dataloader.batch``) call :func:`corrupt_host`
+    on the emitted numpy/Tensor tree — full ``p``/``count``/``after``/
+    ``match`` semantics, counted as ``fault.fired.<point>``;
+  - **in-graph points** (``executor.grads``, ``grad_comm.wire``) are
+    lowered *into the compiled train step* by :func:`corrupt_in_graph`:
+    the rule's ``after``/``count`` become a step window
+    (``after < step <= after + count``) and ``p`` a per-step Bernoulli
+    draw keyed on the fault seed, selected with ``jnp.where`` — zero
+    host syncs, replayable, 0-recompile after warmup.  The graph is
+    built from the arm state at *compile* time: arm corrupt rules
+    before the first run (arming later does nothing until a
+    recompile), and note that a rule matching several sites (several
+    buckets, q + scales) corrupts each matching site in its window —
+    use ``tensor=`` to single one out.  The host mirrors the
+    deterministic schedule (:func:`mirror_graph_fires`) so
+    ``fault.fired.<point>`` stats stay truthful for in-graph fires.
 
 * The RNG driving ``p`` is seeded (``seed=`` / ``FLAGS_fault_seed``) so
   a chaos run replays exactly.
@@ -51,7 +76,9 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple, Union
 
 __all__ = ["FaultInjected", "Rule", "arm", "disarm", "inject", "is_armed",
-           "point", "fire_count", "spec_for_children", "arm_from_flags"]
+           "point", "fire_count", "spec_for_children", "arm_from_flags",
+           "corrupt_host", "corrupt_in_graph", "corrupt_rules",
+           "mirror_graph_fires"]
 
 
 class FaultInjected(RuntimeError):
@@ -90,10 +117,13 @@ class Rule:
     exc: Union[str, type] = "FaultInjected"
     msg: str = ""
     match: str = ""                  # substring required in detail args
-    action: str = "raise"            # raise | exit | sleep
+    action: str = "raise"            # raise | exit | sleep | corrupt
     code: int = 43                   # exit status for action=exit
     secs: float = 60.0               # wedge duration for action=sleep
     respawn: bool = False            # survive into respawned workers
+    mode: str = "nan"                # corrupt: nan | inf | bitflip
+    n: int = 1                       # corrupt: leading elements poisoned
+    tensor: str = ""                 # corrupt: glob on the tensor label
     hits: int = field(default=0, compare=False)
     fires: int = field(default=0, compare=False)
 
@@ -121,6 +151,12 @@ class Rule:
             kv.append(f"secs={self.secs}")
         if self.respawn:
             kv.append("respawn=1")
+        if self.mode != "nan":
+            kv.append(f"mode={self.mode}")
+        if self.n != 1:
+            kv.append(f"n={self.n}")
+        if self.tensor:
+            kv.append(f"tensor={self.tensor}")
         return self.pattern + (":" + ",".join(kv) if kv else "")
 
 
@@ -143,16 +179,23 @@ def parse_spec(spec: str) -> List[Rule]:
                     kw["prob"] = float(v)
                 elif k == "secs":
                     kw["secs"] = float(v)
-                elif k in ("count", "after", "code"):
+                elif k in ("count", "after", "code", "n"):
                     kw[k] = int(v)
                 elif k == "respawn":
                     kw["respawn"] = v not in ("0", "false", "")
-                elif k in ("exc", "msg", "match", "action"):
+                elif k in ("exc", "msg", "match", "action", "mode",
+                           "tensor"):
                     kw[k] = v
                 else:
                     raise ValueError(f"fault spec: unknown key '{k}' in "
                                      f"'{part}'")
-            rules.append(Rule(pattern.strip(), **kw))
+            rule = Rule(pattern.strip(), **kw)
+            if rule.action == "corrupt" and rule.mode not in (
+                    "nan", "inf", "bitflip"):
+                raise ValueError(f"fault spec: corrupt mode "
+                                 f"'{rule.mode}' in '{part}' (want "
+                                 f"nan | inf | bitflip)")
+            rules.append(rule)
         else:
             rules.append(Rule(part))
     return rules
@@ -220,6 +263,8 @@ def _hit(name: str, detail: Tuple) -> None:
     with _lock:
         rule = None
         for r in _rules:
+            if r.action == "corrupt":
+                continue   # corrupt rules fire only at corruption points
             if not fnmatch.fnmatchcase(name, r.pattern):
                 continue
             if r.match and not any(r.match in str(d) for d in detail):
@@ -295,6 +340,211 @@ def arm_from_flags() -> bool:
     if spec:
         arm(spec, seed=flags.get_flag("fault_seed"))
     return _armed
+
+
+# ---------------------------------------------------------------------------
+# Data corruption (action=corrupt): host trees and in-graph tensors
+# ---------------------------------------------------------------------------
+
+def _corrupt_np(a, mode: str, n: int):
+    """Poison the first ``n`` elements of a numpy array (returns a
+    copy; the caller's array is never mutated)."""
+    import numpy as np
+    a = np.array(a, copy=True)          # C-contiguous copy
+    flat = a.reshape(-1)                # view into the copy
+    k = max(1, min(int(n), flat.shape[0]))
+    if mode in ("nan", "inf") and not np.issubdtype(a.dtype,
+                                                    np.floating):
+        mode = "bitflip"     # int payloads have no NaN — flip bits
+    if mode == "nan":
+        flat[:k] = np.nan
+    elif mode == "inf":
+        flat[:k] = np.inf
+    else:
+        nbits = 8 * a.dtype.itemsize
+        u = flat[:k].view(np.dtype(f"u{a.dtype.itemsize}"))
+        # flip a high bit (exponent territory for floats): the poison
+        # stays finite but lands far outside the healthy value range
+        u ^= np.asarray(1 << (nbits - 2), dtype=u.dtype)
+    return a
+
+
+def corrupt_host(name: str, tree, *detail, tensor: str = ""):
+    """Apply any armed ``action=corrupt`` rule matching ``name`` (and
+    ``tensor``/``match``) to a host-side batch/array tree, honoring the
+    full ``p``/``count``/``after`` hit accounting.  numpy, Tensor, and
+    nested tuple/list/dict leaves are supported; the corrupted tree is
+    a copy — the caller's original arrays are never mutated.  No-op
+    (identity, zero cost beyond one bool check) when disarmed."""
+    if not _armed:
+        return tree
+    with _lock:
+        rule = None
+        for r in _rules:
+            if r.action != "corrupt":
+                continue
+            if not fnmatch.fnmatchcase(name, r.pattern):
+                continue
+            if r.tensor and not fnmatch.fnmatchcase(tensor, r.tensor):
+                continue
+            if r.match and not any(r.match in str(d) for d in detail):
+                continue
+            r.hits += 1
+            if r.hits <= r.after:
+                continue
+            if r.count is not None and r.fires >= r.count:
+                continue
+            if r.prob < 1.0 and _rng.random() >= r.prob:
+                continue
+            r.fires += 1
+            rule = r
+            break
+    if rule is None:
+        return tree
+    from ..utils import monitor
+    monitor.stat_add(f"fault.fired.{name}")
+    from ..core import obs_hook
+    trc = obs_hook._tracer
+    if trc is not None:
+        trc.emit("fault", name,
+                 args={"detail": [str(d) for d in detail],
+                       "action": "corrupt", "mode": rule.mode})
+
+    def walk(x):
+        from ..core.tensor import Tensor
+        import numpy as np
+        if isinstance(x, Tensor):
+            return Tensor(_corrupt_np(np.asarray(x.data), rule.mode,
+                                      rule.n))
+        if isinstance(x, np.ndarray):
+            return _corrupt_np(x, rule.mode, rule.n)
+        if isinstance(x, (list, tuple)):
+            return type(x)(walk(v) for v in x)
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in x.items()}
+        return x
+    return walk(tree)
+
+
+def corrupt_rules(name: str, tensor: str = "") -> List[Rule]:
+    """Armed ``action=corrupt`` rules matching an in-graph corruption
+    site — consulted at trace/compile time (no hit accounting: in-graph
+    rules fire on their deterministic step window instead)."""
+    if not _armed:
+        return []
+    with _lock:
+        return [r for r in _rules
+                if r.action == "corrupt"
+                and fnmatch.fnmatchcase(name, r.pattern)
+                and (not r.tensor
+                     or fnmatch.fnmatchcase(tensor, r.tensor))]
+
+
+def _site_key(name: str, tensor: str, rule: Rule):
+    """Deterministic PRNG key for a (site, rule) pair's p-draws — the
+    in-graph lowering and the host mirror derive the identical key, so
+    probabilistic in-graph fires replay and the mirror never lies."""
+    import zlib
+    import jax
+    base = zlib.crc32(f"{name}|{tensor}|{rule.to_spec()}".encode())
+    return jax.random.PRNGKey((_seed ^ base) & 0x7fffffff)
+
+
+def _window_pred(rule: Rule, step):
+    """In-graph fire predicate of a corrupt rule at a (traced) 1-based
+    step counter: ``after < step <= after + count``, times a Bernoulli
+    draw when ``p < 1`` (``count`` then bounds the window, not the
+    realized fires)."""
+    import jax.numpy as jnp
+    fire = step > rule.after
+    if rule.count is not None:
+        fire = jnp.logical_and(fire, step <= rule.after + rule.count)
+    return fire
+
+
+def corrupt_in_graph(name: str, x, step, tensor: str = ""):
+    """In-graph corruption site: returns ``x``, possibly rewritten to
+    ``jnp.where(fire(step), corrupted(x), x)`` when an armed corrupt
+    rule matches at trace time.  ``step`` is the executable's (traced)
+    1-based step counter.  With nothing armed this is a pure identity —
+    the compiled graph is byte-identical to an un-instrumented one."""
+    rules = corrupt_rules(name, tensor)
+    if not rules:
+        return x
+    import jax
+    import jax.numpy as jnp
+    for rule in rules:
+        fire = _window_pred(rule, step)
+        if rule.prob < 1.0:
+            key = jax.random.fold_in(_site_key(name, tensor, rule),
+                                     step)
+            fire = jnp.logical_and(
+                fire, jax.random.uniform(key) < rule.prob)
+        flat = x.reshape(-1)
+        k = max(1, min(int(rule.n), int(flat.shape[0])))
+        mode = rule.mode
+        if mode in ("nan", "inf") and not jnp.issubdtype(
+                x.dtype, jnp.floating):
+            mode = "bitflip"
+        if mode == "nan":
+            bad = flat.at[:k].set(jnp.nan)
+        elif mode == "inf":
+            bad = flat.at[:k].set(jnp.inf)
+        else:
+            nbits = 8 * x.dtype.itemsize
+            u = jax.lax.bitcast_convert_type(
+                flat[:k], jnp.dtype(f"uint{nbits}"))
+            # flip a high bit: detectable as a huge value / spike even
+            # when the poisoned payload stays finite
+            u = u ^ jnp.asarray(1 << (nbits - 2), u.dtype)
+            bad = flat.at[:k].set(
+                jax.lax.bitcast_convert_type(u, x.dtype))
+        x = jnp.where(fire, bad.reshape(x.shape), x)
+    return x
+
+
+def graph_corrupt_sites(points) -> List[tuple]:
+    """``[(point, tensor_label, rule)]`` for every in-graph site with an
+    armed corrupt rule — computed by the Executor at compile time (the
+    same arm state the trace sees) and attached to the executable so
+    :func:`mirror_graph_fires` can keep host-side fire accounting."""
+    out = []
+    for name, tensor in points:
+        for r in corrupt_rules(name, tensor):
+            out.append((name, tensor, r))
+    return out
+
+
+def mirror_graph_fires(sites, step: int) -> None:
+    """Host mirror of the in-graph fire schedule: for each compiled
+    corruption site, evaluate the identical window/Bernoulli predicate
+    at the (concrete) step and bump ``fault.fired.<point>`` stats +
+    rule fire counts — in-graph fires never touch the host, so this is
+    what keeps ``fire_count()`` and the monitor truthful."""
+    if not sites:
+        return
+    for name, tensor, rule in sites:
+        if step <= rule.after:
+            continue
+        if rule.count is not None and step > rule.after + rule.count:
+            continue
+        if rule.prob < 1.0:
+            import jax
+            key = jax.random.fold_in(_site_key(name, tensor, rule),
+                                     step)
+            if not bool(jax.random.uniform(key) < rule.prob):
+                continue
+        with _lock:
+            rule.fires += 1
+        from ..utils import monitor
+        monitor.stat_add(f"fault.fired.{name}")
+        from ..core import obs_hook
+        trc = obs_hook._tracer
+        if trc is not None:
+            trc.emit("fault", name,
+                     args={"detail": [tensor, f"step={step}"],
+                           "action": "corrupt", "mode": rule.mode,
+                           "in_graph": True})
 
 
 # Environment-armed chaos (FLAGS_fault_spec=... python train.py) must work

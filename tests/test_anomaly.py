@@ -1,0 +1,492 @@
+"""Data-plane fault tolerance (ISSUE 15): the corrupt fault action, the
+in-graph anomaly sentry's mesh-agreed skip, the AnomalyPolicy escalation
+ladder, and the supervisor give-up black box.
+
+Runs on the suite's virtual 8-device CPU mesh (conftest.py)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import distributed as dist, optimizer
+from paddle_tpu.distributed import AnomalyEscalation, AnomalyPolicy
+from paddle_tpu.distributed.mesh import init_mesh
+from paddle_tpu.testing import fault
+from paddle_tpu.utils import monitor
+
+
+@pytest.fixture
+def sentry_on():
+    old = paddle.get_flags("anomaly_sentry")
+    paddle.set_flags({"anomaly_sentry": True})
+    yield
+    paddle.set_flags(old)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    fault.disarm()
+
+
+# ------------------------------------------------------ corrupt action --
+def test_corrupt_spec_parse_and_roundtrip():
+    spec = ("dataloader.batch:action=corrupt,mode=nan,count=1,after=2;"
+            "grad_comm.wire:action=corrupt,mode=inf,n=3,"
+            "tensor=*scales*;"
+            "executor.grads:action=corrupt,mode=bitflip,p=0.5")
+    rules = fault.parse_spec(spec)
+    assert [r.mode for r in rules] == ["nan", "inf", "bitflip"]
+    assert rules[1].n == 3 and rules[1].tensor == "*scales*"
+    again = fault.parse_spec(";".join(r.to_spec() for r in rules))
+    assert [r.to_spec() for r in again] == [r.to_spec() for r in rules]
+    with pytest.raises(ValueError, match="corrupt mode"):
+        fault.parse_spec("x:action=corrupt,mode=zero")
+
+
+def test_corrupt_host_modes_and_accounting():
+    fault.arm("p:action=corrupt,mode=nan,count=1,after=1")
+    src = np.ones(4, np.float32)
+    # after=1: first hit clean, second poisoned, count exhausts
+    assert not np.isnan(fault.corrupt_host("p", src)).any()
+    out = fault.corrupt_host("p", src)
+    assert np.isnan(out[0]) and not np.isnan(out[1:]).any()
+    assert not np.isnan(src).any()          # original never mutated
+    assert not np.isnan(fault.corrupt_host("p", src)).any()
+    assert fault.fire_count("p") == 1
+
+    # inf + n, tree walk, match= on detail
+    fault.arm("p:action=corrupt,mode=inf,n=2,match=batch=3")
+    tree = {"x": np.zeros(4, np.float32), "y": (np.zeros(2, np.float32),)}
+    clean = fault.corrupt_host("p", tree, "batch=1")
+    assert not np.isinf(clean["x"]).any()
+    bad = fault.corrupt_host("p", tree, "batch=3")
+    assert np.isinf(bad["x"][:2]).all() and np.isinf(bad["y"][0]).all()
+
+    # nan on an int array falls back to a (detectable) bitflip
+    fault.arm("p:action=corrupt,mode=nan")
+    iv = fault.corrupt_host("p", np.arange(4, dtype=np.int64))
+    assert iv[0] != 0 and (iv[1:] == [1, 2, 3]).all()
+
+
+def test_corrupt_in_graph_window_and_host_mirror():
+    fault.arm("g:action=corrupt,mode=inf,count=2,after=1,n=2")
+
+    @jax.jit
+    def f(step, x):
+        return fault.corrupt_in_graph("g", x, step, tensor="w")
+
+    fired = [bool(np.isinf(np.asarray(
+        f(jnp.asarray(s, jnp.int32), jnp.ones(4)))).any())
+        for s in range(1, 5)]
+    assert fired == [False, True, True, False]   # window (1, 3]
+    sites = fault.graph_corrupt_sites([("g", "w"), ("g", "other")])
+    assert len(sites) == 2                       # no tensor glob: both
+    n0 = monitor.get_stat("fault.fired.g")
+    for s in range(1, 5):
+        fault.mirror_graph_fires(sites[:1], s)
+    assert monitor.get_stat("fault.fired.g") - n0 == 2
+
+
+def test_corrupt_in_graph_probability_matches_mirror():
+    fault.arm("pp:action=corrupt,mode=nan,p=0.4", seed=11)
+
+    @jax.jit
+    def f(step, x):
+        return fault.corrupt_in_graph("pp", x, step)
+
+    graph = [bool(np.isnan(np.asarray(
+        f(jnp.asarray(s, jnp.int32), jnp.ones(3)))).any())
+        for s in range(1, 21)]
+    sites = fault.graph_corrupt_sites([("pp", "")])
+    host = []
+    for s in range(1, 21):
+        before = fault.fire_count("pp")
+        fault.mirror_graph_fires(sites, s)
+        host.append(fault.fire_count("pp") > before)
+    assert graph == host and any(graph) and not all(graph)
+
+
+# ------------------------------------------------- sentry: plain path --
+def _plain_program(lr=0.1):
+    paddle.seed(7)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 4], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        loss = F.mse_loss(paddle.static.nn.fc(x, 1), y)
+        optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, loss
+
+
+def test_sentry_skip_is_bitwise_noop_plain(sentry_on):
+    paddle.enable_static()
+    try:
+        main, loss = _plain_program()
+        exe = paddle.static.Executor()
+        rng = np.random.RandomState(0)
+        xs = rng.standard_normal((8, 4)).astype(np.float32)
+        ys = rng.standard_normal((8, 1)).astype(np.float32)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        state = exe._states[main._serial]
+        p0 = [np.asarray(a) for a in state.p_arrays]
+        s0 = [{k: np.asarray(v) for k, v in s.items()}
+              for s in state.opt_state]
+        step0 = int(np.asarray(state.aux["step"]))
+        xbad = xs.copy()
+        xbad[0, 0] = np.nan
+        bad = exe.run(main, feed={"x": xbad, "y": ys},
+                      fetch_list=[loss])[0]
+        assert np.isnan(bad)                  # the fetch shows the NaN
+        # ...but every piece of carried state is bitwise untouched
+        assert all(np.array_equal(a, b) for a, b in
+                   zip(p0, (np.asarray(a) for a in state.p_arrays)))
+        for before, after in zip(s0, state.opt_state):
+            for k in before:
+                assert np.array_equal(before[k], np.asarray(after[k]))
+        assert int(np.asarray(state.aux["step"])) == step0
+        st = exe.sentry_stats(main)
+        assert st["skipped_steps"] == 1 and st["last_flag"] == 1
+        assert exe.compile_count == 1         # no recompiles
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_sentry_flip_recompiles_with_attribution():
+    from paddle_tpu.observability import explain_compiles
+    paddle.enable_static()
+    try:
+        main, loss = _plain_program()
+        exe = paddle.static.Executor()
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.standard_normal((8, 4)).astype(np.float32),
+                "y": rng.standard_normal((8, 1)).astype(np.float32)}
+        exe.run(main, feed=feed, fetch_list=[loss])
+        paddle.set_flags({"anomaly_sentry": True})
+        try:
+            exe.run(main, feed=feed, fetch_list=[loss])
+        finally:
+            paddle.set_flags({"anomaly_sentry": False})
+        exe.run(main, feed=feed, fetch_list=[loss])
+        assert exe.compile_count == 2          # flip back = cache hit
+        rec = [r for r in explain_compiles("executor")["records"]
+               if r["cause"] == "new_sentry"]
+        assert rec, "sentry flip not attributed"
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+# -------------------------------------------- sentry: grad_comm path --
+def _int8_program(lr=0.05):
+    paddle.seed(7)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        h = paddle.static.nn.fc(x, 8)
+        loss = F.mse_loss(paddle.static.nn.fc(F.relu(h), 1), y)
+        f = dist.fleet
+        strat = dist.DistributedStrategy()
+        strat.grad_comm = {"dtype": "int8", "error_feedback": True,
+                           "scatter_threshold_KB": 0.01,
+                           "block_size": 64}
+        f.init(is_collective=True, strategy=strat)
+        opt = f.distributed_optimizer(optimizer.Adam(learning_rate=lr))
+        opt.minimize(loss)
+    return main, loss
+
+
+def _int8_feed(rng):
+    xs = rng.standard_normal((64, 8)).astype(np.float32)
+    ys = (xs @ rng.standard_normal((8, 1))).astype(np.float32)
+    return xs, ys
+
+
+def test_sentry_mesh_agreement_one_shard_nan(sentry_on):
+    """One replica's shard carries the NaN; the psum'd flag makes EVERY
+    replica skip, and params stay bitwise identical (and replicated)."""
+    paddle.enable_static()
+    try:
+        init_mesh({"dp": 8})
+        main, loss = _int8_program()
+        init_mesh({"dp": 8})
+        exe = paddle.static.Executor()
+        xs, ys = _int8_feed(np.random.RandomState(1))
+        feed = {"x": xs, "y": ys}
+        for _ in range(2):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        state = exe._states[main._serial]
+        p0 = [np.asarray(a) for a in state.p_arrays]
+        r0 = [np.asarray(a) for a in state.aux["grad_comm"]]
+        step0 = int(np.asarray(state.aux["step"]))
+        # rows 24..31 are shard 3's slice of the dp-sharded batch
+        xbad = xs.copy()
+        xbad[25, :] = np.nan
+        exe.run(main, feed={"x": xbad, "y": ys}, fetch_list=[loss])
+        assert exe.sentry_stats(main)["skipped_steps"] == 1
+        assert all(np.array_equal(a, np.asarray(b))
+                   for a, b in zip(p0, state.p_arrays))
+        assert all(np.array_equal(a, np.asarray(b))
+                   for a, b in zip(r0, state.aux["grad_comm"]))
+        assert int(np.asarray(state.aux["step"])) == step0
+        # params are replicated: every device holds the same buffer
+        for a in state.p_arrays:
+            shards = [np.asarray(s.data) for s in a.addressable_shards]
+            assert all(np.array_equal(shards[0], s) for s in shards[1:])
+        assert exe.compile_count == 1
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_sentry_int8_ef_skip_oracle(sentry_on):
+    """The int8+error-feedback oracle: a skipped step leaves the EF
+    residuals bitwise untouched and the next clean step matches a
+    never-faulted run bitwise."""
+    paddle.enable_static()
+    try:
+        rng = np.random.RandomState(3)
+        b1 = _int8_feed(rng)
+        b2 = _int8_feed(rng)
+        bad = (np.full_like(b1[0], np.nan), b1[1])
+
+        def run_sequence(batches):
+            init_mesh({"dp": 8})
+            main, loss = _int8_program()
+            init_mesh({"dp": 8})
+            exe = paddle.static.Executor()
+            for xs, ys in batches:
+                exe.run(main, feed={"x": xs, "y": ys},
+                        fetch_list=[loss])
+            state = exe._states[main._serial]
+            out = ([np.asarray(a) for a in state.p_arrays],
+                   [np.asarray(a) for a in state.aux["grad_comm"]],
+                   int(np.asarray(state.aux["step"])))
+            exe.close()
+            paddle.static.reset_default_programs()
+            return out
+
+        p_ref, r_ref, step_ref = run_sequence([b1, b2])
+        p_got, r_got, step_got = run_sequence([b1, bad, b2])
+        assert step_got == step_ref == 2
+        assert all(np.array_equal(a, b) for a, b in zip(p_got, p_ref))
+        assert all(np.array_equal(a, b) for a, b in zip(r_got, r_ref))
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_ef_residuals_ride_snapshot_rollback(sentry_on, tmp_path):
+    """Same-mesh rollback restores the error-feedback carry bitwise
+    (reshard restores keep starting from a fresh carry)."""
+    from paddle_tpu.utils.checkpoint import SnapshotStore
+    paddle.enable_static()
+    try:
+        init_mesh({"dp": 8})
+        main, loss = _int8_program()
+        init_mesh({"dp": 8})
+        exe = paddle.static.Executor()
+        xs, ys = _int8_feed(np.random.RandomState(5))
+        feed = {"x": xs, "y": ys}
+        store = SnapshotStore(str(tmp_path / "ckpt"))
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        ss = exe.sharded_state(main)
+        store.save(0, {"train": ss}, step=3, kind="step")
+        state = exe._states[main._serial]
+        r_saved = [np.asarray(a) for a in state.aux["grad_comm"]]
+        for _ in range(2):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        assert any(not np.array_equal(a, np.asarray(b)) for a, b in
+                   zip(r_saved, state.aux["grad_comm"]))
+        store.restore({"train": ss})
+        assert all(np.array_equal(a, np.asarray(b)) for a, b in
+                   zip(r_saved, state.aux["grad_comm"]))
+        assert int(np.asarray(state.aux["step"])) == 3
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+# ------------------------------------------------------ policy ladder --
+def _vals(flag, nf=0, extra=0, norm2=1.0):
+    return (np.asarray(flag, np.int32),
+            np.asarray([nf], np.int32),
+            np.asarray(extra, np.int32),
+            np.asarray(norm2, np.float32))
+
+
+class _StateObj:
+    """state_dict-bearing snapshot object for the policy ladder test."""
+
+    def __init__(self, v):
+        self.v = dict(v)
+
+    def state_dict(self):
+        return dict(self.v)
+
+    def set_state_dict(self, d):
+        self.v = dict(d)
+
+
+def test_policy_ladder_skip_quarantine_rollback_giveup(tmp_path):
+    from paddle_tpu.utils.checkpoint import SnapshotStore
+    monitor.stat_reset()
+    store = SnapshotStore(str(tmp_path / "ckpt"))
+    obj = _StateObj({"w": np.ones(2, np.float32)})
+    store.save(0, {"train": obj}, step=4, kind="step")
+    obj.v["w"] = np.zeros(2, np.float32)       # drifts after the save
+
+    policy = AnomalyPolicy(store=store, objects={"train": obj},
+                           skip_budget=2, rollback_budget=1)
+    policy.note_batch(9)
+    step = [0]
+
+    def feed(flag, **kw):
+        step[0] += 1
+        policy.on_step(None, None, step[0], _vals(flag, **kw),
+                       ("loss",), (np.asarray(0.5),))
+        return policy.poll()
+
+    assert feed(0) == "ok"
+    assert feed(1, nf=3) == "skip"
+    assert feed(1, nf=3) == "skip"
+    assert feed(1, nf=3) == "quarantine"
+    assert policy.ledger[0]["batch"] == 9
+    assert feed(1, nf=1) == "rollback"
+    assert policy.resume_step == 4
+    assert np.array_equal(obj.v["w"], np.ones(2))   # state restored
+    assert policy.data_seed == 1
+    # clean steps reset the ladder
+    assert feed(0) == "ok"
+    # a fresh incident past the (now exhausted) rollback budget: the
+    # ladder runs skip, skip, quarantine, then GIVES UP
+    assert feed(1, nf=1) == "skip"
+    assert feed(1, nf=1) == "skip"
+    assert feed(1, nf=1) == "quarantine"
+    with pytest.raises(AnomalyEscalation) as ei:
+        feed(1, nf=1)
+    assert len(ei.value.ledger) == 2
+    stats = monitor.all_stats()
+    assert stats["anomaly.skips"] == 4
+    assert stats["anomaly.quarantines"] == 2
+    assert stats["anomaly.rollbacks"] == 1
+    assert stats["anomaly.giveups"] == 1
+
+
+def test_policy_rollback_without_snapshot_gives_up(tmp_path):
+    """An empty store must not count a no-op restore as a rollback —
+    replaying onto live (possibly poisoned) weights is a give-up."""
+    from paddle_tpu.utils.checkpoint import SnapshotStore
+    store = SnapshotStore(str(tmp_path / "empty"))
+    obj = _StateObj({"w": np.ones(1, np.float32)})
+    policy = AnomalyPolicy(store=store, objects={"train": obj},
+                           skip_budget=0, rollback_budget=1)
+    policy.on_step(None, None, 1, _vals(1, nf=1), (), ())
+    assert policy.poll() == "quarantine"
+    with pytest.raises(AnomalyEscalation, match="no published snapshot"):
+        policy.on_step(None, None, 2, _vals(1, nf=1), (), ())
+    assert policy.rollbacks == 0
+
+
+def test_policy_deferred_mode_blames_the_step_that_ran(tmp_path):
+    """sync=False judges step N while batch N+1 is already noted: the
+    quarantine must still blame the batch that produced the flags."""
+    policy = AnomalyPolicy(skip_budget=0, sync=False)
+    policy.note_batch("poisoned")
+    policy.on_step(None, None, 1, _vals(1, nf=1), (), ())
+    policy.note_batch("healthy")           # next step already in flight
+    policy.on_step(None, None, 2, _vals(0), (), ())
+    assert policy.poll() == "quarantine"
+    assert policy.ledger[0]["batch"] == "poisoned"
+
+
+def test_policy_loss_spike_detector():
+    monitor.stat_reset()
+    policy = AnomalyPolicy(skip_budget=5, spike_window=8,
+                           spike_factor=10.0)
+    for s in range(6):
+        policy.on_step(None, None, s + 1, _vals(0), ("loss",),
+                       (np.asarray(1.0 + 0.01 * s),))
+        assert policy.poll() == "ok"
+    # a finite-but-huge loss (bitflip-class corruption): flag is clean
+    # but the spike detector escalates anyway
+    policy.on_step(None, None, 7, _vals(0), ("loss",),
+                   (np.asarray(1e6),))
+    assert policy.poll() == "skip"
+    assert monitor.get_stat("anomaly.loss_spikes") == 1
+    assert policy.loss_spikes == 1
+
+
+def test_policy_requires_store_with_objects():
+    with pytest.raises(ValueError, match="store AND objects"):
+        AnomalyPolicy(store=object())
+
+
+# -------------------------------------------- dataloader.batch point --
+def test_dataloader_corrupt_point_and_fetch_batch_redelivery():
+    from paddle_tpu.io import DataLoader
+
+    class DS:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.full(2, float(i), np.float32)
+
+    loader = DataLoader(DS(), batch_size=2, shuffle=False)
+    fault.arm("dataloader.batch:action=corrupt,mode=nan,count=1,"
+              "match=batch=1")
+    batches = [np.asarray(b) for b in loader]
+    assert np.isnan(batches[1]).any() and not np.isnan(batches[0]).any()
+    assert not np.isnan(batches[2]).any()
+    # re-delivery goes through the same point; the rule is exhausted,
+    # so the retry is clean — the skip-retry contract
+    again = np.asarray(loader.fetch_batch(1))
+    assert not np.isnan(again).any()
+    assert np.array_equal(again, np.stack([np.full(2, 2.0),
+                                           np.full(2, 3.0)]))
+    assert fault.fire_count("dataloader.batch") == 1
+
+
+# --------------------------------------------- supervisor black box --
+def test_supervisor_giveup_leaves_flight_dump(tmp_path):
+    from paddle_tpu.distributed.supervisor import (SupervisorGaveUp,
+                                                   TrainingSupervisor)
+    from paddle_tpu.testing.chaos import _sv_flaky_entry
+
+    state = str(tmp_path / "n")
+    sv = TrainingSupervisor(
+        _sv_flaky_entry, args=(state, 99, 5), name="doomed",
+        startup_timeout_s=60.0, poll_s=0.05, backoff_s=0.01,
+        backoff_max_s=0.02, crash_window_s=60.0, crash_budget=1,
+        max_restarts=3, workdir=str(tmp_path))
+    with pytest.raises(SupervisorGaveUp) as ei:
+        sv.run()
+    assert ei.value.exit_history
+    dump = tmp_path / "supervisor_giveup.json"
+    assert dump.exists(), "give-up left no flight dump"
+    box = json.loads(dump.read_text())
+    assert box["reason"] == "supervisor.give_up"
+    extra = box["extra"]
+    assert extra["supervisor"] == "doomed"
+    assert extra["exit_history"] == ei.value.exit_history
+    assert all(r["exit_code"] == 5 for r in extra["exit_history"])
+
+
+# --------------------------------------------------- end-to-end drill --
+def test_chaos_anomaly_scenario_in_process(tmp_path):
+    """The full ISSUE 15 gate: injected NaN feeds, a non-finite grad
+    bucket and a corrupted int8 wire payload end in loss-trajectory
+    parity with the fault-free run, with skip/quarantine/rollback all
+    asserted from anomaly.* stats and the rollback flight dump."""
+    from paddle_tpu.testing import chaos
+    assert chaos.anomaly_main(workdir=str(tmp_path)) == 0

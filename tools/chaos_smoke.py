@@ -35,10 +35,20 @@ Scenarios (``--scenario``, default ``all``):
   fault-free run with zero manual intervention and the kill, restart
   reasons and snapshot resumes are visible in ``supervisor.*`` stats,
   the exit history and the kill-time flight dump.
+- ``anomaly`` — :func:`paddle_tpu.testing.chaos.anomaly_main`: the
+  data-plane counterpart on mesh ``{dp: 8}`` with int8+error-feedback
+  grad_comm: injected NaN batches, a non-finite gradient bucket, one
+  corrupted int8 wire payload and a poisoned-feed burst; fails unless
+  the in-graph anomaly sentry skips every poisoned step as a bitwise
+  no-op, the burst escalates to a batch quarantine and a snapshot
+  rollback, the applied-step loss trajectory ends at parity with the
+  fault-free run with zero manual intervention, and the
+  skips/quarantines/rollbacks are all asserted from ``anomaly.*``
+  stats and the annotated rollback flight dump.
 
 Usage::
 
-    python tools/chaos_smoke.py [--scenario all|training|serving|generation|reshard|supervise]
+    python tools/chaos_smoke.py [--scenario all|training|serving|generation|reshard|supervise|anomaly]
                                 [--epochs 4] [--verbose]
 
 CI treats a non-zero exit as a robustness regression.  The same flows
@@ -60,11 +70,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     ap.add_argument("--scenario", default="all",
                     choices=["all", "training", "serving", "generation",
-                             "reshard", "supervise"])
+                             "reshard", "supervise", "anomaly"])
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
-    if args.scenario in ("reshard", "supervise"):
+    if args.scenario in ("reshard", "supervise", "anomaly"):
         # these drills need a multi-device mesh; set env BEFORE
         # anything initialises jax.  Scoped to these scenarios only —
         # the other drills must keep exercising the host's real device
@@ -87,9 +97,11 @@ def main(argv=None) -> int:
         rc |= chaos.reshard_main(verbose=args.verbose)
     if args.scenario == "supervise":
         rc |= chaos.supervise_main(verbose=args.verbose)
+    if args.scenario == "anomaly":
+        rc |= chaos.anomaly_main(verbose=args.verbose)
     if args.scenario == "all":
         import subprocess
-        for sub_scenario in ("reshard", "supervise"):
+        for sub_scenario in ("reshard", "supervise", "anomaly"):
             sub = [sys.executable, os.path.abspath(__file__),
                    "--scenario", sub_scenario]
             if args.verbose:

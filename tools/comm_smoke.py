@@ -138,6 +138,11 @@ def _train(dtype, steps, overlap="auto", verbose=False):
         "algorithms": sorted({c["algorithm"]
                               for c in comm["collectives"]}),
         "residual_buckets": len(state.aux.get("grad_comm", [])),
+        # anomaly sentry (FLAGS_anomaly_sentry, compiled into the
+        # step): clean training must never skip — a false positive
+        # here would silently stall convergence
+        "sentry_skipped_steps": (exe.sentry_stats(main)
+                                 or {}).get("skipped_steps"),
         "step_ms_median": statistics.median(step_s) * 1e3,
         # the overlap gate compares MINIMA: on oversubscribed CI hosts
         # the 8 virtual devices' thread scheduling adds multi-ms noise
@@ -178,6 +183,11 @@ def main(argv=None) -> int:
 
     problems = []
     paddle.enable_static()
+    # the multichip suite runs as production would: with the anomaly
+    # sentry compiled into every step — the overlap/wire gates then
+    # also prove the sentry costs no recompiles and never false-fires
+    old_sentry = paddle.get_flags("anomaly_sentry")
+    paddle.set_flags({"anomaly_sentry": True})
     try:
         fp32 = _train("fp32", args.steps, verbose=args.verbose)
         int8 = _train("int8", args.steps, verbose=args.verbose)
@@ -186,6 +196,7 @@ def main(argv=None) -> int:
         ring = _train("int8", args.steps, overlap="ring",
                       verbose=args.verbose)
     finally:
+        paddle.set_flags(old_sentry)
         paddle.disable_static()
 
     for name, r in (("fp32", fp32), ("int8", int8),
@@ -199,6 +210,11 @@ def main(argv=None) -> int:
                 f"{r['wire_bytes_per_step']} != predicted "
                 f"{r['predicted_wire_bytes']} — the cost model and the "
                 f"runtime disagree")
+        if r["sentry_skipped_steps"] != 0:
+            problems.append(
+                f"{name}: anomaly sentry skipped "
+                f"{r['sentry_skipped_steps']} step(s) of a CLEAN run "
+                f"(false positive — or the sentry carry is missing)")
     ratio = int8["wire_bytes_per_step"] / max(fp32["wire_bytes_per_step"],
                                               1)
     if ratio >= 0.35:
