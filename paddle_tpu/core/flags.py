@@ -99,6 +99,16 @@ define_flag("static_verify", False,
             "each Program before its first compile, and record file:line "
             "anchors for every op at build time.  Off by default: "
             "verification adds one eval_shape re-trace per op.")
+define_flag("shard_verify", False,
+            "Run the shardcheck SPMD safety passes (static/analysis/"
+            "shardcheck: plan coverage & divisibility, collective "
+            "choreography, device-varying taint, wire-byte audit) once "
+            "per (program, sharding-plan fingerprint) before the first "
+            "sharded compile.  A plan/config the Executor would refuse "
+            "at compile time then fails preflight as a structured "
+            "GraphVerificationError carrying the same cause string.  "
+            "Compile keys are unchanged, so the 0-recompile contract "
+            "holds with the flag on or off.")
 define_flag("static_anchors", False,
             "Record a file:line source anchor on every op "
             "Program.record appends — the cheap subset of "

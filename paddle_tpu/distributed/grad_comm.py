@@ -193,19 +193,30 @@ def source_label(cfg: CommSpec) -> str:
             else f'strategy.grad_comm (dtype="{cfg.dtype}")')
 
 
+def format_mesh_axes(mesh_shape, exclude: Sequence[str] = ()) -> str:
+    """``'mp=2, pp=4'`` — the ONE axis=degree renderer every mesh-shape
+    constraint message goes through (:func:`incompatibility` here,
+    ``strategy.infer_mesh_shape``'s divisibility error, shardcheck
+    diagnostics), so the texts name the offending axis and degree
+    everywhere and cannot drift apart."""
+    return ", ".join(f"{a}={int(s)}" for a, s in dict(mesh_shape).items()
+                     if a not in exclude and int(s) > 1)
+
+
 def incompatibility(cfg: CommSpec, mesh_shape,
                     sharded_params: Sequence[str] = ()) -> Optional[str]:
     """Why the explicit shard_map reduction cannot run on this mesh /
     param layout, or None when it can.  The single source of the
-    constraint messages — SpmdTrainStep, the Executor and the cost
-    model all consult this, so they cannot drift apart."""
+    constraint messages — SpmdTrainStep, the Executor, the cost model
+    and the static shardcheck passes all consult this, so they cannot
+    drift apart."""
     src = source_label(cfg)
-    others = [a for a, s in dict(mesh_shape).items()
-              if a != DP_AXIS and s > 1]
+    others = format_mesh_axes(mesh_shape, exclude=(DP_AXIS,))
     if others:
         return (f"{src} covers the data-parallel grad reduction; mesh "
-                f"axes {others} carry model shardings whose collectives "
-                f"GSPMD schedules — run it on a pure-dp mesh.")
+                f"axes [{others}] carry model shardings whose "
+                f"collectives GSPMD schedules — run it on a pure-dp "
+                f"mesh.")
     sharded = list(sharded_params)
     if sharded:
         return (f"{src} + dp-sharded params (ZeRO-3 / partition rules: "
@@ -235,6 +246,53 @@ def plan_status(plan) -> Tuple[str, Optional[str]]:
     if msg is not None:
         return "error", msg
     return "active", None
+
+
+# ---------------------------------------------------------------------------
+# shared cause strings (Executor raise == shardcheck diagnostic, verbatim)
+# ---------------------------------------------------------------------------
+
+def fetch_rule_message(name: str, global_shape, shard_shape) -> str:
+    """A fetch neither shard-invariant nor batch-major under dp.  The
+    Executor raises this at compile; shardcheck reports it statically —
+    one builder so the two can never disagree about the cause."""
+    return (f"grad_comm: fetch '{name}' (global {tuple(global_shape)}, "
+            f"per-shard {tuple(shard_shape)}) "
+            f"is neither shard-invariant nor batch-major — it "
+            f"cannot be reconstructed from dp shards.  Fetch "
+            f"batch-major or scalar-mean tensors, or disable "
+            f"grad_comm.")
+
+
+def sum_fetch_message(what: str, name: str) -> str:
+    """A SUM-reduced loss/fetch under the dp-mean stage — silently off
+    by 1/dp.  Shared by the Executor's compile-time numeric probe and
+    shardcheck's static reduction classifier."""
+    return (f"grad_comm: {what} '{name}' is SUM-reduced over the "
+            f"batch — the dp-mean reduction this stage applies "
+            f"would silently scale it (and its gradients) by "
+            f"1/dp.  Use a mean reduction, or disable "
+            f"grad_comm for this program.")
+
+
+def overlap_note(cfg: "CommSpec", backend: Optional[str] = None) -> str:
+    """How the ``overlap`` knob resolves on ``backend`` — the runtime
+    lowering (Executor compile record / cost model ``overlap_path``)
+    and shardcheck's static report both print this text."""
+    if backend is None:
+        try:
+            backend = jax.default_backend()
+        except Exception:  # noqa: BLE001 - backend not initialisable
+            backend = "cpu"
+    path = resolve_overlap_path(cfg, backend)
+    if path == cfg.overlap:
+        return (f"grad_comm: overlap={cfg.overlap!r} lowers as "
+                f"requested on backend {backend!r}")
+    why = ("XLA:CPU executes one thunk at a time, so chunking only "
+           "adds rendezvous overhead" if backend == "cpu" else
+           "resolved per the latency-hiding scheduler state")
+    return (f"grad_comm: overlap={cfg.overlap!r} falls back to the "
+            f"{path!r} lowering on backend {backend!r} ({why})")
 
 
 # ---------------------------------------------------------------------------

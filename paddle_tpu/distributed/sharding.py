@@ -288,11 +288,31 @@ def spec_from_json(data) -> PartitionSpec:
     return PartitionSpec(*entries)
 
 
-def _fit_spec_to_mesh(spec: PartitionSpec, shape, mesh: Mesh,
-                      name: str = "") -> PartitionSpec:
+def _fit_spec_to_mesh(spec: PartitionSpec, shape, mesh,
+                      name: str = "", downgrades=None) -> PartitionSpec:
     """Drop spec axes the mesh doesn't carry, and axes whose assigned
     dim isn't divisible by the axis size — the portability rule that
-    lets one rule set run unchanged on mesh sizes {1, 8}."""
+    lets one rule set run unchanged on mesh sizes {1, 8}.
+
+    ``mesh`` is a live ``jax.sharding.Mesh`` OR a plain
+    ``{axis: size}`` dict (the mesh-offline shardcheck path — an
+    abstract mesh needs no devices).  Every dropped axis counts a
+    ``sharding.spec_downgrades`` monitor stat, so a silently-replicated
+    axis is visible in /metrics, not just in a scrollback warning.
+    Pass ``downgrades`` (a list) to collect structured
+    ``(dim, axis, size, reason)`` records instead of issuing
+    ``warnings.warn`` — shardcheck promotes them to Diagnostics."""
+    from ..utils import monitor
+    mesh_shape = dict(mesh) if isinstance(mesh, dict) else dict(mesh.shape)
+
+    def _note(d, a, size, reason):
+        monitor.stat_add("sharding.spec_downgrades")
+        if downgrades is not None:
+            downgrades.append((d, a, size, reason))
+        elif size is not None:  # only the divisibility drop warns (the
+            warnings.warn(reason)  # mesh-absent drop is the portability
+            # contract working as designed, stat-counted but not noisy)
+
     entries = []
     changed = False
     for d, entry in enumerate(tuple(spec)):
@@ -301,17 +321,23 @@ def _fit_spec_to_mesh(spec: PartitionSpec, shape, mesh: Mesh,
                 else [])
         kept = []
         for a in axes:
-            size = mesh.shape.get(a)
+            size = mesh_shape.get(a)
+            dim = int(shape[d]) if d < len(shape) else 0
             if size is None:
                 changed = True
+                _note(d, a, None,
+                      f"sharding: '{name}' dim {d} spec names mesh axis "
+                      f"'{a}' which this mesh does not carry; "
+                      f"replicating that dim instead")
                 continue
-            dim = int(shape[d]) if d < len(shape) else 0
             if size > 1 and dim % size != 0:
                 changed = True
-                warnings.warn(
-                    f"sharding: '{name}' dim {d} ({dim}) is not divisible "
-                    f"by mesh axis '{a}' (size {size}); replicating that "
-                    f"dim instead")
+                _note(d, a, size,
+                      f"sharding: '{name}' dim {d} ({dim}) is not "
+                      f"divisible "
+                      f"by mesh axis '{a}' (size {size}); replicating "
+                      f"that "
+                      f"dim instead")
                 continue
             kept.append(a)
         if not kept:

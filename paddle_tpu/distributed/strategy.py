@@ -263,11 +263,16 @@ class DistributedStrategy:
         model = mp * pp * sp
         if n_devices % model != 0:
             from ..core.enforce import InvalidArgumentError
-            degrees = (f"tensor_parallel_degree={mp} x pp_degree={pp} "
-                       f"x sp_degree={sp} = {model}")
+            from .grad_comm import format_mesh_axes
+            # the shared axis=degree renderer (grad_comm.format_mesh_
+            # axes) names WHICH axis carries which degree, same as the
+            # incompatibility message — the two paths cannot drift
+            axes = format_mesh_axes(
+                {MP_AXIS: mp, PP_AXIS: pp, SP_AXIS: sp}) or "none"
             raise InvalidArgumentError(
                 f"DistributedStrategy: the model-parallel degrees "
-                f"({degrees}) do not divide the device count "
+                f"(mesh axes [{axes}], product {model}) do not divide "
+                f"the device count "
                 f"({n_devices}) — {n_devices % model} device(s) would "
                 f"be silently dropped.  Pick degrees whose product "
                 f"divides {n_devices}, or run on "

@@ -39,6 +39,12 @@ from .passes import (PASS_REGISTRY, AnalysisPass, CrossProgramLeakPass,  # noqa
                      DeadCodePass, Diagnostic, NameCollisionPass,
                      ShapeDtypeConsistencyPass, UseBeforeProducePass,
                      check, default_passes, verify)
+from .shardcheck import (SHARDCHECK_PASS_REGISTRY, AbstractMesh,  # noqa
+                         AbstractPlan, CollectiveChoreographyPass,
+                         DeviceVaryingTaintPass, PlanCoveragePass,
+                         WireByteAuditPass, audit_wire_bytes,
+                         build_abstract_plan, parse_mesh_shape,
+                         shardcheck_passes)
 
 __all__ = [
     "DefUseGraph", "Diagnostic", "AnalysisPass", "UseBeforeProducePass",
@@ -50,4 +56,10 @@ __all__ = [
     "CHIP_SPECS", "MemoryEstimate", "estimate_memory", "aval_bytes",
     "hazard_passes", "HostTransferPass", "WideDtypePass",
     "DonationAliasPass", "HAZARD_PASS_REGISTRY",
+    # SPMD safety tier (ISSUE 16)
+    "AbstractMesh", "AbstractPlan", "build_abstract_plan",
+    "parse_mesh_shape", "audit_wire_bytes", "shardcheck_passes",
+    "PlanCoveragePass", "CollectiveChoreographyPass",
+    "DeviceVaryingTaintPass", "WireByteAuditPass",
+    "SHARDCHECK_PASS_REGISTRY",
 ]

@@ -367,18 +367,38 @@ PASS_REGISTRY = {cls.name: cls for cls in (
 
 
 def check(program: Program, fetch_list: Optional[Sequence] = None,
-          passes: Optional[Sequence[AnalysisPass]] = None
-          ) -> List[Diagnostic]:
+          passes: Optional[Sequence[AnalysisPass]] = None,
+          sharding=None, mesh_shape=None, sharding_rules=None,
+          strategy=None) -> List[Diagnostic]:
     """Run verifier + TPU-readiness hazard passes; return ALL
     diagnostics (errors, warnings, infos) without raising.
     ``fetch_list`` entries may be Variables or names; liveness analysis
     is skipped when no fetch roots are known.  An explicit ``passes``
-    sequence replaces the whole default pipeline."""
+    sequence replaces the whole default pipeline (including any
+    shardcheck passes).
+
+    SPMD safety (shardcheck) runs when a plan is in scope: pass
+    ``sharding=`` a concrete/abstract plan, or ``mesh_shape=`` a plain
+    ``{axis: size}`` dict (optionally with ``sharding_rules=`` /
+    ``strategy=``) to resolve an abstract plan against a mesh you don't
+    have hardware for — zero devices needed."""
     from .hazards import hazard_passes
     graph = DefUseGraph(program)
     out: List[Diagnostic] = []
+    plan = sharding
+    shard_pipeline: List[AnalysisPass] = []
+    if passes is None:
+        if plan is None and mesh_shape is not None:
+            from .shardcheck import build_abstract_plan
+            plan = build_abstract_plan(program, mesh_shape,
+                                       rules=sharding_rules,
+                                       strategy=strategy)
+        if plan is not None:
+            from .shardcheck import shardcheck_passes
+            shard_pipeline = shardcheck_passes(plan)
     pipeline = (passes if passes is not None
-                else list(default_passes()) + hazard_passes())
+                else list(default_passes()) + hazard_passes()
+                + shard_pipeline)
     for p in pipeline:
         out.extend(p.run(graph, fetch_list))
     return out
@@ -386,11 +406,14 @@ def check(program: Program, fetch_list: Optional[Sequence] = None,
 
 def verify(program: Program, fetch_list: Optional[Sequence] = None,
            passes: Optional[Sequence[AnalysisPass]] = None,
-           raise_on_error: bool = True) -> List[Diagnostic]:
+           raise_on_error: bool = True, sharding=None, mesh_shape=None,
+           sharding_rules=None, strategy=None) -> List[Diagnostic]:
     """:func:`check`, raising :class:`GraphVerificationError` when any
     error-severity diagnostic is found.  Returns the diagnostics (the
     warnings, when it does not raise)."""
-    diags = check(program, fetch_list, passes)
+    diags = check(program, fetch_list, passes, sharding=sharding,
+                  mesh_shape=mesh_shape, sharding_rules=sharding_rules,
+                  strategy=strategy)
     errors = [d for d in diags if d.severity == Diagnostic.ERROR]
     if errors and raise_on_error:
         serial = getattr(program, "_serial", None)

@@ -287,6 +287,9 @@ class Executor:
         # live mesh + strategy identity each run — O(1) steady state
         self._plans: Dict[int, tuple] = {}
         self._verified: set = set()  # (serial, version) already checked
+        # FLAGS_shard_verify: (serial, version, plan fingerprint)
+        # triples already shardchecked — once per plan, like _verified
+        self._shard_verified: set = set()
         self._tracked: set = set()   # serials with a finalizer attached
         # legacy (pre-change) path bookkeeping — see _run_legacy
         self._legacy_cache: Dict[tuple, object] = {}
@@ -315,9 +318,9 @@ class Executor:
         self._tracked.add(serial)
         # the closure references the containers, NOT self: the finalizer
         # must not keep the Executor alive
-        states, opt, runs, ver, plans = (
+        states, opt, runs, ver, sver, plans = (
             self._states, self._opt_states, self._run_counts,
-            self._verified, self._plans)
+            self._verified, self._shard_verified, self._plans)
 
         def _evict():
             states.pop(serial, None)
@@ -326,6 +329,8 @@ class Executor:
             plans.pop(serial, None)
             for k in [k for k in ver if k[0] == serial]:
                 ver.discard(k)
+            for k in [k for k in sver if k[0] == serial]:
+                sver.discard(k)
 
         weakref.finalize(program, _evict)
 
@@ -344,6 +349,7 @@ class Executor:
         self._opt_states.clear()
         self._run_counts.clear()
         self._verified.clear()
+        self._shard_verified.clear()
         self._plans.clear()
 
     def sentry_stats(self, program=None) -> Optional[dict]:
@@ -739,6 +745,19 @@ class Executor:
                 if vkey not in self._verified:
                     program.verify(fetch_list=fetch_list)
                     self._verified.add(vkey)
+            if plan is not None and get_flag("shard_verify"):
+                # shardcheck preflight: a plan/config the runtime path
+                # below would refuse (grad_comm incompatibility, sum
+                # fetch, bad spec) fails HERE as a structured
+                # GraphVerificationError with the same cause string —
+                # before any sharded compile.  Keyed per plan
+                # fingerprint; compile keys are untouched, so the
+                # 0-recompile contract holds with the flag on or off.
+                skey = (program._serial, program._version,
+                        plan.fingerprint())
+                if skey not in self._shard_verified:
+                    program.verify(fetch_list=fetch_list, sharding=plan)
+                    self._shard_verified.add(skey)
             compiled = self._build(program, params, feed_names, fetch_names,
                                    donate, plan=plan,
                                    feed_arrays=feed_arrays,
@@ -1090,13 +1109,10 @@ class Executor:
                   == (lo.shape[0] * dp,) + tuple(lo.shape[1:])):
                 fetch_rules.append("batch")
             else:
+                # shared builder: shardcheck's static diagnostic and
+                # this raise print the same cause string
                 raise NotImplementedError(
-                    f"grad_comm: fetch '{name}' (global "
-                    f"{tuple(go.shape)}, per-shard {tuple(lo.shape)}) "
-                    f"is neither shard-invariant nor batch-major — it "
-                    f"cannot be reconstructed from dp shards.  Fetch "
-                    f"batch-major or scalar-mean tensors, or disable "
-                    f"grad_comm.")
+                    _gc.fetch_rule_message(name, go.shape, lo.shape))
 
         # certify the 'mean' classification numerically: a SUM-reduced
         # fetch (or loss) has the same shape as a mean-reduced one, but
@@ -1149,12 +1165,7 @@ class Executor:
                 continue
             what = ("loss" if n == loss_var.name else "fetch")
             if np.abs(g - sum_est).max() <= 1e-3 * scale:
-                raise NotImplementedError(
-                    f"grad_comm: {what} '{n}' is SUM-reduced over the "
-                    f"batch — the dp-mean reduction this stage applies "
-                    f"would silently scale it (and its gradients) by "
-                    f"1/dp.  Use a mean reduction, or disable "
-                    f"grad_comm for this program.")
+                raise NotImplementedError(_gc.sum_fetch_message(what, n))
             if _randomized():
                 import warnings
                 warnings.warn(

@@ -212,16 +212,27 @@ class Program:
         return out_vars[0]
 
     # -- verification (static/analysis) ------------------------------------
-    def verify(self, fetch_list=None, raise_on_error=True):
+    def verify(self, fetch_list=None, raise_on_error=True,
+               sharding=None, mesh_shape=None, sharding_rules=None,
+               strategy=None):
         """Run the compile-time verifier passes over this program
         (static/analysis: def-use ordering, cross-program leaks, name
         collisions, shape/dtype drift, and — when ``fetch_list`` roots
         are given — dead-op/unused-feed liveness).  Raises
         ``core.enforce.GraphVerificationError`` on errors unless
-        ``raise_on_error=False``; returns the Diagnostic list."""
+        ``raise_on_error=False``; returns the Diagnostic list.
+
+        With ``sharding=`` (a ``ShardingPlan`` or ``AbstractPlan``) or
+        ``mesh_shape=`` (a plain ``{axis: size}`` dict, optionally with
+        ``sharding_rules=``/``strategy=``) the SPMD shardcheck passes
+        also run: plan coverage & divisibility, collective
+        choreography, device-varying taint, and the wire-byte audit —
+        all mesh-offline, zero devices needed."""
         from .analysis import verify as _verify
         return _verify(self, fetch_list=fetch_list,
-                       raise_on_error=raise_on_error)
+                       raise_on_error=raise_on_error, sharding=sharding,
+                       mesh_shape=mesh_shape,
+                       sharding_rules=sharding_rules, strategy=strategy)
 
     def analyze(self, fetch_list=None, feed_shapes=None, batch_size=None,
                 chip=None, top_k=5, sharding=None):

@@ -662,3 +662,241 @@ def test_api_checker_flags_variadic_removal():
     # keeping them (or adding them) is NOT a break
     assert cac.compare(spec, spec) == []
     assert cac.compare(current, spec) == []
+
+
+# ------------------- shardcheck: static SPMD safety (ISSUE 16) ------------
+# Every config the Executor rejects at runtime must ALSO be caught
+# statically by shardcheck with the SAME cause string — the static and
+# runtime gates can never disagree.
+
+def _fleet_fc_program(gc=None, zero3=False, reduction="mean",
+                      mesh_shape={"dp": 8}):
+    """fc regression program through fleet.distributed_optimizer, the
+    exact setup the Executor compiles sharded."""
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed.mesh import init_mesh
+    init_mesh(mesh_shape)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        pred = paddle.static.nn.fc(x, 1)
+        loss = F.mse_loss(pred, y, reduction=reduction)
+        s = dist.DistributedStrategy()
+        if gc is not None:
+            s.grad_comm = gc
+        if zero3:
+            s.sharding = True
+            s.sharding_configs = {"stage": 3, "min_shard_numel": 1}
+        f = dist.fleet
+        f.init(is_collective=True, strategy=s)
+        opt = f.distributed_optimizer(optimizer.Adam(learning_rate=1e-2))
+        opt.minimize(loss)
+    init_mesh(mesh_shape)
+    return main, loss
+
+
+def _fc_feed():
+    rng = np.random.RandomState(0)
+    return {"x": rng.standard_normal((64, 8)).astype(np.float32),
+            "y": rng.standard_normal((64, 1)).astype(np.float32)}
+
+
+def _static_errors(main, loss, plan):
+    return [d for d in analysis.check(main, fetch_list=[loss],
+                                      sharding=plan)
+            if d.severity == "error"
+            and d.pass_name.startswith("shard-")]
+
+
+def test_zero3_grad_comm_static_matches_runtime_cause():
+    main, loss = _fleet_fc_program({"dtype": "int8"}, zero3=True)
+    exe = paddle.static.Executor()
+    plan = exe._plan_for(main, main.parameters())
+    errs = _static_errors(main, loss, plan)
+    assert len(errs) == 1 and "dp-sharded" in errs[0].message
+    with pytest.raises(NotImplementedError) as ei:
+        exe.run(main, feed=_fc_feed(), fetch_list=[loss])
+    assert str(ei.value) == errs[0].message  # SAME cause string
+    exe.close()
+
+
+def test_non_pure_dp_mesh_static_matches_runtime_cause():
+    main, loss = _fleet_fc_program({"dtype": "int8"},
+                                   mesh_shape={"dp": 4, "mp": 2})
+    exe = paddle.static.Executor()
+    plan = exe._plan_for(main, main.parameters())
+    errs = _static_errors(main, loss, plan)
+    assert len(errs) == 1
+    # satellite: the shared formatter names the axis AND the degree
+    assert "mp=2" in errs[0].message
+    with pytest.raises(NotImplementedError) as ei:
+        exe.run(main, feed=_fc_feed(), fetch_list=[loss])
+    assert str(ei.value) == errs[0].message
+    exe.close()
+
+
+def test_sum_fetch_static_matches_runtime_cause():
+    main, loss = _fleet_fc_program({"dtype": "int8"}, reduction="sum")
+    exe = paddle.static.Executor()
+    plan = exe._plan_for(main, main.parameters())
+    errs = _static_errors(main, loss, plan)
+    assert len(errs) == 1 and "SUM-reduced" in errs[0].message
+    with pytest.raises(NotImplementedError) as ei:
+        exe.run(main, feed=_fc_feed(), fetch_list=[loss])
+    assert str(ei.value) == errs[0].message
+    exe.close()
+
+
+def test_overlap_cpu_fallback_note_matches_cost_model():
+    """The static overlap INFO and cost._comm_block resolve the knob
+    identically (auto -> 'xla' on CPU, ring stays 'ring')."""
+    from paddle_tpu.static.analysis.cost import _comm_block
+    for overlap, path in (("auto", "xla"), ("ring", "ring")):
+        main, loss = _fleet_fc_program(
+            {"dtype": "int8", "overlap": overlap})
+        exe = paddle.static.Executor()
+        plan = exe._plan_for(main, main.parameters())
+        notes = [d for d in analysis.check(main, fetch_list=[loss],
+                                           sharding=plan)
+                 if d.pass_name == "shard-choreography"
+                 and d.severity == "info" and "overlap=" in d.message]
+        assert len(notes) == 1, notes
+        cb = _comm_block(main, plan)
+        assert cb["overlap_path"] == path
+        assert f"'{path}'" in notes[0].message or \
+            f"overlap={overlap!r} lowers as requested" in notes[0].message
+        exe.close()
+        paddle.static.reset_default_programs()
+
+
+def test_shard_verify_preflight_flag():
+    """FLAGS_shard_verify: the bad config fails preflight as a
+    structured GraphVerificationError carrying the runtime cause; with
+    the flag off, the same config reaches the runtime raise."""
+    main, loss = _fleet_fc_program({"dtype": "int8"}, zero3=True)
+    exe = paddle.static.Executor()
+    paddle.set_flags({"FLAGS_shard_verify": True})
+    try:
+        with pytest.raises(GraphVerificationError, match="dp-sharded"):
+            exe.run(main, feed=_fc_feed(), fetch_list=[loss])
+    finally:
+        paddle.set_flags({"FLAGS_shard_verify": False})
+    with pytest.raises(NotImplementedError, match="dp-sharded"):
+        exe.run(main, feed=_fc_feed(), fetch_list=[loss])
+    exe.close()
+
+
+def test_shard_verify_clean_config_zero_recompiles():
+    """With the flag on, a clean sharded program still compiles ONCE —
+    preflight is keyed per plan fingerprint and never recompiles."""
+    main, loss = _fleet_fc_program({"dtype": "int8"})
+    exe = paddle.static.Executor()
+    paddle.set_flags({"FLAGS_shard_verify": True})
+    try:
+        feed = _fc_feed()
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        assert exe.compile_count == 1
+    finally:
+        paddle.set_flags({"FLAGS_shard_verify": False})
+    exe.close()
+
+
+def test_abstract_mesh_lint_zero_devices():
+    """A {dp:4, mp:2} plan lints with no mesh initialised at all: the
+    pure-dp constraint and a non-divisible rule both surface."""
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.static.analysis import parse_mesh_shape
+    assert parse_mesh_shape("dp=4,mp=2") == {"dp": 4, "mp": 2}
+    assert parse_mesh_shape("8") == {"dp": 8}
+    with pytest.raises(ValueError, match="axis=size"):
+        parse_mesh_shape("dp:4")
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [8, 16], "float32")
+        y = paddle.static.data("y", [8, 1], "float32")
+        pred = paddle.static.nn.fc(x, 1)
+        loss = F.mse_loss(pred, y)
+        optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    strat = dist.DistributedStrategy()
+    strat.grad_comm = {"dtype": "int8"}
+    diags = analysis.check(main, fetch_list=[loss],
+                           mesh_shape={"dp": 4, "mp": 2},
+                           strategy=strat)
+    msgs = [d.message for d in diags
+            if d.pass_name == "shard-choreography"
+            and d.severity == "error"]
+    assert len(msgs) == 1 and "pure-dp" in msgs[0] and "mp=2" in msgs[0]
+    # non-divisible rule -> WARN naming rule and axis (the fc weight
+    # has shape (16, 1): mp=3 divides neither dim)
+    wname = next(p.name for p in main.parameters()
+                 if p.data.shape == (16, 1))
+    diags = analysis.check(
+        main, fetch_list=[loss], mesh_shape={"dp": 2, "mp": 3},
+        sharding_rules=[(wname, (None, "mp")), (r".*", ())])
+    warns = [d for d in diags if d.pass_name == "shard-plan"
+             and d.severity == "warning"]
+    assert len(warns) == 1
+    assert "mesh axis 'mp' (size 3)" in warns[0].message
+    assert f"rule r'{wname}'" in warns[0].message
+
+
+def test_taint_pass_flags_device_varying_fetch_and_resync():
+    """axis_index -> fetch is an error; an all_reduce on the path
+    clears the taint."""
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [8, 4], "float32")
+        y = x * 2.0
+        idx = main.record(lambda a: a, [y], {}, "axis_index")
+        synced = main.record(lambda a: a, [idx], {}, "all_reduce")
+    from paddle_tpu.static.analysis import AbstractMesh, AbstractPlan
+    plan = AbstractPlan(AbstractMesh({"dp": 4}), [], [])
+    from paddle_tpu.static.analysis.shardcheck import DeviceVaryingTaintPass
+    diags = analysis.check(main, fetch_list=[idx],
+                           passes=[DeviceVaryingTaintPass(plan)])
+    assert [d.severity for d in diags] == ["error"]
+    assert "axis_index" in diags[0].message
+    assert analysis.check(main, fetch_list=[synced],
+                          passes=[DeviceVaryingTaintPass(plan)]) == []
+
+
+def test_spec_downgrade_counts_monitor_stat():
+    """Satellite: every _fit_spec_to_mesh downgrade is a monitor stat,
+    not just a scrollback warning."""
+    from jax.sharding import PartitionSpec
+    from paddle_tpu.distributed.sharding import _fit_spec_to_mesh
+    from paddle_tpu.utils import monitor
+    before = monitor.get_stat("sharding.spec_downgrades") or 0
+    # axis absent from the mesh: silent (portability contract), counted
+    got = _fit_spec_to_mesh(PartitionSpec("mp"), (8,), {"dp": 4}, "w")
+    assert got == PartitionSpec()
+    # non-divisible dim: warns AND counts
+    with pytest.warns(UserWarning, match="not divisible"):
+        got = _fit_spec_to_mesh(PartitionSpec("dp"), (6,), {"dp": 4}, "w")
+    assert got == PartitionSpec()
+    after = monitor.get_stat("sharding.spec_downgrades") or 0
+    assert after - before == 2
+
+
+def test_mesh_axis_formatter_is_shared():
+    """Satellite: one formatter renders axis=degree in every
+    mesh-constraint message (incompatibility AND infer_mesh_shape)."""
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed.grad_comm import (format_mesh_axes,
+                                                  incompatibility,
+                                                  resolve)
+    assert format_mesh_axes({"dp": 8, "mp": 2, "pp": 4},
+                            exclude=("dp",)) == "mp=2, pp=4"
+    assert format_mesh_axes({"dp": 8}) == "dp=8"
+    assert format_mesh_axes({"dp": 8, "mp": 1}, exclude=("dp",)) == ""
+    strat = dist.DistributedStrategy()
+    strat.grad_comm = {"dtype": "bf16"}
+    msg = incompatibility(resolve(strat), {"dp": 4, "mp": 2})
+    assert "mp=2" in msg
+    strat2 = dist.DistributedStrategy()
+    strat2.tensor_parallel = True
+    strat2.tensor_parallel_configs = {"tensor_parallel_degree": 3}
+    with pytest.raises(Exception, match=r"mp=3"):
+        strat2.infer_mesh_shape(8)

@@ -12,6 +12,9 @@ Usage:
   python tools/lint_program.py mypkg.model --fetch loss
   python tools/lint_program.py script.py --lint-all --strict
   python tools/lint_program.py script.py --format json   # CI annotation
+  # SPMD shardcheck against an ABSTRACT mesh (zero devices needed):
+  python tools/lint_program.py script.py --mesh-shape dp=4,mp=2 \
+      --sharding-rules '[["w_0$", [null, "mp"]], [".*", []]]'
 
 The module is imported under ``paddle.enable_static()`` with
 ``FLAGS_static_verify`` on (so recorded ops carry file:line anchors); a
@@ -73,6 +76,18 @@ def main(argv=None) -> int:
     ap.add_argument("--format", choices=("text", "json"), default="text",
                     help="'json' prints one machine-readable object "
                          "(for CI annotation) instead of the report")
+    ap.add_argument("--mesh-shape", default="",
+                    help="abstract mesh shape ('dp=4,mp=2' or a bare "
+                         "device count) — runs the SPMD shardcheck "
+                         "passes (plan coverage, collective "
+                         "choreography, device-varying taint, "
+                         "wire-byte audit) against it, no devices "
+                         "needed")
+    ap.add_argument("--sharding-rules", default="",
+                    help="JSON list of [regex, partition-spec] pairs "
+                         "(spec in spec_to_json form, e.g. "
+                         "'[[\"w_0$\", [null, \"mp\"]], [\".*\", []]]') "
+                         "resolved per-param for --mesh-shape linting")
     args = ap.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -91,6 +106,26 @@ def main(argv=None) -> int:
         print(f"error: importing {args.module!r} failed: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
         return 2
+
+    mesh_shape = None
+    sharding_rules = None
+    if args.mesh_shape:
+        from paddle_tpu.static.analysis import parse_mesh_shape
+        try:
+            mesh_shape = parse_mesh_shape(args.mesh_shape)
+        except ValueError as e:
+            print(f"error: --mesh-shape: {e}", file=sys.stderr)
+            return 2
+    if args.sharding_rules:
+        import json as _json
+        from paddle_tpu.distributed.sharding import spec_from_json
+        try:
+            sharding_rules = [(pat, spec_from_json(spec)) for pat, spec
+                              in _json.loads(args.sharding_rules)]
+        except (ValueError, TypeError) as e:
+            print(f"error: --sharding-rules is not a JSON list of "
+                  f"[regex, spec] pairs: {e}", file=sys.stderr)
+            return 2
 
     fetch = [n for n in args.fetch.split(",") if n]
     resolved_somewhere = set()
@@ -122,7 +157,9 @@ def main(argv=None) -> int:
         roots = [f for f in fetch
                  if graph.resolve_fetch(f) is not None]
         resolved_somewhere.update(roots)
-        diags = analysis.check(prog, fetch_list=roots or None)
+        diags = analysis.check(prog, fetch_list=roots or None,
+                               mesh_shape=mesh_shape,
+                               sharding_rules=sharding_rules)
         report["programs"].append({
             "name": nm, "serial": prog._serial, "ops": len(prog.nodes),
             "diagnostics": [d.to_dict() for d in diags]})
