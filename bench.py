@@ -1342,7 +1342,7 @@ def bench_lenet_dygraph(args):
 
 def bench_multichip(args):
     """Multichip GPT-tiny collective-efficiency + overlap run (ISSUE
-    10/14 gates): tools/comm_smoke.py on 8 virtual CPU devices in a
+    10/14/17 gates): tools/comm_smoke.py on 8 virtual CPU devices in a
     subprocess (this process's jax is already initialised with its own
     device count), comparing int8 block-scaled grad_comm against the
     fp32 wire baseline — wire bytes/step (measured == cost-model
@@ -1350,7 +1350,12 @@ def bench_multichip(args):
     recompiles — and overlap=auto against overlap=none: step time vs
     the max(compute, comm) bound, with the perf observatory's
     exposed-vs-hidden comm split embedded next to the wire-byte ratio
-    (result key ``overlap_gate``)."""
+    (result key ``overlap_gate``).  ISSUE 17 adds the hybrid rows: a
+    {dp:4, mp:2} tensor-parallel run with per-axis wire accounting
+    (``hybrid`` key: dp/mp bytes each measured == predicted, plus the
+    forward param-gather ledger) and a ZeRO-3 run with params sharded
+    at rest (``zero3`` key: rscatter buckets + per-shard peak bytes
+    vs the replicated baseline)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
@@ -1371,7 +1376,32 @@ def bench_multichip(args):
     except Exception as e:  # pragma: no cover - defensive
         return {"metric": "multichip_gpt_int8_wire_ratio_vs_fp32",
                 "error": f"{type(e).__name__}: {e}"}
-    res.update({"platform": "cpu", "devices": 8, "mesh": {"dp": 8}})
+    res.update({"platform": "cpu", "devices": 8,
+                "meshes": [{"dp": 8}, {"dp": 4, "mp": 2}]})
+    hyb = res.get("hybrid_dp4_mp2") or {}
+    z3 = res.get("zero3") or {}
+    int8 = res.get("int8") or {}
+    res["hybrid"] = {
+        "mesh": {"dp": 4, "mp": 2},
+        "axis_wire_bytes_per_step": hyb.get("axis_wire_bytes_per_step"),
+        "predicted_axis_wire_bytes":
+            hyb.get("predicted_axis_wire_bytes"),
+        "gather_wire_bytes_per_step":
+            hyb.get("gather_wire_bytes_per_step"),
+        "gather_collectives_per_step":
+            hyb.get("gather_collectives_per_step"),
+        "step_ms_min": hyb.get("step_ms_min"),
+        "compiles": hyb.get("compiles"),
+    }
+    res["zero3_summary"] = {
+        "algorithms": z3.get("algorithms"),
+        "peak_bytes_per_shard": z3.get("peak_bytes_per_shard"),
+        "replicated_peak_bytes_per_shard":
+            int8.get("peak_bytes_per_shard"),
+        "wire_bytes_per_step": z3.get("wire_bytes_per_step"),
+        "step_ms_min": z3.get("step_ms_min"),
+        "compiles": z3.get("compiles"),
+    }
     return res
 
 

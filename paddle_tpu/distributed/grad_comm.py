@@ -79,13 +79,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .mesh import DP_AXIS
+from .mesh import DP_AXIS, MP_AXIS
 
 __all__ = [
     "CommSpec", "Bucket", "GradCommPlan", "resolve", "plan_reduction",
     "build_buckets", "flatten_bucket", "unflatten_bucket",
     "quantize_int8_blocks", "dequantize_int8_blocks", "reduce_gradients",
-    "source_label", "incompatibility", "plan_status",
+    "source_label", "incompatibility", "plan_status", "classify_spec",
+    "hybrid_layout", "plan_gathers", "gather_param", "bucket_flat_numel",
     "resolve_overlap_path", "production_order",
 ]
 
@@ -203,26 +204,100 @@ def format_mesh_axes(mesh_shape, exclude: Sequence[str] = ()) -> str:
                      if a not in exclude and int(s) > 1)
 
 
+def classify_spec(spec, mesh_shape) -> Tuple[str, Optional[int]]:
+    """Which hybrid grad-comm form a param's PartitionSpec takes on
+    this mesh: ``('rep', None)`` replicated, ``('fsdp', 0)`` dp-sharded
+    on dim 0 (ZeRO-3 — gathered over dp ahead of forward, grads
+    reduce-scattered back to shards), ``('mp', dim)`` mp-sharded on one
+    tensor dim (gathered over mp ahead of forward, grads sliced back),
+    or ``('bad', why)`` for layouts the shard_map stage cannot carry
+    (multi-dim / multi-axis shards, dp off dim 0, pp/sp shards)."""
+    shape = dict(mesh_shape)
+    hits = []  # (tensor dim, mesh axes active on it)
+    for d, e in enumerate(tuple(spec) if spec is not None else ()):
+        if e is None:
+            continue
+        axes = [a for a in ((e,) if isinstance(e, str) else tuple(e))
+                if int(shape.get(a, 1)) > 1]
+        if axes:
+            hits.append((d, tuple(axes)))
+    if not hits:
+        return "rep", None
+    if len(hits) > 1:
+        return "bad", "sharded over more than one tensor dimension"
+    d, axes = hits[0]
+    if len(axes) > 1:
+        return "bad", (f"dim {d} sharded over multiple mesh axes "
+                       f"{list(axes)}")
+    ax = axes[0]
+    if ax == DP_AXIS:
+        if d != 0:
+            return "bad", (f"dp-sharded on dim {d} — the FSDP form "
+                           f"shards dim 0 only")
+        return "fsdp", 0
+    if ax == MP_AXIS:
+        return "mp", d
+    return "bad", (f"sharded over mesh axis {ax!r} — only 'dp' (dim 0) "
+                   f"and 'mp' shards compose with grad_comm")
+
+
 def incompatibility(cfg: CommSpec, mesh_shape,
-                    sharded_params: Sequence[str] = ()) -> Optional[str]:
+                    sharded_params: Sequence = (),
+                    hybrid: bool = False) -> Optional[str]:
     """Why the explicit shard_map reduction cannot run on this mesh /
     param layout, or None when it can.  The single source of the
     constraint messages — SpmdTrainStep, the Executor, the cost model
     and the static shardcheck passes all consult this, so they cannot
-    drift apart."""
+    drift apart.
+
+    Two lowerings share this predicate.  ``hybrid=False`` is the
+    restricted SpmdTrainStep form (params closed over replicated; any
+    non-dp mesh axis or sharded param is rejected; ``sharded_params``
+    is a sequence of names).  ``hybrid=True`` is the static Executor's
+    composed form: 'mp' mesh axes and FSDP/'mp' param shards are
+    first-class (params enter the shard_map per their spec and are
+    all-gathered ahead of forward; FSDP grads reduce-scatter back to
+    shards), so only pp/sp axes and spec shapes outside the two
+    supported forms (see :func:`classify_spec`) reject —
+    ``sharded_params`` is then ``(name, spec)`` pairs."""
     src = source_label(cfg)
-    others = format_mesh_axes(mesh_shape, exclude=(DP_AXIS,))
+    if not hybrid:
+        others = format_mesh_axes(mesh_shape, exclude=(DP_AXIS,))
+        if others:
+            return (f"{src} covers the data-parallel grad reduction; "
+                    f"mesh axes [{others}] carry model shardings whose "
+                    f"collectives GSPMD schedules — run it on a "
+                    f"pure-dp mesh, or use the static Executor, whose "
+                    f"grad_comm stage composes dp with 'mp' and FSDP "
+                    f"shards.")
+        sharded = list(sharded_params)
+        if sharded:
+            return (f"{src} + dp-sharded params (ZeRO-3 / partition "
+                    f"rules: {sharded[:4]}): the explicit shard_map "
+                    f"grad path would replicate them.  Keep params "
+                    f"replicated (ZeRO stage <= 2) with it, or use the "
+                    f"static Executor, which gathers FSDP shards ahead "
+                    f"of forward and reduce-scatters grads back.")
+        return None
+    others = format_mesh_axes(mesh_shape, exclude=(DP_AXIS, MP_AXIS))
     if others:
-        return (f"{src} covers the data-parallel grad reduction; mesh "
-                f"axes [{others}] carry model shardings whose "
-                f"collectives GSPMD schedules — run it on a pure-dp "
-                f"mesh.")
-    sharded = list(sharded_params)
-    if sharded:
-        return (f"{src} + dp-sharded params (ZeRO-3 / partition rules: "
-                f"{sharded[:4]}): the explicit shard_map grad path "
-                f"would replicate them.  Keep params replicated (ZeRO "
-                f"stage <= 2) with it.")
+        return (f"{src} composes the data-parallel grad reduction "
+                f"with tensor-parallel 'mp' param gathers; mesh axes "
+                f"[{others}] schedule cross-stage collectives "
+                f"(pipeline/sequence parallel) this shard_map stage "
+                f"cannot carry — drop those axes from the mesh or "
+                f"disable grad_comm.")
+    bad = []
+    for name, spec in sharded_params:
+        kind, why = classify_spec(spec, mesh_shape)
+        if kind == "bad":
+            bad.append(f"{name} ({why})")
+    if bad:
+        return (f"{src} carries dp-sharded (ZeRO-3, dim 0) and "
+                f"mp-sharded param layouts; these param specs fit "
+                f"neither form: {bad[:4]}.  Re-shard them via "
+                f"partition rules / tp placements, or disable "
+                f"grad_comm.")
     return None
 
 
@@ -233,16 +308,19 @@ def plan_status(plan) -> Tuple[str, Optional[str]]:
     ``('error', msg)`` — configured but impossible (the Executor raises
     ``msg``; the cost model reports it).  Executor and cost model share
     this predicate so measured and predicted can never disagree about
-    WHICH path runs."""
+    WHICH path runs.  Uses the HYBRID compatibility form: {dp, mp}
+    meshes and FSDP / mp-sharded params are accepted (the Executor
+    gathers them ahead of forward), pp/sp axes and unsupported spec
+    shapes reject."""
     cfg = getattr(plan, "grad_comm", None)
     if cfg is None:
         return "off", None
     if dict(plan.mesh.shape).get(DP_AXIS, 1) <= 1:
         return "off", None
     from .sharding import spec_axes
-    sharded = [n for n, s in zip(plan.param_names, plan.param_specs)
+    sharded = [(n, s) for n, s in zip(plan.param_names, plan.param_specs)
                if spec_axes(s)]
-    msg = incompatibility(cfg, plan.mesh.shape, sharded)
+    msg = incompatibility(cfg, plan.mesh.shape, sharded, hybrid=True)
     if msg is not None:
         return "error", msg
     return "active", None
@@ -406,7 +484,7 @@ class Bucket:
     shapes: Tuple[tuple, ...]
     sizes: Tuple[int, ...]        # numels, aligned with indices
     numel: int
-    algorithm: str                # 'psum' | 'scatter' | 'none'
+    algorithm: str                # 'psum' | 'scatter' | 'rscatter' | 'none'
     wire_dtype: str               # 'fp32' | 'bf16' | 'int8'
     wire_bytes: int               # per-device bytes per step
     collectives: int
@@ -415,8 +493,13 @@ class Bucket:
 
     @property
     def classification(self) -> str:
+        # 'rscatter' is the FSDP reduce-scatter-only route: the
+        # all-gather leg is skipped because each device keeps exactly
+        # its own param shards' grad chunk — bandwidth-class, at half
+        # the allreduce wire
         return ("none" if self.algorithm == "none"
-                else "bandwidth" if self.algorithm == "scatter"
+                else "bandwidth" if self.algorithm in ("scatter",
+                                                       "rscatter")
                 else "latency")
 
     def to_dict(self) -> dict:
@@ -483,7 +566,17 @@ def _wire_bytes(numel: int, wire_dtype: str, algorithm: str, dp: int,
     device's links per step."""
     if dp <= 1 or algorithm == "none":
         return 0
-    ring = 2.0 * (dp - 1) / dp
+    one_dir = (dp - 1) / dp
+    if algorithm == "rscatter":
+        # FSDP reduce-scatter only: each device keeps its own chunk,
+        # no all-gather leg — the payload rides ONE direction
+        if wire_dtype == "int8":
+            payload = _int8_payload(numel, dp, block_size)
+        else:
+            payload = (_padded_numel(numel, dp)
+                       * _WIRE_ITEMSIZE[wire_dtype])
+        return int(round(one_dir * payload))
+    ring = 2.0 * one_dir
     if wire_dtype == "int8":
         # scatter route: quantized payload + scales ride both directions
         payload = _int8_payload(numel, dp, block_size)
@@ -492,6 +585,75 @@ def _wire_bytes(numel: int, wire_dtype: str, algorithm: str, dp: int,
     else:
         payload = numel * _WIRE_ITEMSIZE[wire_dtype]
     return int(round(ring * payload))
+
+
+def _gather_wire_bytes(numel: int, size: int) -> int:
+    """One forward param all-gather's per-device wire bytes: every
+    device receives (and, on the ring path, forwards) ``(size-1)/size``
+    of the f32 payload — exactly half the allreduce ring factor, same
+    link model as :func:`_wire_bytes`."""
+    if size <= 1:
+        return 0
+    return int(round((size - 1) / size * numel * 4))
+
+
+def plan_gathers(shapes: Sequence[tuple], kinds: Sequence[tuple],
+                 mesh_shape, order: Optional[Sequence[int]] = None
+                 ) -> List[dict]:
+    """The forward param-gather schedule of the hybrid grad path: one
+    all-gather per sharded param (FSDP over 'dp' dim 0, tensor-parallel
+    over 'mp' on its sharded dim), emitted in REVERSE backward
+    production order — backward level descends toward the loss, so the
+    reversed order is forward order and each layer's params are
+    requested ahead of that layer's forward (the prefetch shape of the
+    overlap stack).  ``kinds[i]`` is ``classify_spec``'s ``(kind, dim)``
+    for param i.  Returns ``[{index, axis, size, dim, numel,
+    wire_bytes}]`` — static, so the cost model, the wire-byte audit and
+    the runtime stats all read the same numbers."""
+    shape = dict(mesh_shape)
+    seq = (list(order) if order is not None
+           else list(range(len(shapes))))
+    gathers: List[dict] = []
+    for i in reversed(seq):
+        kind, dim = kinds[i]
+        if kind == "rep":
+            continue
+        ax = DP_AXIS if kind == "fsdp" else MP_AXIS
+        size = int(shape.get(ax, 1))
+        numel = int(np.prod(shapes[i])) if shapes[i] else 1
+        gathers.append({
+            "index": int(i), "axis": ax, "size": size,
+            "dim": int(dim or 0), "numel": numel,
+            "wire_bytes": _gather_wire_bytes(numel, size)})
+    return gathers
+
+
+def hybrid_layout(plan, named_shapes: Sequence[Tuple[str, tuple]],
+                  order: Optional[Sequence[int]] = None):
+    """Per-trainable-param comm classification of the hybrid grad path
+    plus its forward gather schedule, from ONE source (the plan's
+    specs) for the Executor, the cost model and shardcheck alike.
+
+    ``named_shapes`` is ``[(param name, global shape)]`` in creation
+    order; ``order`` the backward production order over the same list.
+    Returns ``(kinds, fsdp, gathers)`` — ``kinds[i] = (kind, dim)``
+    per :func:`classify_spec`, ``fsdp`` the tuple of positions that
+    take the reduce-scatter bucket route, ``gathers`` per
+    :func:`plan_gathers`.  Raises on specs outside the supported forms
+    (callers normally gate via :func:`plan_status` first)."""
+    shape = dict(plan.mesh.shape)
+    kinds: List[Tuple[str, Optional[int]]] = []
+    for name, shp in named_shapes:
+        spec = plan.spec_by_name(name)
+        kind, dim = classify_spec(spec, shape)
+        if kind == "bad":
+            raise NotImplementedError(
+                f"grad_comm: param '{name}' spec {spec} — {dim}")
+        kinds.append((kind, dim))
+    fsdp = tuple(i for i, (k, _) in enumerate(kinds) if k == "fsdp")
+    gathers = plan_gathers([s for _, s in named_shapes], kinds, shape,
+                           order=order)
+    return kinds, fsdp, gathers
 
 
 class GradCommPlan:
@@ -504,10 +666,12 @@ class GradCommPlan:
 
     __slots__ = ("cfg", "dp", "buckets", "wire_bytes_per_step",
                  "collectives_per_step", "fp32_wire_bytes_per_step",
-                 "overlap_path")
+                 "overlap_path", "gathers", "gather_wire_bytes_per_step",
+                 "axis_wire_bytes")
 
     def __init__(self, cfg: CommSpec, dp: int, buckets: List[Bucket],
-                 backend: Optional[str] = None):
+                 backend: Optional[str] = None,
+                 gathers: Sequence[dict] = ()):
         self.cfg = cfg
         self.dp = int(dp)
         self.buckets = buckets
@@ -518,6 +682,19 @@ class GradCommPlan:
         # cost model's exposed-comm simulation, which therefore cannot
         # disagree with what actually compiled
         self.overlap_path = resolve_overlap_path(cfg, backend)
+        # forward param-gather schedule (hybrid meshes: FSDP dp-gathers
+        # + tensor-parallel mp-gathers; empty on replicated layouts)
+        self.gathers = list(gathers)
+        self.gather_wire_bytes_per_step = sum(
+            g["wire_bytes"] for g in self.gathers)
+        # per-mesh-axis wire accounting: grad buckets ride the dp axis;
+        # each gather rides its own axis.  The runtime's
+        # comm.axis.<name>.wire_bytes stats, the cost model's per-axis
+        # prediction and shardcheck's audit all read THIS dict
+        axis: Dict[str, int] = {DP_AXIS: self.wire_bytes_per_step}
+        for g in self.gathers:
+            axis[g["axis"]] = axis.get(g["axis"], 0) + g["wire_bytes"]
+        self.axis_wire_bytes = axis
         # the un-quantized, un-bucketed baseline the ratio gates measure
         # against: one fp32 ring allreduce over every gradient byte
         total = sum(b.numel for b in buckets)
@@ -546,6 +723,9 @@ class GradCommPlan:
             "wire_bytes_per_step": self.wire_bytes_per_step,
             "fp32_wire_bytes_per_step": self.fp32_wire_bytes_per_step,
             "collectives_per_step": self.collectives_per_step,
+            "gather_wire_bytes_per_step": self.gather_wire_bytes_per_step,
+            "axis_wire_bytes": dict(self.axis_wire_bytes),
+            "gathers": [dict(g) for g in self.gathers],
             "buckets": [b.to_dict() for b in self.buckets],
         }
 
@@ -558,6 +738,8 @@ class GradCommPlan:
             "path": self.overlap_path,
             "dp": self.dp,
             "wire_bytes_per_step": self.wire_bytes_per_step,
+            "axis_wire_bytes": dict(self.axis_wire_bytes),
+            "gathers": [dict(g) for g in self.gathers],
             "buckets": [b.to_dict() for b in self.buckets],
         }
 
@@ -572,22 +754,56 @@ class GradCommPlan:
 
 def plan_reduction(shapes: Sequence[tuple], dp: int, cfg: CommSpec,
                    order: Optional[Sequence[int]] = None,
-                   backend: Optional[str] = None) -> GradCommPlan:
+                   backend: Optional[str] = None,
+                   fsdp: Sequence[int] = (),
+                   gathers: Sequence[dict] = ()) -> GradCommPlan:
     """Assemble buckets over gradient ``shapes`` (creation order;
     ``order`` gives the backward production order — see
     :func:`production_order` — default reverse creation) and pick each
-    bucket's wire dtype + collective algorithm."""
-    buckets: List[Bucket] = []
-    total_numel = 0
-    for s in shapes:
-        n = 1
-        for d in s:
-            n *= int(d)
-        total_numel += n
+    bucket's wire dtype + collective algorithm.
+
+    ``fsdp`` names the positions whose params are dp-sharded on dim 0
+    (ZeRO-3): their grads stay OUT of the gathered buckets and form
+    dedicated ``'rscatter'`` buckets — reduce-scatter only, each device
+    keeps exactly its own shard's chunk (half the allreduce wire), with
+    the per-device EF residual covering the shard-major flat layout.
+    ``gathers`` is the forward param-gather schedule
+    (:func:`plan_gathers`) that rides the plan for per-axis wire
+    accounting."""
+    fsdp_set = frozenset(int(i) for i in fsdp)
+    seq = (list(order) if order is not None
+           else list(reversed(range(len(shapes)))))
+    # issue point = fraction of backward (by cumulative grad numel over
+    # the FULL production order) complete when the bucket's LAST grad
+    # materializes — shared by the interleaved normal/fsdp streams
+    numels = [int(np.prod(s)) if s else 1 for s in shapes]
+    rank = {i: r for r, i in enumerate(seq)}
+    prefix = []
     cum = 0
-    for indices, numel in build_buckets(shapes, cfg.fuse_grad_size_in_MB,
-                                        order=order):
-        cum += numel
+    for i in seq:
+        cum += numels[i]
+        prefix.append(cum)
+    total_numel = max(cum, 1)
+
+    def _mk(indices, numel, algo, wire, n_coll):
+        carries = (cfg.error_feedback and algo != "none"
+                   and wire != "fp32")
+        last = max(prefix[rank[i]] for i in indices)
+        return Bucket(
+            indices=indices,
+            shapes=tuple(tuple(shapes[i]) for i in indices),
+            sizes=tuple(numels[i] for i in indices),
+            numel=numel, algorithm=algo, wire_dtype=wire,
+            wire_bytes=_wire_bytes(numel, wire, algo, dp,
+                                   cfg.block_size),
+            collectives=n_coll, carries_residual=carries,
+            issue_frac=last / total_numel)
+
+    buckets: List[Bucket] = []
+    normal_seq = [i for i in seq if i not in fsdp_set]
+    fsdp_seq = [i for i in seq if i in fsdp_set]
+    for indices, numel in build_buckets(
+            shapes, cfg.fuse_grad_size_in_MB, order=normal_seq):
         if dp <= 1:
             algo, wire = "none", cfg.dtype
         else:
@@ -613,18 +829,33 @@ def plan_reduction(shapes: Sequence[tuple], dp: int, cfg: CommSpec,
             n_coll = 4      # all_to_all q, all_to_all scales, ag q, ag s
         else:
             n_coll = 2      # psum_scatter + all_gather
-        carries = (cfg.error_feedback and algo != "none"
-                   and wire != "fp32")
-        buckets.append(Bucket(
-            indices=indices,
-            shapes=tuple(tuple(shapes[i]) for i in indices),
-            sizes=tuple(int(np.prod(shapes[i])) if shapes[i] else 1
-                        for i in indices),
-            numel=numel, algorithm=algo, wire_dtype=wire,
-            wire_bytes=_wire_bytes(numel, wire, algo, dp, cfg.block_size),
-            collectives=n_coll, carries_residual=carries,
-            issue_frac=cum / max(total_numel, 1)))
-    return GradCommPlan(cfg, dp, buckets, backend=backend)
+        buckets.append(_mk(indices, numel, algo, wire, n_coll))
+    for indices, numel in build_buckets(
+            shapes, cfg.fuse_grad_size_in_MB, order=fsdp_seq):
+        if dp <= 1:
+            buckets.append(_mk(indices, numel, "none", cfg.dtype, 0))
+            continue
+        # the reduce-scatter IS the point of the FSDP route — there is
+        # no psum fallback (a full allreduce would replicate the grad a
+        # sharded optimizer state cannot consume).  int8 keeps the
+        # one-shot quantized exchange; small int8 buckets ride bf16
+        # like the psum route (scales-in-payload has the same
+        # constraint either way).
+        if cfg.dtype == "int8":
+            payload = _int8_payload(numel, dp, cfg.block_size)
+            wire = ("int8" if payload >= cfg.scatter_threshold_KB * 1024
+                    else "bf16")
+        else:
+            wire = cfg.dtype
+        n_coll = 2 if wire == "int8" else 1   # a2a q + a2a scales | rs
+        buckets.append(_mk(indices, numel, "rscatter", wire, n_coll))
+    # interleave the two streams back into production order (by issue
+    # point) so bucket emission, residual order and the cost model's
+    # link simulation all see one schedule
+    buckets.sort(key=lambda b: (b.issue_frac,
+                                min(rank[i] for i in b.indices)))
+    return GradCommPlan(cfg, dp, buckets, backend=backend,
+                        gathers=gathers)
 
 
 # ---------------------------------------------------------------------------
@@ -649,6 +880,65 @@ def unflatten_bucket(flat, bucket: Bucket, like: Sequence):
         piece = jax.lax.slice_in_dim(flat, off, off + n).reshape(shp)
         out.append((i, piece.astype(like[i].dtype)))
         off += n
+    return out
+
+
+# -- FSDP reduce-scatter buckets: shard-major flat layout -------------------
+# A reduce-scatter bucket's flat layout must align each device's chunk
+# with its OWN param shards: row r = the concatenation of every member
+# grad's r-th dim-0 shard (flattened).  Rows are padded to a block
+# multiple on int8 wire so quantization blocks never straddle a chunk
+# boundary; the padding is zeros, quantizes exactly, and is stripped on
+# unflatten.  After the reduce-scatter each device's chunk reshapes
+# DIRECTLY into its per-param dim-0 shard grads — no gather, no slice.
+
+def fsdp_row_len(bucket: Bucket, dp: int, block_size: int) -> int:
+    """Per-device row length of an ``'rscatter'`` bucket's shard-major
+    flat layout (``numel/dp``, block-padded on int8 wire)."""
+    row = bucket.numel // dp
+    if bucket.wire_dtype == "int8":
+        row = _padded_numel(row, block_size)
+    return row
+
+
+def bucket_flat_numel(bucket: Bucket, dp: int, block_size: int) -> int:
+    """Length of a bucket's flat working vector — and of its EF
+    residual: plain ``numel`` for gathered buckets, ``dp x padded-row``
+    for FSDP reduce-scatter buckets (the Executor sizes the donated
+    residual carry from THIS, re-keyed on the plan fingerprint)."""
+    if bucket.algorithm != "rscatter":
+        return bucket.numel
+    return dp * fsdp_row_len(bucket, dp, block_size)
+
+
+def flatten_bucket_fsdp(grads: Sequence, bucket: Bucket, dp: int,
+                        block_size: int):
+    """Shard-major flatten of an ``'rscatter'`` bucket: ``[dp,
+    row_len]`` row-major, row r holding every member grad's r-th dim-0
+    shard."""
+    rows = jnp.concatenate(
+        [jnp.asarray(grads[i], jnp.float32).reshape(dp, -1)
+         for i in bucket.indices], axis=1)
+    row = fsdp_row_len(bucket, dp, block_size)
+    pad = row - rows.shape[1]
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+    return rows.reshape(-1)
+
+
+def unflatten_bucket_fsdp(chunk, bucket: Bucket, dp: int,
+                          like: Sequence):
+    """Split my reduced chunk (one row of the shard-major layout) into
+    the bucket's per-param dim-0 SHARD grads — ``[(index, grad)]`` with
+    shard shape ``(d0/dp, *rest)``, dtype restored from ``like``."""
+    out = []
+    off = 0
+    for i, n, shp in zip(bucket.indices, bucket.sizes, bucket.shapes):
+        ln = n // dp
+        piece = jax.lax.slice_in_dim(chunk, off, off + ln)
+        piece = piece.reshape((int(shp[0]) // dp,) + tuple(shp[1:]))
+        out.append((i, piece.astype(like[i].dtype)))
+        off += ln
     return out
 
 
@@ -728,6 +1018,44 @@ def _rs_ag_ring(x, axis_name: str, dp: int):
     rows = jnp.pad(x, (0, np_ - n)).reshape(dp, np_ // dp)
     total = _ascending_sum(_chunked_all_to_all(rows, axis_name, dp), dp)
     return _chunked_all_gather(total, axis_name, dp).reshape(-1)[:n]
+
+
+def _rs_only(x, axis_name: str, dp: int, ring: bool):
+    """Reduce-scatter WITHOUT the all-gather leg: my chunk of the sum.
+    ``x`` length must be a dp multiple (the shard-major FSDP layout
+    guarantees it).  The ring form's ascending accumulation is
+    bitwise-identical to ``psum_scatter`` at fp32 (same property as
+    :func:`_ascending_sum` vs psum), so flipping the overlap knob can
+    never change FSDP training numerics."""
+    if ring:
+        rows = x.reshape(dp, x.shape[0] // dp)
+        return _ascending_sum(
+            _chunked_all_to_all(rows, axis_name, dp), dp)
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0,
+                                tiled=True)
+
+
+def gather_param(shard, axis_name: str, size: int, dim: int = 0,
+                 ring: bool = False):
+    """All-gather one sharded param to its full value inside shard_map
+    — the forward-prefetch leg of the hybrid grad path (FSDP shards
+    gather over 'dp' on dim 0, tensor-parallel shards over 'mp' on
+    their sharded dim).  ``ring=True`` decomposes into ``size-1``
+    single-chunk ppermutes (:func:`_chunked_all_gather`) so even a
+    static scheduler can slot the steps between forward ops; the fused
+    form leaves one ``all_gather`` for the latency-hiding scheduler.
+    Wire bytes either way: ``(size-1)/size`` of the payload
+    (:func:`_gather_wire_bytes`)."""
+    if size <= 1:
+        return shard
+    if dim != 0:
+        moved = jnp.moveaxis(shard, dim, 0)
+        return jnp.moveaxis(
+            gather_param(moved, axis_name, size, 0, ring=ring), 0, dim)
+    if ring:
+        rows = _chunked_all_gather(shard, axis_name, size)
+        return rows.reshape((size * shard.shape[0],) + shard.shape[1:])
+    return jax.lax.all_gather(shard, axis_name, tiled=True)
 
 
 def _reduce_int8_scatter(carry, axis_name: str, dp: int, block: int,
@@ -818,6 +1146,93 @@ def _reduce_int8_scatter(carry, axis_name: str, dp: int, block: int,
     return total, e1[:n], nonfinite_blocks, wire_nf
 
 
+def _reduce_int8_rscatter(carry, axis_name: str, dp: int, block: int,
+                          error_feedback: bool, ring: bool = False,
+                          sentry: bool = False, step=None,
+                          bucket_label: str = ""):
+    """One-shot block-scaled int8 reduce-scatter for FSDP buckets:
+    quantize the shard-major flat, exchange chunks, dequantize-sum —
+    each device keeps its OWN chunk (its params' shard rows), so the
+    second shot (requantize + all-gather) never happens and neither
+    does its wire or its requantize error.  Returns (my reduced chunk
+    f32, per-device residual or None, nonfinite-block count, wire_nf).
+    The EF residual is the full-length local quantize error e1 — the
+    requantize term e2 of the gathered route has no analog here."""
+    n = carry.shape[0]            # dp*block multiple by layout
+    chunk = n // dp
+    cb = chunk // block
+    nonfinite_blocks = None
+    if sentry:
+        finite = jnp.isfinite(carry)
+        nonfinite_blocks = jnp.sum(
+            jnp.any((~finite).reshape(-1, block), axis=1)
+            .astype(jnp.int32))
+        carry = jnp.where(finite, carry, 0.0)
+    q, s = quantize_int8_blocks(carry, block)
+    if step is not None:
+        from ..testing import fault
+        q = fault.corrupt_in_graph("grad_comm.wire", q, step,
+                                   tensor=f"{bucket_label}.q")
+        s = fault.corrupt_in_graph("grad_comm.wire", s, step,
+                                   tensor=f"{bucket_label}.scales")
+    if ring:
+        qq = _chunked_all_to_all(q.reshape(dp, cb, block), axis_name, dp)
+        ss = _chunked_all_to_all(s.reshape(dp, cb, 1), axis_name, dp)
+        red_chunk = _ascending_sum(
+            qq.astype(jnp.float32) * ss, dp).reshape(-1)
+    else:
+        qq = jax.lax.all_to_all(q.reshape(dp, cb, block), axis_name, 0, 0)
+        ss = jax.lax.all_to_all(s.reshape(dp, cb, 1), axis_name, 0, 0)
+        red_chunk = jnp.sum(qq.astype(jnp.float32) * ss,
+                            axis=0).reshape(-1)
+    wire_nf = None
+    if sentry:
+        # same wire guard as the two-shot route: corrupted received
+        # payload is counted (psum'd — chunks are device-varying) and
+        # masked; the flagged step's update is discarded anyway
+        bad = ~jnp.isfinite(red_chunk)
+        wire_nf = jax.lax.psum(jnp.sum(bad.astype(jnp.int32)),
+                               axis_name)
+        red_chunk = jnp.where(bad, 0.0, red_chunk)
+    if not error_feedback:
+        return red_chunk, None, nonfinite_blocks, wire_nf
+    e1 = carry - dequantize_int8_blocks(q, s, n)
+    return red_chunk, e1, nonfinite_blocks, wire_nf
+
+
+def _reduce_bucket_fsdp(flat, residual, axis_name: str, bucket: Bucket,
+                        plan: GradCommPlan, ring: bool = False,
+                        sentry: bool = False, step=None,
+                        bucket_label: str = ""):
+    """Reduce one FSDP (``'rscatter'``) bucket: the shard-major flat
+    reduce-scatters over dp and each device keeps its own chunk —
+    returns (my mean chunk f32, new residual or None, nonfinite-block
+    count or None, wire_nf or None).  fp32 wire is exact (residual
+    drains); bf16 carries ``carry - sent``; int8 takes the one-shot
+    quantized exchange above."""
+    dp = plan.dp
+    carry = flat + residual if residual is not None else flat
+    wire = bucket.wire_dtype
+    if wire == "fp32":
+        chunk = _rs_only(carry, axis_name, dp, ring)
+        new_res = residual
+        if residual is not None:
+            new_res = jnp.zeros_like(residual)
+        return chunk / dp, new_res, None, None
+    if wire == "bf16":
+        sent = carry.astype(jnp.bfloat16)
+        chunk = _rs_only(sent, axis_name, dp, ring).astype(jnp.float32)
+        new_res = (carry - sent.astype(jnp.float32)
+                   if bucket.carries_residual and residual is not None
+                   else None)
+        return chunk / dp, new_res, None, None
+    chunk, new_res, nfb, wire_nf = _reduce_int8_rscatter(
+        carry, axis_name, dp, plan.cfg.block_size,
+        bucket.carries_residual and residual is not None, ring=ring,
+        sentry=sentry, step=step, bucket_label=bucket_label)
+    return chunk / dp, new_res, nfb, wire_nf
+
+
 def _reduce_bucket(flat, residual, axis_name: str, bucket: Bucket,
                    plan: GradCommPlan, ring: bool = False,
                    sentry: bool = False, step=None,
@@ -827,10 +1242,16 @@ def _reduce_bucket(flat, residual, axis_name: str, bucket: Bucket,
     nonfinite-block count or None).  ``ring`` lowers the bandwidth
     route as ppermute chunks; latency-bound psum buckets stay one
     fused psum on every path (chunking a small bucket would multiply
-    its latency, the thing the threshold protects)."""
+    its latency, the thing the threshold protects).  ``'rscatter'``
+    buckets return each device's OWN chunk (FSDP shard grads), not the
+    replicated mean."""
     dp = plan.dp
     if bucket.algorithm == "none":
         return flat, residual, None, None
+    if bucket.algorithm == "rscatter":
+        return _reduce_bucket_fsdp(
+            flat, residual, axis_name, bucket, plan, ring=ring,
+            sentry=sentry, step=step, bucket_label=bucket_label)
     carry = flat + residual if residual is not None else flat
     wire = bucket.wire_dtype
     rs = _rs_ag_ring if ring else _rs_ag
@@ -898,10 +1319,14 @@ def reduce_gradients(grads: Sequence, *, plan: GradCommPlan,
 
     Returns ``(reduced grads, new residuals)`` — plus the sentry dict
     when ``sentry=True``; reduced grads come back replicated (every
-    device holds the same mean), in the original order/shape/dtype.
-    Buckets are emitted in backward production order, each as an
-    independent collective, so bucket N's reduction can overlap the
-    producers of the buckets after it."""
+    device holds the same mean) in the original order/shape/dtype —
+    EXCEPT params in ``'rscatter'`` (FSDP) buckets, whose entries are
+    each device's own dim-0 SHARD of the mean grad (shape
+    ``(d0/dp, *rest)``): the caller's shard_map out_spec ``P(dp)``
+    reassembles them as the dp-sharded global grad the sharded
+    optimizer state consumes.  Buckets are emitted in backward
+    production order, each as an independent collective, so bucket N's
+    reduction can overlap the producers of the buckets after it."""
     mode = plan.overlap_path if mode is None else mode
     if mode == "none":
         # all buckets depend on ALL grads: the comm stage cannot start
@@ -918,7 +1343,10 @@ def reduce_gradients(grads: Sequence, *, plan: GradCommPlan,
         res = None
         if residuals is not None and bucket.carries_residual:
             res = residuals[ri]
-        flat = flatten_bucket(grads, bucket)
+        fsdp = bucket.algorithm == "rscatter"
+        flat = (flatten_bucket_fsdp(grads, bucket, plan.dp,
+                                    plan.cfg.block_size)
+                if fsdp else flatten_bucket(grads, bucket))
         if sentry:
             pre_nf.append(jnp.sum(
                 (~jnp.isfinite(flat)).astype(jnp.int32)))
@@ -930,19 +1358,29 @@ def reduce_gradients(grads: Sequence, *, plan: GradCommPlan,
             # is already mesh-agreed (wire_nf — corruption caught in
             # the received int8 chunks before the requantize launders
             # it — arrives already psum'd); pre counts + block counts
-            # are device-varying and psum below
+            # are device-varying and psum below.  An rscatter bucket's
+            # reduced CHUNK is device-varying too (each device holds
+            # its own shard rows), so its post count and its norm
+            # contribution psum here — the flag stays mesh-agreed on
+            # hybrid meshes and the norm matches the gathered path's.
             post = jnp.sum((~jnp.isfinite(red)).astype(jnp.int32))
+            nrm = jnp.sum(red * red)
+            if fsdp:
+                post = jax.lax.psum(post, axis_name)
+                nrm = jax.lax.psum(nrm, axis_name)
             if wire_nf is not None:
                 post = post + wire_nf
             post_nf.append(post)
-            norm2 = norm2 + jnp.sum(red * red)
+            norm2 = norm2 + nrm
             if nfb is not None:
                 blocks = blocks + nfb
         if residuals is not None and bucket.carries_residual:
             new_res.append(r2 if r2 is not None
                            else jnp.zeros_like(flat))
             ri += 1
-        for i, g in unflatten_bucket(red, bucket, grads):
+        pieces = (unflatten_bucket_fsdp(red, bucket, plan.dp, grads)
+                  if fsdp else unflatten_bucket(red, bucket, grads))
+        for i, g in pieces:
             out[i] = g
     if not sentry:
         return out, new_res

@@ -341,6 +341,23 @@ def validate_toggles(strategy: "DistributedStrategy",
             f"strategy.fp16_allreduce is an alias for grad_comm.dtype="
             f"'bf16' but grad_comm.dtype={gc.dtype!r} is also set — "
             f"drop the alias or the explicit dtype; they conflict.")
+    if gc.dtype is not None or strategy.fp16_allreduce:
+        still_bad = [name for name, on in
+                     (("pipeline", strategy.pipeline),
+                      ("sequence_parallel", strategy.sequence_parallel))
+                     if on]
+        if still_bad:
+            raise NotImplementedError(
+                f"strategy.grad_comm + strategy."
+                f"{' + strategy.'.join(still_bad)}: the explicit "
+                f"grad-comm stage composes data parallelism with "
+                f"tensor parallelism (mp-sharded params) and ZeRO-3 "
+                f"(strategy.sharding stage 3, dp-sharded params), but "
+                f"pipeline/sequence-parallel axes schedule cross-stage "
+                f"collectives the in-graph shard_map stage cannot "
+                f"carry.  Disable grad_comm (leave its dtype None) on "
+                f"pp/sp meshes — GSPMD then schedules the grad "
+                f"reduction — or drop the pp/sp degrees.")
     if strategy.dgc:
         raise NotImplementedError(
             "strategy.dgc: deep gradient compression (dgc_optimizer.py, "

@@ -392,6 +392,9 @@ def _comm_block(program: Program, plan,
             "overlap": "none", "overlap_path": "none",
             "wire_bytes_per_step": fp32_wire,
             "fp32_wire_bytes_per_step": fp32_wire,
+            "gathers": [], "gather_wire_bytes_per_step": 0,
+            "axis_wire_bytes": ({DP_AXIS: fp32_wire} if dp > 1
+                                else {}),
             "collectives": ([] if dp <= 1 else [{
                 "params": list(range(len(shapes))),
                 "numel": grad_bytes // 4, "algorithm": "gspmd_psum",
@@ -407,7 +410,14 @@ def _comm_block(program: Program, plan,
     order = _gc.production_order(program, trainable,
                                  pack[1] if pack is not None else None,
                                  graph=graph)
-    gplan = _gc.plan_reduction(shapes, dp=dp, cfg=cfg, order=order)
+    # the SAME hybrid layout the Executor compiles (FSDP rscatter
+    # buckets + forward gather schedule from the plan's own specs) —
+    # per-axis prediction and the runtime comm.axis.<name>.wire_bytes
+    # stats read one derivation
+    named = [(p.name, s) for p, s in zip(trainable, shapes)]
+    _kinds, fsdp, gathers = _gc.hybrid_layout(plan, named, order=order)
+    gplan = _gc.plan_reduction(shapes, dp=dp, cfg=cfg, order=order,
+                               fsdp=fsdp, gathers=gathers)
     return {
         "enabled": True, "dp": dp, "dtype": cfg.dtype,
         "block_size": cfg.block_size,
@@ -418,6 +428,9 @@ def _comm_block(program: Program, plan,
         "fp32_wire_bytes_per_step": gplan.fp32_wire_bytes_per_step,
         "collectives_per_step": gplan.collectives_per_step,
         "collectives": [b.to_dict() for b in gplan.buckets],
+        "gathers": list(gplan.gathers),
+        "gather_wire_bytes_per_step": gplan.gather_wire_bytes_per_step,
+        "axis_wire_bytes": dict(gplan.axis_wire_bytes),
     }
 
 
@@ -433,10 +446,18 @@ def _comm_seconds(comm: dict, backward_s: float, ici_bw: float
     ``max(0, link_end - backward_s)``.  For a single bucket this is
     exactly ``max(0, comm_s - overlappable_backward_s)``.  With
     ``overlap_path == 'none'`` (or no overlap info) the whole stage is
-    serialized after backward: exposed == total."""
+    serialized after backward: exposed == total.
+
+    Hybrid meshes add the forward param gathers
+    (``gather_wire_bytes_per_step``): they always count toward the
+    total; on an overlapping path they are issued ahead of each
+    layer's forward in production order and hide behind forward
+    compute, on the barriered path they serialize like everything
+    else."""
     if ici_bw <= 0:
         return 0.0, 0.0
-    total = comm["wire_bytes_per_step"] / ici_bw
+    gather_s = comm.get("gather_wire_bytes_per_step", 0) / ici_bw
+    total = comm["wire_bytes_per_step"] / ici_bw + gather_s
     if not comm.get("enabled") or comm.get("overlap_path") == "none":
         return total, total
     link_end = 0.0
@@ -1009,6 +1030,14 @@ def compile_summary(program: Program, donate: bool = True,
         # comm.wire_bytes stat is compared against
         out["predicted_wire_bytes"] = comm["wire_bytes_per_step"]
         out["comm_enabled"] = comm["enabled"]
+        # per-mesh-axis prediction (hybrid meshes): what the runtime's
+        # comm.axis.<name>.wire_bytes stats must measure, axis by axis
+        if comm.get("axis_wire_bytes"):
+            out["predicted_axis_wire_bytes"] = dict(
+                comm["axis_wire_bytes"])
+        if comm.get("gather_wire_bytes_per_step"):
+            out["predicted_gather_wire_bytes"] = \
+                comm["gather_wire_bytes_per_step"]
         # the overlap prediction (total/exposed/hidden comm seconds on
         # the running chip + the resolved path) — what the perf
         # observatory's exposed-vs-hidden split reads per step

@@ -18,10 +18,13 @@ shard-plan          every param covered, every spec axis present and
                     batch-divisible; ``_fit_spec_to_mesh`` silent
                     downgrades promoted to WARN naming the matched rule
 shard-choreography  every replica executes the identical collective
-                    sequence: known-bad grad_comm configs (ZeRO-3,
-                    non-pure-dp mesh) via :func:`grad_comm.plan_status`,
-                    sum-classified fetches, collectives under
-                    device-varying predicates, overlap-knob resolution
+                    sequence: known-bad grad_comm configs (pp/sp mesh
+                    axes, param specs outside the FSDP/mp forms) via
+                    :func:`grad_comm.plan_status` — hybrid {dp, mp} and
+                    ZeRO-3 layouts are first-class and report their
+                    gather choreography — sum-classified fetches,
+                    collectives under device-varying predicates,
+                    overlap-knob resolution
 shard-taint         device-varying values (axis_index, shard-local
                     collectives, per-shard RNG) reaching fetches,
                     host-sync ops, or step control flow without a
@@ -368,8 +371,12 @@ def _derive_gplan(program, plan, graph: Optional[DefUseGraph] = None):
     loss = program._optimizer[1]
     order = _gc.production_order(program, trainable, loss, graph=graph)
     dp = dict(plan.mesh.shape).get(DP_AXIS, 1)
+    # the SAME hybrid layout the Executor compiles: FSDP positions take
+    # rscatter buckets, sharded params get forward gathers
+    named = [(p.name, s) for p, s in zip(trainable, shapes)]
+    _kinds, fsdp, gathers = _gc.hybrid_layout(plan, named, order=order)
     return _gc.plan_reduction(shapes, dp=dp, cfg=plan.grad_comm,
-                              order=order)
+                              order=order, fsdp=fsdp, gathers=gathers)
 
 
 def audit_wire_bytes(gplan) -> dict:
@@ -380,14 +387,32 @@ def audit_wire_bytes(gplan) -> dict:
     (auditing a formula with itself proves nothing); the shard-wire
     pass cross-checks this against the schedule, ``cost._comm_block``
     and the ``comm.bucket.<i>.wire_bytes`` runtime stats."""
+    from ...distributed.mesh import DP_AXIS
     dp, cfg = gplan.dp, gplan.cfg
     itemsize = {"fp32": 4, "bf16": 2, "int8": 1}
     scale_bytes = 4
     ring = 2.0 * (dp - 1) / dp if dp > 1 else 0.0
+    one_dir = (dp - 1) / dp if dp > 1 else 0.0
     buckets = []
     for b in gplan.buckets:
         if dp <= 1 or b.algorithm == "none":
             wire, ncoll = 0, 0
+        elif b.algorithm == "rscatter":
+            # FSDP reduce-scatter only: no all-gather leg, the payload
+            # rides ONE ring direction; int8 pads to dp*block so each
+            # shard row holds whole blocks (one-shot: no requantize,
+            # so half the two-shot collective count too)
+            if b.wire_dtype == "int8":
+                blk = int(cfg.block_size)
+                padded = -(-b.numel // (dp * blk)) * (dp * blk)
+                payload = padded * itemsize["int8"]
+                payload += (padded // blk) * scale_bytes
+                wire, ncoll = int(round(one_dir * payload)), 2
+            else:
+                padded = -(-b.numel // dp) * dp
+                wire = int(round(one_dir * padded
+                                 * itemsize[b.wire_dtype]))
+                ncoll = 1
         elif b.wire_dtype == "int8":
             # pad to dp*block so every shard holds whole blocks
             blk = int(cfg.block_size)
@@ -408,12 +433,30 @@ def audit_wire_bytes(gplan) -> dict:
             "wire_dtype": b.wire_dtype,
         })
     total_numel = sum(b.numel for b in gplan.buckets)
+    # forward param gathers (hybrid meshes): each moves (size-1)/size
+    # of the f32 payload through every device's links, per axis
+    gathers = []
+    for g in getattr(gplan, "gathers", ()) or ():
+        size = int(g["size"])
+        frac = (size - 1) / size if size > 1 else 0.0
+        gathers.append({
+            "axis": str(g["axis"]),
+            "wire_bytes": int(round(frac * int(g["numel"]) * 4))})
+    bucket_wire = sum(x["wire_bytes"] for x in buckets)
+    axis_wire = {DP_AXIS: bucket_wire}
+    for g in gathers:
+        axis_wire[g["axis"]] = axis_wire.get(g["axis"], 0) \
+            + g["wire_bytes"]
     return {
         "dp": dp,
         "buckets": buckets,
-        "wire_bytes_per_step": sum(x["wire_bytes"] for x in buckets),
+        "wire_bytes_per_step": bucket_wire,
         "collectives_per_step": sum(x["collectives"] for x in buckets),
         "fp32_wire_bytes_per_step": int(round(ring * total_numel * 4)),
+        "gathers": gathers,
+        "gather_wire_bytes_per_step": sum(x["wire_bytes"]
+                                          for x in gathers),
+        "axis_wire_bytes": axis_wire,
     }
 
 
@@ -580,12 +623,15 @@ class PlanCoveragePass(AnalysisPass):
 
 class CollectiveChoreographyPass(AnalysisPass):
     """Prove every replica executes the identical collective sequence.
-    Known-bad grad_comm configs (ZeRO-3 sharded params, non-pure-dp
-    mesh) become ERROR diagnostics with the EXACT string the Executor
-    raises (one builder: ``grad_comm.incompatibility``); sum-classified
-    fetches get ``sum_fetch_message`` statically, before the runtime
-    numeric probe; a collective inside a control-flow branch guarded by
-    a device-varying predicate is a static deadlock."""
+    Known-bad grad_comm configs (pp/sp meshes, param specs fitting
+    neither the FSDP nor the mp form) become ERROR diagnostics with the
+    EXACT string the Executor raises (one builder:
+    ``grad_comm.incompatibility``, hybrid form); hybrid/FSDP plans get
+    their forward param-gather choreography (count, prefetch order,
+    per-axis wire) reported as INFO; sum-classified fetches get
+    ``sum_fetch_message`` statically, before the runtime numeric probe;
+    a collective inside a control-flow branch guarded by a
+    device-varying predicate is a static deadlock."""
 
     name = "shard-choreography"
 
@@ -647,6 +693,17 @@ class CollectiveChoreographyPass(AnalysisPass):
                     f"{len(gplan.residual_buckets)} bucket(s) carry "
                     f"error-feedback residuals; overlap path "
                     f"'{gplan.overlap_path}'"))
+                if gplan.gathers:
+                    per_axis = ", ".join(
+                        f"{a}={v} B" for a, v in
+                        sorted(gplan.axis_wire_bytes.items()))
+                    out.append(self._diag(
+                        graph, Diagnostic.INFO,
+                        f"hybrid choreography: "
+                        f"{len(gplan.gathers)} forward param "
+                        f"gather(s) in production (prefetch) order, "
+                        f"{gplan.gather_wire_bytes_per_step} B/step; "
+                        f"per-axis wire [{per_axis}]"))
 
         # collectives under device-varying predicates: replicas take
         # different branches and the collective deadlocks the mesh.
@@ -831,6 +888,20 @@ class WireByteAuditPass(AnalysisPass):
                 graph, Diagnostic.ERROR,
                 f"fp32 baseline {gplan.fp32_wire_bytes_per_step} B != "
                 f"audited {audit['fp32_wire_bytes_per_step']} B"))
+        if gplan.gather_wire_bytes_per_step != \
+                audit["gather_wire_bytes_per_step"]:
+            out.append(self._diag(
+                graph, Diagnostic.ERROR,
+                f"wire-byte conservation violated: forward gathers "
+                f"schedule {gplan.gather_wire_bytes_per_step} B/step "
+                f"but the independent re-derivation gives "
+                f"{audit['gather_wire_bytes_per_step']} B"))
+        if dict(gplan.axis_wire_bytes) != audit["axis_wire_bytes"]:
+            out.append(self._diag(
+                graph, Diagnostic.ERROR,
+                f"wire-byte conservation violated: per-axis schedule "
+                f"{dict(gplan.axis_wire_bytes)} != audited "
+                f"{audit['axis_wire_bytes']}"))
 
         # third leg: the cost model must price the SAME bytes
         from .cost import _comm_block
@@ -855,16 +926,29 @@ class WireByteAuditPass(AnalysisPass):
                     f"{cb.get('collectives_per_step')} collective(s)/"
                     f"step but the audit derives "
                     f"{audit['collectives_per_step']}"))
+            if cb.get("axis_wire_bytes", audit["axis_wire_bytes"]) \
+                    != audit["axis_wire_bytes"]:
+                out.append(self._diag(
+                    graph, Diagnostic.ERROR,
+                    f"cost._comm_block predicts per-axis "
+                    f"{cb.get('axis_wire_bytes')} but the audit "
+                    f"derives {audit['axis_wire_bytes']} — the "
+                    f"per-axis measured==predicted gate would certify "
+                    f"a wrong number"))
 
         if not out:
+            per_axis = ", ".join(
+                f"{a}={v}" for a, v in
+                sorted(audit["axis_wire_bytes"].items()))
             out.append(self._diag(
                 graph, Diagnostic.INFO,
                 f"wire audit: {len(gplan.buckets)} bucket(s), "
                 f"{audit['wire_bytes_per_step']} B/step on the wire "
                 f"(fp32 baseline {audit['fp32_wire_bytes_per_step']} "
                 f"B), {audit['collectives_per_step']} collective(s)/"
-                f"step — schedule, cost model and independent "
-                f"re-derivation agree"))
+                f"step, {len(audit['gathers'])} forward gather(s) "
+                f"[per-axis B: {per_axis}] — schedule, cost model and "
+                f"independent re-derivation agree"))
         return out
 
 

@@ -1033,9 +1033,18 @@ class Executor:
         shapes = [tuple(param_array(params[i]).shape) for i in t_idx]
         order = _gc.production_order(
             program, [params[i] for i in t_idx], loss_var)
+        # hybrid layout: which trainable params are FSDP (dp-sharded,
+        # dedicated reduce-scatter buckets) or mp-sharded (gathered
+        # over mp ahead of forward), plus the forward gather schedule
+        # — one derivation shared with cost._comm_block and shardcheck
+        named = [(params[i].name, shapes[k])
+                 for k, i in enumerate(t_idx)]
+        _kinds, fsdp, gathers = _gc.hybrid_layout(plan, named,
+                                                  order=order)
         return _gc.plan_reduction(shapes,
                                   dp=plan.mesh.shape[DP_AXIS],
-                                  cfg=plan.grad_comm, order=order)
+                                  cfg=plan.grad_comm, order=order,
+                                  fsdp=fsdp, gathers=gathers)
 
     def _build_grad_comm(self, params, fetch_names, donate, plan, gplan,
                          feed_arrays, opt, loss_var, t_idx, params_meta,
@@ -1053,17 +1062,35 @@ class Executor:
         residual carried (and donated) in the aux tree — and the
         optimizer update runs outside on the replicated mean grads.
 
+        Hybrid meshes are first-class: trainable params enter the
+        shard_map under their OWN plan specs.  FSDP (dp-sharded, ZeRO-3)
+        params are all-gathered over dp ahead of their layer's forward
+        — the gather schedule is ``gplan.gathers``, reverse backward
+        production order, i.e. forward prefetch order — and their
+        gradients reduce-scatter back to shards ('rscatter' buckets,
+        per-shard EF residuals).  Tensor-parallel (mp-sharded) params
+        gather over mp the same way; because batch feeds ride dp only
+        and the RNG folds the dp index alone, every mp replica computes
+        bitwise identically, the full mp grad is mp-invariant, and each
+        rank keeps its own chunk at the shard_map boundary (the
+        composite all_gather+matmul / matmul+reduce_scatter lowering —
+        see ops/collective_matmul.py for the fused-kernel form).
+        Replicated non-trainables stay closure-captured; if the plan
+        shards one, GSPMD reconciles it with an (unaccounted) gather.
+
         ``sentry`` (FLAGS_anomaly_sentry) fuses the data-plane anomaly
         sentry into the same executable: reduce_gradients scans each
         bucket's existing flat view for non-finite values (one
         reduction per bucket, pre- and post-wire, plus the int8
         quantize-time block guard), the counts collapse to ONE scalar
-        anomaly flag that is psum'd over dp — every replica takes the
-        same branch, so a skip can never diverge or deadlock the mesh
-        — and the param/slot/step-counter/EF-residual update is
-        applied through a jnp.where select: a flagged step is a
-        bitwise no-op on all carried state while donation and the
-        0-recompile contract stay intact."""
+        anomaly flag that is psum'd over dp — rscatter buckets psum
+        their device-varying post counts and norm contributions too, so
+        every replica of a hybrid mesh takes the same branch and a skip
+        can never diverge or deadlock the mesh — and the
+        param/slot/step-counter/EF-residual update is applied through a
+        jnp.where select: a flagged step is a bitwise no-op on all
+        carried state while donation and the 0-recompile contract stay
+        intact."""
         from jax.sharding import PartitionSpec
         from ..core import rng as _rng
         from ..core.jax_compat import pvary, shard_map
@@ -1075,6 +1102,10 @@ class Executor:
         mesh = plan.mesh
         dp = gplan.dp
         P = PartitionSpec
+        # per-trainable gather directives (hybrid meshes), keyed by
+        # position in t_idx; empty on replicated layouts
+        gkind = {g["index"]: g for g in gplan.gathers}
+        ring_gather = gplan.overlap_path == "ring"
         feed_specs = tuple(plan.feed_spec(a.shape) for a in feed_arrays)
 
         # fetch reconstruction rules from abstract shapes: a fetch whose
@@ -1194,13 +1225,31 @@ class Executor:
             t_arrays = [p_arrays[i] for i in t_idx]
             residuals = tuple(aux.get("grad_comm", ()))
 
-            def local(res_rows, *local_feeds):
-                # decorrelate per-shard random ops (dropout masks)
+            def local(t_shards, res_rows, *local_feeds):
+                # decorrelate per-shard random ops (dropout masks) —
+                # the dp index ONLY: mp replicas must draw identical
+                # masks so the full mp grad stays mp-invariant
                 k_local = jax.random.fold_in(
                     rng_key, jax.lax.axis_index(DP_AXIS))
+                # forward prefetch: gather each sharded param over its
+                # axis in gplan.gathers order (reverse backward
+                # production = forward order), so a layer's all-gather
+                # is issued ahead of that layer's forward and the
+                # scheduler can overlap it with earlier compute.  The
+                # gathers run BEFORE differentiation: grads are taken
+                # w.r.t. the full gathered values, so AD never
+                # transposes the gather into its own (unquantized,
+                # unaccounted) reduce-scatter
+                t_full = {}
+                for gth in gplan.gathers:
+                    k = gth["index"]
+                    t_full[k] = _gc.gather_param(
+                        t_shards[k], gth["axis"], gth["size"],
+                        dim=gth["dim"], ring=ring_gather)
                 # differentiate w.r.t. device-VARYING copies: grads
                 # stay local, the ONLY reduction is grad_comm's below
-                t_var = [pvary(a, DP_AXIS) for a in t_arrays]
+                t_var = [pvary(t_full.get(k, a), DP_AXIS)
+                         for k, a in enumerate(t_shards)]
 
                 def loss_of(tlist):
                     full = list(p_arrays)
@@ -1244,6 +1293,19 @@ class Executor:
                         residuals=res_arg)
                     sleaves = ()
                 del loss
+                # mp params: the reduced grad is the FULL mp-invariant
+                # tensor — each rank keeps its own chunk, the out_spec
+                # (the param's own spec) reassembles.  FSDP grads
+                # already left reduce_gradients as dim-0 shards.
+                from ..distributed.mesh import MP_AXIS
+                grads = list(grads)
+                for k, gth in gkind.items():
+                    if gth["axis"] != MP_AXIS:
+                        continue
+                    g, d = grads[k], gth["dim"]
+                    sh = g.shape[d] // gth["size"]
+                    grads[k] = jax.lax.dynamic_slice_in_dim(
+                        g, jax.lax.axis_index(MP_AXIS) * sh, sh, d)
                 outs = []
                 for name, rule in zip(fetch_names, fetch_rules):
                     v = env[name]
@@ -1252,16 +1314,19 @@ class Executor:
                 return (tuple(outs), tuple(grads),
                         tuple(r[None] for r in new_res), sleaves)
 
+            t_specs = tuple(plan.param_spec(i) for i in t_idx)
             fetch_vals, grads, new_res, sleaves = shard_map(
                 local, mesh=mesh,
-                in_specs=((tuple(P(DP_AXIS) for _ in residuals),)
+                in_specs=((t_specs,)
+                          + (tuple(P(DP_AXIS) for _ in residuals),)
                           + feed_specs),
                 out_specs=(tuple(P(DP_AXIS) if r == "batch" else P()
                                  for r in fetch_rules),
-                           tuple(P() for _ in t_idx),
+                           t_specs,
                            tuple(P(DP_AXIS) for _ in residuals),
                            (P(), P(), P(), P()) if sentry else ()),
-                check_vma=False)(residuals, *feed_arrays)
+                check_vma=False)(tuple(t_arrays), residuals,
+                                 *feed_arrays)
 
             new_t, new_s = opt.functional_update(
                 t_arrays, list(grads), opt_state, lr, step_i,
@@ -1328,8 +1393,11 @@ class Executor:
                                   f"bucket.{i}.scales"))
         compiled._graph_corrupts = _fault.graph_corrupt_sites(sites)
         compiled._gc_plan = gplan
-        compiled._residual_shapes = [(dp, b.numel)
-                                     for b in gplan.residual_buckets]
+        # rscatter (FSDP) buckets carry their residual over the
+        # shard-major padded flat — bucket_flat_numel, not numel
+        compiled._residual_shapes = [
+            (dp, _gc.bucket_flat_numel(b, dp, gplan.cfg.block_size))
+            for b in gplan.residual_buckets]
         # residuals are only meaningful for the exact bucket layout they
         # were accumulated under: a knob recompile (overlap flip, dtype
         # change, re-bucketing) re-zeroes them even when the flat shapes
@@ -1350,11 +1418,29 @@ class Executor:
                                b.collectives))
             stat_items.append((f"comm.algo.{b.algorithm}.wire_bytes",
                                b.wire_bytes))
+        # per-mesh-axis accounting (hybrid meshes): grad buckets + dp
+        # param gathers ride 'dp', mp param gathers ride 'mp' — same
+        # dict the cost model predicts and shardcheck audits, so
+        # measured == predicted holds on EVERY axis
+        for ax in sorted(gplan.axis_wire_bytes):
+            stat_items.append((f"comm.axis.{ax}.wire_bytes",
+                               gplan.axis_wire_bytes[ax]))
+        if gplan.gathers:
+            stat_items.append(("comm.gather.wire_bytes",
+                               gplan.gather_wire_bytes_per_step))
+            stat_items.append(("comm.gather.collectives",
+                               len(gplan.gathers)))
         compiled._comm_stats = stat_items
         # the bucket schedule (size, algo, wire, issue point) + resolved
         # overlap path ride the compile record so overlap decisions are
         # auditable from explain_compiles()
         compiled._comm_record = gplan.schedule()
+        # hybrid lowering attribution: mp param gathers compile the
+        # whole-layer all_gather+matmul composite into this step (the
+        # per-chunk Pallas form is ops/collective_matmul's opt-in for
+        # custom layers) — ride kernels= like every tier selection
+        if any(g["axis"] != DP_AXIS for g in gplan.gathers):
+            compiled._pallas_kernels = ["collective_matmul[composite]"]
         return compiled
 
     def _build(self, program: Program, params, feed_names, fetch_names,
@@ -1450,10 +1536,13 @@ class Executor:
 
         # -- grad_comm: explicit quantized/bucketed gradient collectives --
         # When the plan carries a grad_comm spec (strategy.grad_comm /
-        # fp16_allreduce through fleet) on a multi-device pure-dp mesh,
-        # the loss+backward runs inside a shard_map over dp and the
-        # gradient reduction is OURS: bucketed, quantized, with the
-        # error-feedback residual carried in the donated aux tree.
+        # fp16_allreduce through fleet) on a multi-device {dp} or
+        # {dp, mp} mesh, the loss+backward runs inside a shard_map over
+        # the whole mesh and the gradient reduction is OURS: bucketed,
+        # quantized, with the error-feedback residual carried in the
+        # donated aux tree.  FSDP/ZeRO-3 params stay sharded at rest
+        # (gathered ahead of forward, grads reduce-scattered back);
+        # mp-sharded params gather over mp in production order.
         gplan = None
         if plan is not None and plan.grad_comm is not None:
             gplan = self._grad_comm_plan(program, plan, params, t_idx,

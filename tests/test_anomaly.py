@@ -284,6 +284,52 @@ def test_sentry_int8_ef_skip_oracle(sentry_on):
         paddle.static.reset_default_programs()
 
 
+def test_sentry_skip_oracle_hybrid_mesh(sentry_on):
+    """ISSUE 17 satellite: the skip oracle holds on a {dp:4, mp:2}
+    mesh with an mp-sharded weight — the flagged step is still a
+    proven bitwise no-op on params AND EF residuals.  The hybrid
+    buckets' device-varying scan contributions are psum'd inside
+    reduce_gradients, so the flag stays mesh-agreed across both axes."""
+    paddle.enable_static()
+    try:
+        rng = np.random.RandomState(4)
+        b1 = _int8_feed(rng)
+        b2 = _int8_feed(rng)
+        bad = (np.full_like(b1[0], np.nan), b1[1])
+        mesh_shape = {"dp": 4, "mp": 2}
+
+        def run_sequence(batches):
+            init_mesh(mesh_shape)
+            main, loss = _int8_program()
+            wname = next(p.name for p in main.parameters()
+                         if p.data.shape == (8, 8))
+            main._sharding_rules = [(wname, (None, "mp")), (r".*", ())]
+            init_mesh(mesh_shape)
+            exe = paddle.static.Executor()
+            for xs, ys in batches:
+                exe.run(main, feed={"x": xs, "y": ys},
+                        fetch_list=[loss])
+            sen = exe.sentry_stats(main)
+            state = exe._states[main._serial]
+            out = ([np.asarray(a) for a in state.p_arrays],
+                   [np.asarray(a) for a in state.aux["grad_comm"]],
+                   int(np.asarray(state.aux["step"])), sen)
+            exe.close()
+            paddle.static.reset_default_programs()
+            return out
+
+        p_ref, r_ref, step_ref, sen_ref = run_sequence([b1, b2])
+        p_got, r_got, step_got, sen_got = run_sequence([b1, bad, b2])
+        assert step_got == step_ref == 2
+        assert sen_ref["skipped_steps"] == 0
+        assert sen_got["skipped_steps"] == 1
+        assert all(np.array_equal(a, b) for a, b in zip(p_got, p_ref))
+        assert all(np.array_equal(a, b) for a, b in zip(r_got, r_ref))
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
 def test_ef_residuals_ride_snapshot_rollback(sentry_on, tmp_path):
     """Same-mesh rollback restores the error-feedback carry bitwise
     (reshard restores keep starting from a fresh carry)."""

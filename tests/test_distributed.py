@@ -1050,6 +1050,108 @@ def test_grad_comm_error_feedback_accumulation_identity():
     assert err_plain > 3 * err_ef, (err_plain, err_ef)
 
 
+def test_grad_comm_overlap_axis_matrix_recompiles_as_new_sharding():
+    """Satellite: the overlap-knob × mesh-axis matrix — pure dp,
+    hybrid {dp, mp} with an mp-sharded weight, and ZeRO-3 — each knob
+    flip is exactly ONE recompile attributed 'new_sharding' on every
+    axis layout."""
+    from paddle_tpu.observability import explain_compiles
+    paddle.enable_static()
+    try:
+        rng = np.random.RandomState(2)
+        xs = rng.standard_normal((64, 8)).astype(np.float32)
+        ys = (xs @ rng.standard_normal((8, 1))).astype(np.float32)
+        feed = {"x": xs, "y": ys}
+        gc = {"dtype": "int8", "scatter_threshold_KB": 0.01,
+              "block_size": 64, "overlap": "auto"}
+        for mesh_shape, mp_rule, zero3 in (
+                ({"dp": 8}, False, False),
+                ({"dp": 4, "mp": 2}, True, False),
+                ({"dp": 8}, False, True)):
+            init_mesh(mesh_shape)
+            paddle.seed(7)
+            main, loss = _grad_comm_fc_program(gc, zero3=zero3)
+            if mp_rule:
+                wname = next(p.name for p in main.parameters()
+                             if len(p.data.shape) == 2)
+                main._sharding_rules = [(wname, ("mp", None)),
+                                        (r".*", ())]
+            init_mesh(mesh_shape)
+            exe = paddle.static.Executor()
+            exe.run(main, feed=feed, fetch_list=[loss])
+            assert exe.compile_count == 1
+            strat2 = dist.DistributedStrategy()
+            strat2.grad_comm = dict(gc, overlap="ring")
+            if zero3:
+                strat2.sharding = True
+                strat2.sharding_configs = {"stage": 3,
+                                           "min_shard_numel": 1}
+            main._optimizer[0]._dist_strategy = strat2
+            exe.run(main, feed=feed, fetch_list=[loss])
+            assert exe.compile_count == 2, (mesh_shape, zero3)
+            recs = [r for r in explain_compiles("executor")["records"]
+                    if r["identity"] == main._serial]
+            assert recs[-1]["cause"] == "new_sharding"
+            exe.close()
+            paddle.static.reset_default_programs()
+    finally:
+        paddle.disable_static()
+        paddle.static.reset_default_programs()
+
+
+def test_collective_matmul_composite_bitwise_oracles():
+    """The fused compute-collective lowerings vs their unfused oracles,
+    bitwise at fp32: column-parallel all_gather_matmul == gather-then-
+    matmul, row-parallel matmul_reduce_scatter == psum + row slice —
+    on both the ring and fused forms."""
+    from paddle_tpu.core.jax_compat import shard_map
+    from paddle_tpu.ops.collective_matmul import (all_gather_matmul,
+                                                  matmul_reduce_scatter)
+    size, m, k, n = 8, 16, 8, 32
+    mesh = dist.get_mesh()
+    rng = np.random.RandomState(9)
+    x = jnp.asarray(rng.standard_normal((m, k)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((k, n)).astype(np.float32))
+    want = np.asarray(x @ w)
+
+    # column-parallel: w sharded on its output dim over 'dp'
+    for ring in (True, False):
+        def col(wv, ring=ring):
+            return all_gather_matmul(x, wv, "dp", size, ring=ring)
+        got = shard_map(col, mesh=mesh, in_specs=(P(None, "dp"),),
+                        out_specs=P(), check_vma=False)(w)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    # row-parallel: x sharded on K, w on its input dim; the unfused
+    # oracle psums partials then slices rows — must be bitwise
+    def oracle(xv, wv):
+        full = jax.lax.psum(jnp.matmul(xv, wv), "dp")
+        i = jax.lax.axis_index("dp")
+        return jax.lax.dynamic_slice_in_dim(full, i * (m // size),
+                                            m // size, 0)
+    want_rows = shard_map(oracle, mesh=mesh,
+                          in_specs=(P(None, "dp"), P("dp")),
+                          out_specs=P("dp"), check_vma=False)(x, w)
+    for ring in (True, False):
+        def row(xv, wv, ring=ring):
+            return matmul_reduce_scatter(xv, wv, "dp", size, ring=ring)
+        got = shard_map(row, mesh=mesh,
+                        in_specs=(P(None, "dp"), P("dp")),
+                        out_specs=P("dp"), check_vma=False)(x, w)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want_rows))
+        # vs the single-device matmul only APPROXIMATELY: psum of 8
+        # rank partials is a different fp32 accumulation order
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+    # shape gate: a non-divisible row count raises, actionably
+    with pytest.raises(ValueError, match="not divisible"):
+        def bad(xv, wv):
+            return matmul_reduce_scatter(xv[:5], wv, "dp", size)
+        shard_map(bad, mesh=mesh, in_specs=(P(None, "dp"), P("dp")),
+                  out_specs=P("dp"), check_vma=False)(x, w)
+
+
 def _grad_comm_fc_program(gc=None, zero3=False):
     import paddle_tpu.nn.functional as F
     from paddle_tpu import optimizer
@@ -1135,24 +1237,96 @@ def test_grad_comm_executor_parity_wire_stats_and_prediction():
         paddle.static.reset_default_programs()
 
 
-def test_grad_comm_executor_rejects_sharded_params():
-    """grad_comm + ZeRO-3 (dp-sharded params) must fail loudly at
-    compile — the shard_map grad path would replicate the shards."""
+def test_grad_comm_fsdp_fp32_bitwise_parity_vs_gathered():
+    """ISSUE 17 tentpole: grad_comm + ZeRO-3 now composes — and at
+    fp32 wire the FSDP reduce-scatter path is BITWISE the gathered dp
+    path (losses and trained params), because reduce-scatter reproduces
+    psum's ascending reduction order and Adam updates shards
+    elementwise."""
     paddle.enable_static()
     try:
-        init_mesh({"dp": 8})
-        main, loss = _grad_comm_fc_program({"dtype": "int8"}, zero3=True)
-        init_mesh({"dp": 8})
-        exe = paddle.static.Executor()
-        rng = np.random.RandomState(0)
-        feed = {"x": rng.standard_normal((64, 8)).astype(np.float32),
-                "y": rng.standard_normal((64, 1)).astype(np.float32)}
-        with pytest.raises(NotImplementedError, match="dp-sharded"):
-            exe.run(main, feed=feed, fetch_list=[loss])
-        exe.close()
+        rng = np.random.RandomState(5)
+        xs = rng.standard_normal((64, 8)).astype(np.float32)
+        ys = (xs @ rng.standard_normal((8, 1))).astype(np.float32)
+        feed = {"x": xs, "y": ys}
+        got = {}
+        for zero3 in (False, True):
+            init_mesh({"dp": 8})
+            paddle.seed(11)
+            main, loss = _grad_comm_fc_program(
+                {"dtype": "fp32", "scatter_threshold_KB": 0.0},
+                zero3=zero3)
+            init_mesh({"dp": 8})
+            exe = paddle.static.Executor()
+            losses = [float(exe.run(main, feed=feed,
+                                    fetch_list=[loss])[0])
+                      for _ in range(5)]
+            assert exe.compile_count == 1
+            state = exe._states[main._serial]
+            if zero3:
+                # the weight actually lives sharded at rest
+                assert any("dp" in str(a.sharding.spec)
+                           for a in state.p_arrays)
+            params = {k: np.asarray(v).copy() for k, v in
+                      exe.sharded_state(main)._getter()["params"]
+                      .items()}
+            got[zero3] = (losses, params)
+            exe.close()
+            paddle.static.reset_default_programs()
+        np.testing.assert_array_equal(got[False][0], got[True][0])
+        for k in got[False][1]:
+            np.testing.assert_array_equal(got[False][1][k],
+                                          got[True][1][k])
     finally:
         paddle.disable_static()
         paddle.static.reset_default_programs()
+
+
+def test_grad_comm_fsdp_int8_ef_residual_telescoping():
+    """Per-shard error feedback on the FSDP rscatter route telescopes
+    exactly like the gathered route: T steps of int8 reduce-scatter
+    with EF stay within a one-step quantization bound of the true
+    running mean, EF-off drifts ~T times further."""
+    from paddle_tpu.core.jax_compat import shard_map
+    dp, n, T = 8, 96, 24
+    mesh = dist.get_mesh()
+    plan = gcx.plan_reduction([(n,)], dp=dp, cfg=_spec(block=32),
+                              fsdp=(0,))
+    b = plan.buckets[0]
+    assert b.algorithm == "rscatter" and b.wire_dtype == "int8"
+    flat_n = gcx.bucket_flat_numel(b, dp, plan.cfg.block_size)
+    rng = np.random.RandomState(3)
+    g = jnp.asarray(rng.standard_normal((dp, n)).astype(np.float32))
+    true_mean = np.asarray(g).mean(0)
+
+    def one(res_rows, g_rows, use_res):
+        def local(r, gr):
+            res = [r[0]] if use_res else None
+            out, new_res = gcx.reduce_gradients(
+                [gr[0]], plan=plan, residuals=res)
+            nr = (new_res[0] if use_res
+                  else jnp.zeros((flat_n,), jnp.float32))
+            # out[0] is MY (n/dp,) shard; P("dp") reassembles it
+            return out[0], nr[None]
+        return shard_map(local, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                         out_specs=(P("dp"), P("dp")),
+                         check_vma=False)(res_rows, g_rows)
+
+    for use_res in (True, False):
+        res = jnp.zeros((dp, flat_n), jnp.float32)
+        applied = np.zeros(n, np.float64)
+        for _ in range(T):
+            red, res = one(res, g, use_res)
+            assert red.shape == (n,)
+            applied += np.asarray(red, np.float64)
+        err = np.abs(applied - T * true_mean).max()
+        if use_res:
+            err_ef = err
+        else:
+            err_plain = err
+    one_step = float(np.abs(np.asarray(g)).max()) / 127.0
+    assert err_ef < 2 * one_step, err_ef
+    assert err_plain > 3 * err_ef, (err_plain, err_ef)
 
 
 def test_fp16_allreduce_alias_equals_grad_comm_bf16():

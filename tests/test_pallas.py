@@ -388,6 +388,81 @@ def test_paged_decode_gate():
 
 
 # ---------------------------------------------------------------------------
+# collective-matmul chunk kernel (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def test_chunk_matmul_kernel_vs_matmul():
+    from paddle_tpu.ops.pallas.collective_matmul import chunk_matmul
+    r = np.random.RandomState(4)
+    for m, k, nc in [(16, 128, 128), (256, 256, 128)]:
+        x = jnp.asarray(r.standard_normal((m, k)), jnp.float32)
+        w = jnp.asarray(r.standard_normal((k, nc)), jnp.float32)
+        got = chunk_matmul(x, w, interpret=True)
+        assert got.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(got), np.asarray(x @ w),
+                                   rtol=1e-6)
+    # bf16 operands accumulate in f32, cast back on the way out
+    xb = jnp.asarray(r.standard_normal((16, 128)), jnp.bfloat16)
+    wb = jnp.asarray(r.standard_normal((128, 128)), jnp.bfloat16)
+    assert chunk_matmul(xb, wb, interpret=True).dtype == jnp.bfloat16
+
+
+def test_chunk_matmul_gate():
+    from paddle_tpu.ops.pallas.collective_matmul import \
+        chunk_matmul_supported
+    f32 = jnp.float32
+    assert chunk_matmul_supported((16, 128), (128, 128), f32, f32)
+    assert not chunk_matmul_supported((15, 128), (128, 128), f32, f32)
+    assert not chunk_matmul_supported((16, 100), (100, 128), f32, f32)
+    assert not chunk_matmul_supported((16, 128), (128, 100), f32, f32)
+    assert not chunk_matmul_supported((16, 128), (128, 128),
+                                      jnp.int32, f32)
+    assert not chunk_matmul_supported((2, 16, 128), (128, 128), f32, f32)
+    assert not chunk_matmul_supported((16, 128), (64, 128), f32, f32)
+
+
+def test_collective_matmul_tier_selection_contract():
+    """Tier off -> the composite jnp.matmul path, ZERO Pallas
+    selections; tier on (interpret opt-in) with qualifying chunk shapes
+    -> the chunk kernel is selected and counted, results matching the
+    composite."""
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.core.jax_compat import shard_map
+    from paddle_tpu.ops.collective_matmul import (all_gather_matmul,
+                                                  lowering_label)
+    from paddle_tpu.ops.pallas.support import kernel_selections
+    dist.init_mesh({"dp": 8})
+    mesh = dist.get_mesh()
+    r = np.random.RandomState(6)
+    x = jnp.asarray(r.standard_normal((16, 128)), jnp.float32)
+    w = jnp.asarray(r.standard_normal((128, 1024)), jnp.float32)
+
+    def run():
+        def col(wv):
+            return all_gather_matmul(x, wv, "dp", 8, ring=True)
+        return np.asarray(shard_map(col, mesh=mesh,
+                                    in_specs=(P(None, "dp"),),
+                                    out_specs=P(), check_vma=False)(w))
+
+    set_flags({"use_pallas_kernels": False})
+    try:
+        before = dict(kernel_selections)
+        off = run()
+        assert dict(kernel_selections) == before
+        assert lowering_label() == "composite"
+        set_flags({"use_pallas_kernels": True, "pallas_interpret": True})
+        assert lowering_label() == "pallas"
+        on = run()
+        assert kernel_selections.get("collective_matmul", 0) \
+            > before.get("collective_matmul", 0)
+    finally:
+        set_flags({"pallas_interpret": False,
+                   "use_pallas_kernels": True})
+    np.testing.assert_allclose(on, off, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
 # executor fusion pass: selection, fallback, OFF contract
 # ---------------------------------------------------------------------------
 

@@ -709,30 +709,58 @@ def _static_errors(main, loss, plan):
             and d.pass_name.startswith("shard-")]
 
 
-def test_zero3_grad_comm_static_matches_runtime_cause():
+def test_zero3_grad_comm_static_and_runtime_both_accept():
+    """ISSUE 17: ZeRO-3 + grad_comm is first-class — shardcheck accepts
+    it (with a wire audit covering the reduce-scatter route) and the
+    Executor trains it, string-for-string with nothing to raise."""
     main, loss = _fleet_fc_program({"dtype": "int8"}, zero3=True)
     exe = paddle.static.Executor()
     plan = exe._plan_for(main, main.parameters())
-    errs = _static_errors(main, loss, plan)
-    assert len(errs) == 1 and "dp-sharded" in errs[0].message
-    with pytest.raises(NotImplementedError) as ei:
-        exe.run(main, feed=_fc_feed(), fetch_list=[loss])
-    assert str(ei.value) == errs[0].message  # SAME cause string
+    assert _static_errors(main, loss, plan) == []
+    diags = analysis.check(main, fetch_list=[loss], sharding=plan)
+    audits = [d for d in diags if d.pass_name == "shard-wire"
+              and d.severity == "info"]
+    assert len(audits) == 1 and "gather(s)" in audits[0].message
+    chor = [d.message for d in diags
+            if d.pass_name == "shard-choreography"
+            and d.severity == "info"]
+    assert any("rscatter" in m for m in chor)
+    assert any("hybrid choreography" in m for m in chor)
+    l0, = exe.run(main, feed=_fc_feed(), fetch_list=[loss])
+    assert np.isfinite(l0).all()
+    assert exe.compile_count == 1
     exe.close()
 
 
 def test_non_pure_dp_mesh_static_matches_runtime_cause():
+    # {dp, mp} meshes are now first-class; a pp axis still rejects —
+    # statically and at runtime with the SAME cause string.
     main, loss = _fleet_fc_program({"dtype": "int8"},
-                                   mesh_shape={"dp": 4, "mp": 2})
+                                   mesh_shape={"dp": 4, "pp": 2})
     exe = paddle.static.Executor()
     plan = exe._plan_for(main, main.parameters())
     errs = _static_errors(main, loss, plan)
     assert len(errs) == 1
     # satellite: the shared formatter names the axis AND the degree
-    assert "mp=2" in errs[0].message
+    assert "pp=2" in errs[0].message
+    assert "cross-stage" in errs[0].message
     with pytest.raises(NotImplementedError) as ei:
         exe.run(main, feed=_fc_feed(), fetch_list=[loss])
     assert str(ei.value) == errs[0].message
+    exe.close()
+
+
+def test_hybrid_mesh_static_and_runtime_both_accept():
+    """The lifted restriction, string-for-string in the accepting
+    direction: a {dp:4, mp:2} mesh lints clean and runs."""
+    main, loss = _fleet_fc_program({"dtype": "int8"},
+                                   mesh_shape={"dp": 4, "mp": 2})
+    exe = paddle.static.Executor()
+    plan = exe._plan_for(main, main.parameters())
+    assert _static_errors(main, loss, plan) == []
+    l0, = exe.run(main, feed=_fc_feed(), fetch_list=[loss])
+    assert np.isfinite(l0).all()
+    assert exe.compile_count == 1
     exe.close()
 
 
@@ -774,15 +802,16 @@ def test_shard_verify_preflight_flag():
     """FLAGS_shard_verify: the bad config fails preflight as a
     structured GraphVerificationError carrying the runtime cause; with
     the flag off, the same config reaches the runtime raise."""
-    main, loss = _fleet_fc_program({"dtype": "int8"}, zero3=True)
+    main, loss = _fleet_fc_program({"dtype": "int8"},
+                                   mesh_shape={"dp": 4, "pp": 2})
     exe = paddle.static.Executor()
     paddle.set_flags({"FLAGS_shard_verify": True})
     try:
-        with pytest.raises(GraphVerificationError, match="dp-sharded"):
+        with pytest.raises(GraphVerificationError, match="cross-stage"):
             exe.run(main, feed=_fc_feed(), fetch_list=[loss])
     finally:
         paddle.set_flags({"FLAGS_shard_verify": False})
-    with pytest.raises(NotImplementedError, match="dp-sharded"):
+    with pytest.raises(NotImplementedError, match="cross-stage"):
         exe.run(main, feed=_fc_feed(), fetch_list=[loss])
     exe.close()
 
@@ -804,8 +833,9 @@ def test_shard_verify_clean_config_zero_recompiles():
 
 
 def test_abstract_mesh_lint_zero_devices():
-    """A {dp:4, mp:2} plan lints with no mesh initialised at all: the
-    pure-dp constraint and a non-divisible rule both surface."""
+    """A {dp:4, pp:2} plan lints with no mesh initialised at all: the
+    cross-stage constraint and a non-divisible rule both surface —
+    while the now-first-class {dp:4, mp:2} mesh lints clean."""
     from paddle_tpu import distributed as dist
     from paddle_tpu.static.analysis import parse_mesh_shape
     assert parse_mesh_shape("dp=4,mp=2") == {"dp": 4, "mp": 2}
@@ -822,12 +852,17 @@ def test_abstract_mesh_lint_zero_devices():
     strat = dist.DistributedStrategy()
     strat.grad_comm = {"dtype": "int8"}
     diags = analysis.check(main, fetch_list=[loss],
-                           mesh_shape={"dp": 4, "mp": 2},
+                           mesh_shape={"dp": 4, "pp": 2},
                            strategy=strat)
     msgs = [d.message for d in diags
             if d.pass_name == "shard-choreography"
             and d.severity == "error"]
-    assert len(msgs) == 1 and "pure-dp" in msgs[0] and "mp=2" in msgs[0]
+    assert len(msgs) == 1 and "cross-stage" in msgs[0] \
+        and "pp=2" in msgs[0]
+    diags = analysis.check(main, fetch_list=[loss],
+                           mesh_shape={"dp": 4, "mp": 2},
+                           strategy=strat)
+    assert [d for d in diags if d.severity == "error"] == []
     # non-divisible rule -> WARN naming rule and axis (the fc weight
     # has shape (16, 1): mp=3 divides neither dim)
     wname = next(p.name for p in main.parameters()

@@ -581,3 +581,37 @@ def test_grad_comm_overlap_knob_validation():
         s.grad_comm = {"dtype": "int8", "overlap": ov}
         fps.add(gcx.resolve(s).fingerprint())
     assert len(fps) == 3
+
+
+def test_grad_comm_hybrid_degree_combos_validate():
+    """ISSUE 17: grad_comm now composes with tensor_parallel and
+    ZeRO-3 degree combos at validation time; pp/sp remain rejected
+    with an actionable message; infer_mesh_shape covers the composed
+    cases."""
+    from paddle_tpu.distributed.strategy import validate_toggles
+    # fsdp + mp + grad_comm: accepted, mesh composes {dp, mp}
+    s = DistributedStrategy()
+    s.grad_comm = {"dtype": "int8"}
+    s.sharding = True
+    s.sharding_configs = {"stage": 3, "min_shard_numel": 1}
+    s.tensor_parallel = True
+    s.tensor_parallel_configs = {"tensor_parallel_degree": 2}
+    validate_toggles(s, n_devices=8)
+    assert s.infer_mesh_shape(8) == {"dp": 4, "mp": 2}
+    # pp/sp corners: loud, actionable, name the offending toggle
+    for toggle in ("pipeline", "sequence_parallel"):
+        s = DistributedStrategy()
+        s.grad_comm = {"dtype": "bf16"}
+        setattr(s, toggle, True)
+        with pytest.raises(NotImplementedError, match=toggle):
+            validate_toggles(s)
+    # the alias spelling hits the same guard
+    s = DistributedStrategy()
+    s.fp16_allreduce = True
+    s.pipeline = True
+    with pytest.raises(NotImplementedError, match="cross-stage"):
+        validate_toggles(s)
+    # without grad_comm the same pp strategy validates fine
+    s = DistributedStrategy()
+    s.pipeline = True
+    validate_toggles(s)

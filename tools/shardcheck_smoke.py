@@ -10,11 +10,14 @@ The correctness promises of ``static/analysis/shardcheck.py`` (ISSUE
   ABSTRACT {dp: 4, mp: 2} mesh — the last with zero devices involved,
   which is the whole point;
 - **seeded-defect matrix**: one injected defect per pass family
-  (non-divisible rule spec, grad_comm on a non-pure-dp mesh,
+  (non-divisible rule spec, grad_comm on a pipeline mesh,
   device-varying fetch, corrupted wire formula) produces exactly the
   expected diagnostic — and the choreography error carries the SAME
   cause string ``grad_comm.incompatibility`` builds for the Executor's
-  runtime raise;
+  runtime raise.  ISSUE 17's narrowed rejection is covered from both
+  sides: grad_comm on the abstract {dp:4, mp:2} mesh (rejected before)
+  now verifies clean, while a pp axis and a multi-axis param spec
+  still fail with their shared cause strings;
 - **wire-byte audit closes the triangle**: on all four comm_smoke
   overlap configs (fp32/auto, int8/auto, int8/none, int8/ring) the
   measured ``comm.wire_bytes`` monitor delta == the cost model's
@@ -173,24 +176,54 @@ def check_defect_matrix(problems, verbose):
                         "name the rule that matched")
     paddle.static.reset_default_programs()
 
-    # (b) shard-choreography: grad_comm on a non-pure-dp mesh -> the
-    # EXACT string grad_comm.incompatibility builds (the Executor's
-    # runtime raise and the static diagnostic share one builder)
+    # (b) shard-choreography: grad_comm on a pp mesh (cross-stage
+    # collectives) -> the EXACT string grad_comm.incompatibility
+    # builds in its hybrid form (the Executor's runtime raise and the
+    # static diagnostic share one builder).  ISSUE 17 narrowed this
+    # rejection: {dp, mp} meshes and FSDP/mp shards are now legal, so
+    # the genuinely-bad config is a pipeline axis.
     main, loss = _tiny_program()
     strat = dist.DistributedStrategy()
     strat.grad_comm = {"dtype": "int8", "error_feedback": True,
                        "block_size": 256}
     cfg = _gc.resolve(strat)
-    want = _gc.incompatibility(cfg, {"dp": 4, "mp": 2})
+    want = _gc.incompatibility(cfg, {"dp": 4, "pp": 2}, hybrid=True)
+    diags = analysis.check(main, fetch_list=[loss],
+                           mesh_shape={"dp": 4, "pp": 2},
+                           strategy=strat)
+    expect("choreography/pp-mesh", diags, "shard-choreography",
+           "error", "", exact=want)
+    if want is None or "pp=2" not in (want or ""):
+        problems.append("defect[choreography/pp-mesh]: the shared "
+                        "formatter does not name the axis+degree "
+                        "(expected 'pp=2' in the cause)")
+    paddle.static.reset_default_programs()
+
+    # (b-legal) the narrowed rejection's flip side: the SAME grad_comm
+    # strategy on the abstract {dp:4, mp:2} mesh — rejected before
+    # ISSUE 17 — now verifies with zero errors
+    main, loss = _tiny_program()
     diags = analysis.check(main, fetch_list=[loss],
                            mesh_shape={"dp": 4, "mp": 2},
                            strategy=strat)
-    expect("choreography/non-pure-dp", diags, "shard-choreography",
-           "error", "", exact=want)
-    if want is None or "mp=2" not in (want or ""):
-        problems.append("defect[choreography/non-pure-dp]: the shared "
-                        "formatter does not name the axis+degree "
-                        "(expected 'mp=2' in the cause)")
+    newly_bad = [d for d in _shard_diags(diags)
+                 if d.severity == "error"]
+    if newly_bad:
+        problems.append(f"defect[choreography/hybrid-now-legal]: "
+                        f"grad_comm on the abstract {{dp:4, mp:2}} "
+                        f"mesh must verify clean after ISSUE 17, got: "
+                        f"{newly_bad[0]}")
+    elif verbose:
+        print("  defect[choreography/hybrid-now-legal]: grad_comm + "
+              "{dp:4, mp:2} verifies clean (restriction lifted)")
+    # an unsupported param spec still rejects, with the spec named
+    bad_spec = _gc.incompatibility(
+        cfg, {"dp": 4, "mp": 2},
+        [("w_0", ("dp", "mp"))], hybrid=True)
+    if not bad_spec or "fit neither form" not in bad_spec:
+        problems.append("defect[choreography/bad-spec]: a multi-axis "
+                        "param spec must still reject with the "
+                        "'fit neither form' cause")
     paddle.static.reset_default_programs()
 
     # (b2) shard-choreography: SUM-reduced loss under the dp-mean
@@ -224,7 +257,11 @@ def check_defect_matrix(problems, verbose):
     finally:
         _gc._wire_bytes = real
     expect("wire/conservation", diags, "shard-wire", "error",
-           "wire-byte conservation violated")
+           "wire-byte conservation violated: bucket")
+    # the ISSUE-17 per-axis ledger is an independent gate over the
+    # same corruption: the per-axis schedule must disagree too
+    expect("wire/per-axis-conservation", diags, "shard-wire", "error",
+           "wire-byte conservation violated: per-axis schedule")
     paddle.static.reset_default_programs()
 
 
@@ -373,8 +410,9 @@ def main(argv=None) -> int:
     print("shardcheck_smoke OK: GPT/BERT-tiny verify clean on mesh "
           "{1}, {dp:8} and abstract {dp:4,mp:2} (zero devices); every "
           "seeded defect produced exactly its expected diagnostic "
-          "with the Executor's own cause string; measured == "
-          "predicted == audited wire bytes on all four overlap "
+          "with the Executor's own cause string (pp mesh + bad spec "
+          "still reject, hybrid {dp,mp} now verifies clean); measured "
+          "== predicted == audited wire bytes on all four overlap "
           "configs; lint --format json round-trips the diagnostics")
     return 0
 
