@@ -33,7 +33,8 @@ from .sharding import (ShardedState, ShardingPlan,  # noqa: F401
                        SpecLayout, gather_tree, match_partition_rules,
                        plan_for_params, shard_tree, spec_divisor,
                        specs_for_state, with_constraint)
-from .supervisor import (StepWatchdog, SupervisorGaveUp,  # noqa: F401
+from .supervisor import (ProcessSupervisor, ServingSupervisor,  # noqa: F401
+                         StepWatchdog, SupervisorGaveUp,
                          SupervisorResult, TrainingSupervisor)
 
 

@@ -50,6 +50,20 @@ must precede ``import jax`` (``XLA_FLAGS``, ``JAX_PLATFORMS``,
 ``FLAGS_fault_spec``) reach the child; a callable ``child_env`` receives
 the attempt index, which is how chaos drills shrink the mesh between
 restarts.
+
+The spawn/heartbeat/backoff/crash-budget core is lifecycle-agnostic and
+lives in :class:`ProcessSupervisor`; what varies by workload is only the
+*liveness policy* (:meth:`ProcessSupervisor._check_liveness`).
+:class:`TrainingSupervisor` keeps the original step-deadline policy.
+:class:`ServingSupervisor` supervises a serving replica instead: the
+child's engines stamp a heartbeat per dispatched batch/decode step (the
+same one-None-check ``obs_hook`` pattern), the parent probes readiness
+over the replica's ``/healthz``, and a replica whose HTTP plane stops
+answering *and* whose dispatch beats went stale is declared hung — an
+idle-but-responsive replica is never killed for quiet traffic.  Warm
+restarts readmit traffic only once ``/healthz`` turns ready again (the
+entrypoint re-warms its buckets before flipping readiness), which the
+parent observes as a ``ready`` transition.
 """
 from __future__ import annotations
 
@@ -66,8 +80,9 @@ from ..core import obs_hook
 from ..utils import monitor
 
 __all__ = ["Heartbeat", "HeartbeatReader", "HeartbeatWriter",
-           "StepWatchdog", "SupervisorGaveUp", "SupervisorResult",
-           "TrainingSupervisor", "current_heartbeat"]
+           "ProcessSupervisor", "ServingSupervisor", "StepWatchdog",
+           "SupervisorGaveUp", "SupervisorResult", "TrainingSupervisor",
+           "current_heartbeat"]
 
 
 # ---------------------------------------------------------------------------
@@ -316,20 +331,30 @@ class _patched_env:
         return False
 
 
-class TrainingSupervisor:
+class ProcessSupervisor:
     """Run ``entry(*args, **kwargs)`` in a supervised child process and
     keep it alive until it exits cleanly, the restart budget runs out,
     or :meth:`stop` is called.
 
     ``entry`` must be picklable (module-level callable) under the
     chosen start method.  The entrypoint owns resume semantics: on every
-    (re)start it should re-detect devices and restore from its snapshot
-    store — the supervisor guarantees only *that* it runs again, with
-    backoff, and that wedged incarnations die.
+    (re)start it should re-detect its environment and restore from its
+    durable state — the supervisor guarantees only *that* it runs again,
+    with backoff, and that wedged incarnations die.
+
+    Subclasses specialise the *liveness policy* by overriding
+    :meth:`_check_liveness` (and per-attempt state via
+    :meth:`_attempt_reset`); spawn, kill escalation, backoff, the crash
+    budget, exit history and the flight dumps are shared.
+    ``stat_ns`` namespaces the monitor counters (``supervisor.*`` for
+    training — the original namespace — ``supervisor.serving.*`` for
+    replicas).
     """
 
+    stat_ns = "supervisor"
+
     def __init__(self, entry: Callable, args: Sequence = (), kwargs=None,
-                 *, name: str = "train",
+                 *, name: str = "job",
                  watchdog: Optional[StepWatchdog] = None,
                  startup_timeout_s: Optional[float] = 300.0,
                  hang_grace_s: float = 10.0,
@@ -367,7 +392,7 @@ class TrainingSupervisor:
 
     # -- observability -----------------------------------------------------
     def _stat(self, suffix: str, v=1) -> None:
-        monitor.stat_add(f"supervisor.{suffix}", v)
+        monitor.stat_add(f"{self.stat_ns}.{suffix}", v)
 
     def _emit(self, action: str, **args) -> None:
         trc = obs_hook._tracer
@@ -450,8 +475,9 @@ class TrainingSupervisor:
         # 'never beat' and 'stopped beating mid-step' are different
         # diagnoses (environment/startup vs collective deadlock) —
         # keep their counters distinct for whoever alerts on them
-        self._stat("hang_kills" if reason == "hang"
-                   else "startup_timeouts")
+        self._stat({"hang": "hang_kills",
+                    "startup_timeout": "startup_timeouts"}.get(
+                        reason, f"{reason}_kills"))
         self._emit("kill", reason=reason, attempt=attempt,
                    step=None if hb is None else hb.step,
                    deadline_s=round(deadline, 3))
@@ -461,6 +487,35 @@ class TrainingSupervisor:
         if proc.exitcode is None:
             proc.kill()
             proc.join()
+
+    # -- liveness policy (the subclass hook) --------------------------------
+    def _attempt_reset(self) -> None:
+        """Per-child-start state reset (a restarted child recompiles /
+        re-warms from scratch — stale per-attempt judgments must not
+        carry over)."""
+        self.watchdog.reset()
+
+    def _check_liveness(self, hb: Optional[Heartbeat], seen_step: bool,
+                        started: float) -> Optional[str]:
+        """One poll's verdict on the running child: a kill reason
+        (``"hang"`` / ``"startup_timeout"`` / policy-specific) or None
+        while the child is considered live.  Default policy: the
+        training step watchdog."""
+        if not seen_step:
+            # startup phase: THIS child has produced no step beat yet
+            # (birth beat is step -1) — it is importing, restoring, or
+            # compiling, and the step-scale watchdog deadline does not
+            # apply (restarted children recompile from scratch; the
+            # retained interval window must not kill them)
+            if (self.startup_timeout_s is not None
+                    and time.monotonic() - started
+                    > self.startup_timeout_s):
+                return "startup_timeout"
+            return None
+        deadline = self.watchdog.deadline_s()
+        if time.time() - hb.time > deadline:
+            return "hang"
+        return None
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> SupervisorResult:
@@ -491,7 +546,7 @@ class TrainingSupervisor:
             self._stat("starts")
             self._emit("start", attempt=attempt, pid=proc.pid,
                        env={k: str(v) for k, v in env.items()})
-            self.watchdog.reset()
+            self._attempt_reset()
             reader = HeartbeatReader(hb_path)
             started = time.monotonic()
             kill_reason = None
@@ -510,22 +565,9 @@ class TrainingSupervisor:
                     self.watchdog.observe(fresh)
                     if fresh.step >= 0:
                         seen_step = True
-                if not seen_step:
-                    # startup phase: THIS child has produced no step
-                    # beat yet (birth beat is step -1) — it is
-                    # importing, restoring, or compiling, and the
-                    # step-scale watchdog deadline does not apply
-                    # (restarted children recompile from scratch; the
-                    # retained interval window must not kill them)
-                    if (self.startup_timeout_s is not None
-                            and time.monotonic() - started
-                            > self.startup_timeout_s):
-                        kill_reason = "startup_timeout"
-                        break
-                    continue
-                deadline = self.watchdog.deadline_s()
-                if time.time() - hb.time > deadline:
-                    kill_reason = "hang"
+                reason = self._check_liveness(hb, seen_step, started)
+                if reason is not None:
+                    kill_reason = reason
                     break
             stopped = self._stop.is_set()
             if kill_reason == "stopped":
@@ -630,3 +672,124 @@ class TrainingSupervisor:
                     clean_exit=False, stopped=True, attempts=attempt,
                     restarts=attempt - 1, hang_kills=hang_kills,
                     exit_history=self.exit_history)
+
+
+class TrainingSupervisor(ProcessSupervisor):
+    """Supervise a *training* entrypoint: liveness is the per-step
+    deadline from :class:`StepWatchdog` over the Executor's heartbeats
+    (the original PR-12 policy, inherited unchanged from
+    :class:`ProcessSupervisor`'s default ``_check_liveness``).  Stats
+    stay in the original ``supervisor.*`` namespace."""
+
+    def __init__(self, entry: Callable, args: Sequence = (), kwargs=None,
+                 *, name: str = "train", **kw):
+        super().__init__(entry, args, kwargs, name=name, **kw)
+
+
+class ServingSupervisor(ProcessSupervisor):
+    """Supervise a *serving replica*: the child runs a serving
+    entrypoint (engine + :class:`~paddle_tpu.serving.ServingServer`)
+    and stamps a heartbeat per dispatched batch / decode step through
+    the same ``obs_hook`` slot training uses.
+
+    Serving liveness differs from training in one fundamental way: an
+    idle replica legitimately stops beating (no traffic, no dispatches),
+    so stale beats alone must never kill it.  The policy here is
+    conjunctive — a replica is declared hung only when its HTTP plane
+    has failed ``ready_fail_budget`` consecutive ``/healthz`` probes
+    *and* its newest dispatch beat is older than ``hang_deadline_s``.
+    A responsive-but-quiet replica survives; a replica whose dispatcher
+    wedged mid-batch keeps answering probes only until the server
+    thread pool saturates, then fails both clocks and dies.
+
+    Readiness (HTTP 200 from ``/healthz``; 503 during warmup/drain) is
+    tracked as :attr:`ready` with transitions counted
+    (``supervisor.serving.ready_transitions``) and emitted on the
+    tracer — a warm restart is observable as not-ready → re-warm →
+    ready.  Until the replica has been ready once (or produced a
+    dispatch beat), ``startup_timeout_s`` is the only clock, exactly
+    like training's compile window.  Without a ``health_url`` the
+    supervisor degrades to crash-restart-only: no probe means no hang
+    verdict, because beats alone cannot distinguish wedged from idle.
+    """
+
+    stat_ns = "supervisor.serving"
+
+    def __init__(self, entry: Callable, args: Sequence = (), kwargs=None,
+                 *, name: str = "serve", health_url: Optional[str] = None,
+                 ready_poll_s: float = 0.5, probe_timeout_s: float = 2.0,
+                 ready_fail_budget: int = 6, hang_deadline_s: float = 60.0,
+                 **kw):
+        super().__init__(entry, args, kwargs, name=name, **kw)
+        self.health_url = health_url
+        self.ready_poll_s = float(ready_poll_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.ready_fail_budget = int(ready_fail_budget)
+        self.hang_deadline_s = float(hang_deadline_s)
+        self.ready = False
+        self._probe_failures = 0
+        self._ever_ready = False
+        self._last_probe = 0.0
+
+    def _attempt_reset(self) -> None:
+        super()._attempt_reset()
+        # a fresh incarnation starts un-probed and not ready: its
+        # predecessor's probe verdicts must not kill (or vouch for) it
+        self._probe_failures = 0
+        self._ever_ready = False
+        self._last_probe = 0.0
+        self._set_ready(False)
+
+    def _set_ready(self, ready: bool) -> None:
+        if ready == self.ready:
+            return
+        self.ready = ready
+        self._stat("ready_transitions")
+        self._stat("ready_up" if ready else "ready_down")
+        self._emit("ready" if ready else "unready")
+        if ready:
+            self._ever_ready = True
+
+    def _probe(self):
+        """One stdlib HTTP GET against ``health_url``.  Returns
+        ``(reachable, ready)``: reachable means the HTTP plane answered
+        at all (any status), ready means it answered 200."""
+        import http.client
+        from urllib.parse import urlparse
+        u = urlparse(self.health_url)
+        conn = http.client.HTTPConnection(
+            u.hostname, u.port or 80, timeout=self.probe_timeout_s)
+        try:
+            conn.request("GET", u.path or "/healthz")
+            status = conn.getresponse().status
+            return True, status == 200
+        except (OSError, http.client.HTTPException):
+            return False, False
+        finally:
+            conn.close()
+
+    def _check_liveness(self, hb: Optional[Heartbeat], seen_step: bool,
+                        started: float) -> Optional[str]:
+        now = time.monotonic()
+        if self.health_url is not None \
+                and now - self._last_probe >= self.ready_poll_s:
+            self._last_probe = now
+            reachable, is_ready = self._probe()
+            self._set_ready(is_ready)
+            self._probe_failures = 0 if reachable else \
+                self._probe_failures + 1
+        if not self._ever_ready and not seen_step:
+            # startup / warm-restart window: importing, loading the
+            # artifact, AOT-warming buckets — only the startup clock
+            # applies until readiness (or the first dispatch beat)
+            if (self.startup_timeout_s is not None
+                    and now - started > self.startup_timeout_s):
+                return "startup_timeout"
+            return None
+        if self.health_url is None:
+            return None          # beats alone can't tell wedged from idle
+        if self._probe_failures > self.ready_fail_budget \
+                and (hb is None
+                     or time.time() - hb.time > self.hang_deadline_s):
+            return "hang"
+        return None

@@ -22,13 +22,22 @@ users"):
   contract.
 - :mod:`paddle_tpu.serving.http` — stdlib ``ThreadingHTTPServer``
   front-end (``/predict``, ``/generate`` with chunked token streaming,
-  ``/healthz``, ``/metrics``) plus a keep-alive client helper;
-  ``tools/serve.py`` is the CLI entry point.
+  ``/healthz`` with a liveness/readiness split, ``/metrics``) plus a
+  keep-alive client helper that rides through supervised replica
+  restarts; ``tools/serve.py`` is the CLI entry point.
+- :mod:`paddle_tpu.serving.hotswap` — zero-downtime weight hot swap:
+  :func:`publish_weights` packages serving payloads into a
+  digest-verified :class:`~paddle_tpu.utils.checkpoint.SnapshotStore`
+  snapshot; :class:`WeightWatcher` polls the store and commits new
+  weights into live engines at batch/step boundaries with zero
+  recompiles and no drain (corrupt snapshots rejected, partial
+  multi-engine applies rolled back).
 """
 from .engine import (DeadlineExceeded, EngineClosed,  # noqa: F401
                      InferenceEngine, QueueFull, ServingError)
 from .generation import (GenerationEngine, GenerationError,  # noqa: F401
                          GenerationStream)
+from .hotswap import WeightWatcher, publish_weights  # noqa: F401
 from .kv_cache import KVCacheConfig, PagePool  # noqa: F401
 from .models import PagedDecoderLM  # noqa: F401
 from .http import Client, ServingServer  # noqa: F401
@@ -36,4 +45,5 @@ from .http import Client, ServingServer  # noqa: F401
 __all__ = ["InferenceEngine", "ServingError", "QueueFull",
            "DeadlineExceeded", "EngineClosed", "ServingServer", "Client",
            "GenerationEngine", "GenerationError", "GenerationStream",
-           "KVCacheConfig", "PagePool", "PagedDecoderLM"]
+           "KVCacheConfig", "PagePool", "PagedDecoderLM",
+           "WeightWatcher", "publish_weights"]

@@ -20,6 +20,13 @@ cf. Ragged Paged Attention, PAPERS.md):
 - ``fault.point("serving.enqueue")`` / ``fault.point("serving.dispatch")``
   hooks let chaos tests (testing/chaos.py serving scenario) flake the
   admission and dispatch paths deterministically.
+- Self-healing rails: every dispatched batch stamps the supervised
+  heartbeat (``obs_hook._heartbeat`` — one None-check when
+  unsupervised, the same pattern the Executor uses for training
+  supervision), and :meth:`InferenceEngine.swap_predictor` commits a
+  prepared replacement predictor under the engine lock at a batch
+  boundary — the zero-downtime weight hot swap
+  (:mod:`paddle_tpu.serving.hotswap` owns the polling/verify side).
 """
 from __future__ import annotations
 
@@ -196,6 +203,7 @@ class InferenceEngine:
         self._paused = False            # testing hook: pause()/resume()
         self._pred_mu = threading.Lock()
         self._warm_variants: Optional[int] = None
+        self._weights_version = 0       # last hot-swapped snapshot step
         # which outputs carry the batch dim: warmup observes it across
         # bucket sizes; the artifact's symbolic out_avals are the
         # fallback; None = per-batch shape heuristic
@@ -442,6 +450,12 @@ class InferenceEngine:
                     self._c["dispatch_retries"] += 1
                     self._madd("dispatch_retries")
         t_done = time.perf_counter()
+        # supervised liveness: one beat per dispatched batch (success OR
+        # failure — the signal is "the dispatch loop makes progress",
+        # not "requests succeed"); a single None-check when unsupervised
+        hb = obs_hook._heartbeat
+        if hb is not None:
+            hb.beat(int(self._c["batches"]) + 1)
         trc = obs_hook._tracer
         if trc is not None:
             # one typed event per coalesced dispatch, correlated to the
@@ -520,14 +534,11 @@ class InferenceEngine:
                     self._cv.notify_all()
 
     # -- warmup / lifecycle ------------------------------------------------
-    def warmup(self, rest_shapes: Optional[Sequence[Sequence[int]]] = None
-               ) -> int:
-        """AOT-compile every bucket so the serve path never compiles.
-
-        ``rest_shapes`` — per-input shapes *minus* the batch dim; derived
-        from the artifact metadata when its non-batch dims are static.
-        Returns the number of compiled variants after warmup (the
-        baseline for ``recompiles_after_warmup``)."""
+    def _bucket_feeds(self, rest_shapes: Optional[Sequence[Sequence[int]]]):
+        """Yield ``(bucket, feeds)`` zero-feeds for every bucket —
+        shared by :meth:`warmup` (this engine's predictor, under the
+        engine lock) and :meth:`prewarm_predictor` (a replacement
+        predictor, no lock needed: it is not serving yet)."""
         if rest_shapes is None:
             if self._in_shapes is None:
                 raise ValueError("artifact metadata lacks input shapes; "
@@ -540,11 +551,21 @@ class InferenceEngine:
                     "artifact has symbolic non-batch dims; pass concrete "
                     "rest_shapes=[shape_without_batch, ...]") from None
         dtypes = self._in_dtypes or [np.float32] * len(self._input_names)
+        for b in self._buckets:
+            yield b, [np.zeros((b,) + tuple(rs), dtype=dt)
+                      for rs, dt in zip(rest_shapes, dtypes)]
+
+    def warmup(self, rest_shapes: Optional[Sequence[Sequence[int]]] = None
+               ) -> int:
+        """AOT-compile every bucket so the serve path never compiles.
+
+        ``rest_shapes`` — per-input shapes *minus* the batch dim; derived
+        from the artifact metadata when its non-batch dims are static.
+        Returns the number of compiled variants after warmup (the
+        baseline for ``recompiles_after_warmup``)."""
         out_shapes = {}
         with self._pred_mu:
-            for b in self._buckets:
-                feeds = [np.zeros((b,) + tuple(rs), dtype=dt)
-                         for rs, dt in zip(rest_shapes, dtypes)]
+            for b, feeds in self._bucket_feeds(rest_shapes):
                 outs = self._pred.run(feeds)
                 out_shapes[b] = [tuple(np.shape(o)) for o in outs]
         if len(out_shapes) >= 2:
@@ -558,6 +579,62 @@ class InferenceEngine:
                 for j in range(n_out)]
         self._warm_variants = self._pred.num_compiled_variants()
         return self._warm_variants
+
+    # -- zero-downtime weight hot swap -------------------------------------
+    def prewarm_predictor(self, pred,
+                          rest_shapes: Optional[Sequence[Sequence[int]]]
+                          = None) -> int:
+        """Warm a *replacement* predictor on every bucket WITHOUT
+        touching the serving one — runs entirely off the dispatch path
+        (no engine lock: ``pred`` has no other caller yet), so a hot
+        swap commits an already-compiled predictor and the serve path
+        never compiles.  Returns its compiled-variant count.
+
+        Raises if the replacement disagrees with this engine's input
+        signature (names / per-row shapes / dtypes) — the pre-commit
+        rejection path for a mismatched artifact."""
+        names = list(pred.get_input_names())
+        if names != self._input_names:
+            raise ValueError(
+                f"replacement artifact has inputs {names}, engine serves "
+                f"{self._input_names}")
+        for b, feeds in self._bucket_feeds(rest_shapes):
+            pred.run(feeds)
+        return pred.num_compiled_variants()
+
+    def swap_predictor(self, pred, version: int):
+        """Commit a prepared (loaded + digest-verified + prewarmed)
+        predictor as the serving weights.  The commit is one pointer
+        write under the engine's predictor lock — the batch boundary:
+        an in-flight batch finishes on the old weights, the next batch
+        runs on the new ones, nothing drains and nothing recompiles
+        (``prewarm_predictor`` already compiled every bucket).
+
+        Returns the replaced predictor — the caller's rollback handle
+        (swap it back if a later stage of a multi-engine swap fails).
+        """
+        with self._cv:
+            if self._closing or self._closed:
+                raise EngineClosed("engine is draining or closed")
+        with self._pred_mu:
+            old = self._pred
+            self._pred = pred
+            # the new predictor's variants are the new warm baseline —
+            # recompiles_after_warmup stays 0 across a clean swap
+            self._warm_variants = pred.num_compiled_variants()
+            self._weights_version = int(version)
+        with self._cv:
+            self._c["weight_swaps"] += 1
+        self._madd("weight_swaps")
+        trc = obs_hook._tracer
+        if trc is not None:
+            trc.emit("serving", "weights_swap",
+                     args=self._ev(version=int(version)))
+        return old
+
+    @property
+    def weights_version(self) -> int:
+        return self._weights_version
 
     def pause(self) -> None:
         """Testing hook: hold the dispatcher (no new batch starts)."""
@@ -590,7 +667,17 @@ class InferenceEngine:
 
     def close(self, timeout: float = 10.0) -> None:
         """Graceful shutdown: drain, stop the dispatcher, and fail any
-        request that could not be served — no future is ever stranded."""
+        request that could not be served — no future is ever stranded.
+
+        ``timeout`` is a hard deadline on the whole method, measured
+        from entry: time spent contending for the engine lock counts
+        against the dispatcher join, so a dispatcher wedged in a
+        faulted dispatch (e.g. the ``serving.dispatch`` fault point
+        with ``action=sleep``) can never hold ``close`` past its
+        budget — the wedged batch's futures are failed and the thread
+        is abandoned (it is a daemon and exits on its next state
+        check)."""
+        deadline = time.monotonic() + max(0.0, float(timeout))
         with self._cv:
             if self._closed:
                 return
@@ -598,7 +685,7 @@ class InferenceEngine:
             self._closing = True
             self._paused = False        # a paused engine must still close
             self._cv.notify_all()
-        self._thread.join(timeout)
+        self._thread.join(max(0.0, deadline - time.monotonic()))
         with self._cv:
             self._closed = True
             # only on join timeout / wedged dispatcher: fail everything
@@ -614,7 +701,11 @@ class InferenceEngine:
             for r in stranded:
                 _safe_set_exception(r.future, EngineClosed(
                     "engine closed before the request was served"))
+            if stranded:
+                self._c["closed_stranded"] += len(stranded)
             self._cv.notify_all()
+        if stranded:
+            self._madd("closed_stranded", len(stranded))
 
     def __enter__(self):
         return self
@@ -657,7 +748,8 @@ class InferenceEngine:
             "counters": {k: c.get(k, 0) for k in (
                 "requests", "responses", "batches", "rows", "padded_rows",
                 "shed", "deadline_expired", "failed", "dispatch_errors",
-                "dispatch_retries")},
+                "dispatch_retries", "weight_swaps", "closed_stranded")},
+            "weights_version": self._weights_version,
             "mean_batch_occupancy": (occ_sum / batches) if batches else 0.0,
             "padding_waste": (padded / (rows + padded))
             if (rows + padded) else 0.0,

@@ -42,6 +42,17 @@ sequence and frees its pages), decode-step retries around
 ``fault.point("serving.decode_step")`` (the step is functional over the
 pool — injected flakes fire before dispatch, so a retry is safe), and
 ``drain()``/``close()`` that never strand a future or leak a page.
+
+**Weights as arguments, not constants.**  The model parameters ride
+every compiled entry point as its FIRST argument (a real device-array
+pytree) instead of being closure-captured and baked into the HLO as
+constants.  That one signature choice is what makes the zero-downtime
+weight hot swap (:meth:`GenerationEngine.swap_weights`) a pure pointer
+replacement: new arrays of identical shape/dtype slot into the already
+compiled executables with ZERO recompiles, committed by the scheduler
+between decode steps so every sequence's next token comes from exactly
+one weights version.  When no swap is pending the steady-state cost is
+a single attribute check at the top of the scheduler loop.
 """
 from __future__ import annotations
 
@@ -276,6 +287,14 @@ class GenerationEngine:
         self._compile_count = 0
         self._warm_variants: Optional[int] = None
         self._serial = f"gen-{id(self):x}"
+        # serving weights, device-resident, passed as the first argument
+        # of every compiled entry point (see module docstring): a hot
+        # swap replaces this dict wholesale between decode steps
+        self._params_dev: Dict[str, object] = {
+            k: jnp.asarray(v) for k, v in model.params.items()}
+        self._weights_version = 0
+        self._pending_swap = None   # (params_dev, version) staged by
+        #                             swap_weights, committed by _loop
 
         self._c: Dict[str, Union[int, float]] = collections.defaultdict(int)
         self._occ_sum = 0.0
@@ -396,17 +415,30 @@ class GenerationEngine:
         sampled = jnp.argmax(scaled, axis=-1).astype(jnp.int32)
         return jnp.where(temps > 0, sampled, greedy)
 
-    def _decode_step_fn(self, k_pool, v_pool, tokens, positions, tables,
-                        temps, keys):
-        logits, (k_pool, v_pool) = self._model.decode(
-            tokens, positions, (k_pool, v_pool), tables)
+    def _decode_step_fn(self, params, k_pool, v_pool, tokens, positions,
+                        tables, temps, keys):
+        # `params` rides the executable as a real argument so a weight
+        # hot swap is an array replacement, never a recompile; the model
+        # reads self.params, so bind the traced pytree for the trace
+        saved = self._model.params
+        self._model.params = params
+        try:
+            logits, (k_pool, v_pool) = self._model.decode(
+                tokens, positions, (k_pool, v_pool), tables)
+        finally:
+            self._model.params = saved
         toks = self._select_tokens(logits, temps, keys)
         return k_pool, v_pool, toks
 
-    def _prefill_fn(self, k_pool, v_pool, tokens, length, table, temp,
-                    key):
-        logits, (k_pool, v_pool) = self._model.prefill(
-            tokens, length, (k_pool, v_pool), table)
+    def _prefill_fn(self, params, k_pool, v_pool, tokens, length, table,
+                    temp, key):
+        saved = self._model.params
+        self._model.params = params
+        try:
+            logits, (k_pool, v_pool) = self._model.prefill(
+                tokens, length, (k_pool, v_pool), table)
+        finally:
+            self._model.params = saved
         tok = self._select_tokens(logits[None], temp[None], key[None])[0]
         return k_pool, v_pool, tok
 
@@ -421,19 +453,26 @@ class GenerationEngine:
             def aval(shape, dt):
                 return jax.ShapeDtypeStruct(shape, dt)
 
-            donate = (0, 1) if self._donate else ()
+            # params (arg 0) are never donated: the old weights must
+            # stay alive through a hot swap's in-flight step; the KV
+            # pool (args 1, 2) keeps its in-place donation
+            donate = (1, 2) if self._donate else ()
+            params_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                            for k, v in self._params_dev.items()}
             if kind == "decode":
                 # bucket = page-table width (context bucket), so the
                 # gather is O(live context), not O(max_context)
                 S = self._slots_n
                 fn = jax.jit(self._decode_step_fn, donate_argnums=donate)
-                ex = fn.lower(pool_aval, pool_aval, aval((S,), i32),
+                ex = fn.lower(params_avals, pool_aval, pool_aval,
+                              aval((S,), i32),
                               aval((S,), i32), aval((S, bucket), i32),
                               aval((S,), f32),
                               aval((S, 2), jnp.uint32)).compile()
             else:
                 fn = jax.jit(self._prefill_fn, donate_argnums=donate)
-                ex = fn.lower(pool_aval, pool_aval, aval((bucket,), i32),
+                ex = fn.lower(params_avals, pool_aval, pool_aval,
+                              aval((bucket,), i32),
                               aval((), i32), aval((self._P,), i32),
                               aval((), f32),
                               aval((2,), jnp.uint32)).compile()
@@ -444,6 +483,7 @@ class GenerationEngine:
                 "kind": kind, "bucket": bucket,
                 "slots": self._slots_n, "pages": c.num_pages,
                 "page_size": c.page_size,
+                "weights_version": self._weights_version,
             }, note="warmup" if self._warm_variants is None
                     else "serve-path miss")
         return ex
@@ -458,6 +498,75 @@ class GenerationEngine:
             self._get_exec("prefill", b)
         self._warm_variants = self._compile_count
         return self._warm_variants
+
+    # -- zero-downtime weight hot swap -------------------------------------
+    def swap_weights(self, params, version: int,
+                     timeout: Optional[float] = 30.0) -> int:
+        """Atomically replace the decode weights at a step boundary.
+
+        Validates the new parameter pytree against the serving one
+        (same names, shapes, dtypes — the compiled executables are
+        shape-specialized, so a mismatch is REJECTED with
+        ``ValueError``, never recompiled), uploads it to the device
+        entirely off the scheduler thread, then stages it for the
+        scheduler to commit between decode steps: every in-flight
+        sequence finishes its current token on the old weights and
+        produces its next one on the new — no drain, no recompile, and
+        each emitted token is attributable to exactly one version.
+
+        Blocks until the commit (or ``timeout`` → ``TimeoutError``);
+        returns the committed version.  Call from any thread except the
+        scheduler's."""
+        new = {k: jnp.asarray(v) for k, v in params.items()}
+        cur = self._params_dev
+        if set(new) != set(cur):
+            diff = sorted(set(cur) ^ set(new))
+            raise ValueError(
+                f"weight swap rejected: parameter set differs from the "
+                f"serving weights (mismatched: {diff})")
+        for k in sorted(new):
+            if (tuple(new[k].shape) != tuple(cur[k].shape)
+                    or new[k].dtype != cur[k].dtype):
+                raise ValueError(
+                    f"weight swap rejected: param {k!r} is "
+                    f"{tuple(new[k].shape)}/{new[k].dtype}, executables "
+                    f"compiled for {tuple(cur[k].shape)}/{cur[k].dtype}")
+        for a in new.values():      # finish the device upload HERE, off
+            getattr(a, "block_until_ready", lambda: None)()  # the loop
+        with self._cv:
+            if self._closing or self._closed:
+                raise EngineClosed("engine is draining or closed")
+            if self._pending_swap is not None:
+                raise ServingError("a weight swap is already pending")
+            self._pending_swap = (new, int(version))
+            self._cv.notify_all()
+            ok = self._cv.wait_for(
+                lambda: self._pending_swap is None or self._closing
+                or self._closed, timeout)
+            if self._pending_swap is not None:
+                self._pending_swap = None       # unstage: never commit
+                if not ok:                      # a swap after our bail
+                    raise TimeoutError(
+                        f"weight swap not committed within {timeout}s")
+                raise EngineClosed("engine closed before the swap "
+                                   "committed")
+        return int(version)
+
+    def _commit_swap_locked(self) -> None:
+        """Scheduler-side commit (caller holds the lock, between
+        steps): one pointer write, then wake the staging thread."""
+        params, version = self._pending_swap
+        self._pending_swap = None
+        self._params_dev = params
+        self._weights_version = version
+        self._c["weight_swaps"] += 1
+        self._madd("weight_swaps")
+        self._emit("gen_weights_swap", version=version)
+        self._cv.notify_all()
+
+    @property
+    def weights_version(self) -> int:
+        return self._weights_version
 
     # -- scheduler ---------------------------------------------------------
     def _expire_queued_locked(self) -> None:
@@ -645,7 +754,7 @@ class GenerationEngine:
         try:
             k_pool, v_pool, tok = self._run_exec(
                 "prefill", bucket,
-                (k_pool, v_pool, jnp.asarray(toks),
+                (self._params_dev, k_pool, v_pool, jnp.asarray(toks),
                  jnp.int32(seq.prompt.size),
                  jnp.asarray(self._tables[seq.slot]),
                  jnp.float32(seq.temperature),
@@ -661,6 +770,9 @@ class GenerationEngine:
         self._madd("tokens")
         self._emit("gen_prefill", sid=seq.sid, bucket=bucket,
                    dur_ms=(time.perf_counter() - t0) * 1000.0)
+        hb = obs_hook._heartbeat
+        if hb is not None:
+            hb.beat(int(self._c["prefills"]))
         seq.position = int(seq.prompt.size) + 1
         self._emit_token(seq, int(tok))
 
@@ -702,7 +814,7 @@ class GenerationEngine:
         try:
             k_pool, v_pool, toks = self._run_exec(
                 "decode", p_b,
-                (k_pool, v_pool, jnp.asarray(tokens),
+                (self._params_dev, k_pool, v_pool, jnp.asarray(tokens),
                  jnp.asarray(positions), tables,
                  self._temps_dev, keys))
         except GenerationError as e:
@@ -720,6 +832,11 @@ class GenerationEngine:
         step_s = time.perf_counter() - t0
         self._reg.observe("step_ms", step_s * 1000.0)
         self._mobs("step_ms", step_s * 1000.0)
+        # supervised liveness: one beat per decode step (one None-check
+        # when unsupervised — the engine heartbeat contract)
+        hb = obs_hook._heartbeat
+        if hb is not None:
+            hb.beat(int(self._c["decode_steps"]))
         # perf observatory: decode anatomy + memory sampler cadence
         p = obs_hook._perf
         if p is not None:
@@ -746,6 +863,11 @@ class GenerationEngine:
     def _loop(self) -> None:
         while True:
             with self._cv:
+                # weight hot swap: commit between steps — the ONLY
+                # steady-state cost of the swap machinery is this one
+                # attribute check when no swap is pending
+                if self._pending_swap is not None:
+                    self._commit_swap_locked()
                 self._expire_queued_locked()
                 has_active = any(s is not None for s in self._slots)
                 if self._closing and not self._queue and not has_active:
@@ -895,7 +1017,9 @@ class GenerationEngine:
                 "requests", "admitted", "finished", "failed", "shed",
                 "deadline_expired", "tokens", "prefills",
                 "prefill_tokens", "decode_steps", "decode_errors",
-                "decode_retries", "pages_allocated", "pages_freed")},
+                "decode_retries", "pages_allocated", "pages_freed",
+                "weight_swaps")},
+            "weights_version": self._weights_version,
             "mean_slot_occupancy": (occ_sum / steps) if steps else 0.0,
             "prefill_decode_ratio": (prefill_toks / decode_toks
                                      if decode_toks else 0.0),

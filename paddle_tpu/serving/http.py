@@ -22,13 +22,21 @@ dispatcher coalesces into micro-batches):
   ``{"done": true, "tokens": [...], "finish_reason": ...}`` summary
   line (errors mid-stream arrive in-band as an ``{"error": ...}``
   line).  ``stream: false`` returns one JSON object at the end.
-- ``GET /healthz`` — 200 while serving, 503 when draining/closed.
-  With an SLO monitor installed (``observability.install_slo_monitor``)
-  each probe also polls the rule set: any breached burn-rate rule
-  degrades the reply to 503 with ``{"status": "degraded", "slo":
-  {reasons...}}`` while the engine itself keeps serving — the
-  load-balancer sees the objective, not just liveness — and the
-  endpoint recovers to 200 as soon as the rolling windows clear.
+- ``GET /healthz`` — liveness AND readiness in one probe.  200 only
+  when the engine is serving and the server has been marked ready
+  (:meth:`ServingServer.mark_ready` — ``tools/serve.py`` and the
+  supervised serving entry mark ready only after warmup); 503 with a
+  ``Retry-After`` hint during warmup (``"warming"``), drain, and
+  close, so supervisors and load balancers rotate a replica out
+  BEFORE it stops answering instead of after.  The body always
+  carries ``status`` / ``ready`` / ``weights_version`` (the hot-swap
+  observable).  With an SLO monitor installed
+  (``observability.install_slo_monitor``) each probe also polls the
+  rule set: any breached burn-rate rule degrades the reply to 503
+  with ``{"status": "degraded", "slo": {reasons...}}`` while the
+  engine itself keeps serving — the load-balancer sees the objective,
+  not just liveness — and the endpoint recovers to 200 as soon as the
+  rolling windows clear.
 - ``GET /perf`` — the runtime performance observatory's drift report
   (``observability.perf_report``) plus the last SLO evaluation.
 - ``GET /metrics`` — content-negotiated.  Default (and any JSON
@@ -48,7 +56,9 @@ import concurrent.futures
 import http.client as httpclient
 import io
 import json
+import random
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterator, List, Optional
 from urllib.parse import urlsplit
@@ -56,6 +66,7 @@ from urllib.parse import urlsplit
 import numpy as np
 
 from ..observability import perf as _perf, slo as _slo
+from ..utils import monitor
 from .engine import (DeadlineExceeded, EngineClosed, InferenceEngine,
                      QueueFull, ServingError)
 
@@ -137,14 +148,34 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply_json(500, payload)
 
+    def _weights_version(self) -> int:
+        for src in (self.engine, self.generation):
+            if src is not None:
+                return int(getattr(src, "weights_version", 0))
+        return 0
+
     # -- routes ------------------------------------------------------------
     def do_GET(self):
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
             src = self.engine if self.engine is not None else self.generation
             st = src.stats()["state"] if src is not None else "empty"
+            wv = self._weights_version()
+            retry = [("Retry-After", str(getattr(
+                self.server, "retry_after_s", 1)))]
             if st not in ("running", "paused"):
-                self._reply_json(503, {"status": st})
+                # liveness gone: draining / closing / closed
+                self._reply_json(503, {"status": st, "ready": False,
+                                       "weights_version": wv}, retry)
+                return
+            if not getattr(self.server, "ready", True):
+                # alive but not yet (re-)warmed: readiness split — the
+                # supervisor/load balancer holds traffic, the process
+                # is NOT restarted
+                self._reply_json(503, {"status": "warming",
+                                       "engine_state": st,
+                                       "ready": False,
+                                       "weights_version": wv}, retry)
                 return
             # liveness is fine; with an SLO monitor installed the probe
             # also polls the objectives — any breached burn-rate rule
@@ -155,10 +186,12 @@ class _Handler(BaseHTTPRequestHandler):
             if slo.get("status") == "degraded":
                 self._reply_json(503, {
                     "status": "degraded", "engine_state": st,
+                    "ready": False, "weights_version": wv,
                     "slo": {"breached": slo.get("breached", []),
-                            "reasons": slo.get("reasons", [])}})
+                            "reasons": slo.get("reasons", [])}}, retry)
             else:
-                body = {"status": st}
+                body = {"status": st, "ready": True,
+                        "weights_version": wv}
                 if slo.get("installed"):
                     body["slo"] = "ok"
                 self._reply_json(200, body)
@@ -186,6 +219,16 @@ class _Handler(BaseHTTPRequestHandler):
                           if isinstance(v, (int, float))}
                 gauges.update({f"serving_engine_{k}{lab}": v
                                for k, v in stats["counters"].items()})
+                # the self-healing observables: what version this
+                # replica serves and whether it should receive traffic
+                st = stats.get("state",
+                               self.generation.stats()["state"]
+                               if self.engine is None else "empty")
+                ready = (getattr(self.server, "ready", True)
+                         and st in ("running", "paused"))
+                gauges[f"serving_weights_version{lab}"] = \
+                    self._weights_version()
+                gauges[f"serving_ready{lab}"] = 1 if ready else 0
                 if gen is not None:
                     gs = stats["generation"]
                     gname = getattr(gen, "name", None)
@@ -319,7 +362,8 @@ class ServingServer:
     def __init__(self, engine: Optional[InferenceEngine],
                  host: str = "127.0.0.1",
                  port: int = 8000, request_timeout: float = 60.0,
-                 verbose: bool = False, generation=None):
+                 verbose: bool = False, generation=None,
+                 ready: bool = True, retry_after_s: float = 1.0):
         if engine is None and generation is None:
             raise ValueError("attach an InferenceEngine, a "
                              "GenerationEngine, or both")
@@ -329,6 +373,12 @@ class ServingServer:
         self._httpd.generation = generation
         self._httpd.request_timeout = request_timeout
         self._httpd.verbose = verbose
+        # readiness split: ``ready=False`` lets a supervised replica
+        # bind its port early (liveness probes answer) and admit
+        # traffic only after warmup via mark_ready(); retry_after_s is
+        # the Retry-After hint on every 503 probe
+        self._httpd.ready = bool(ready)
+        self._httpd.retry_after_s = retry_after_s
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -343,6 +393,21 @@ class ServingServer:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    @property
+    def ready(self) -> bool:
+        return self._httpd.ready
+
+    def mark_ready(self) -> None:
+        """Readiness gate up: warmup (or re-warm after a supervised
+        restart) is done — /healthz turns 200 and traffic may land."""
+        self._httpd.ready = True
+
+    def mark_unready(self) -> None:
+        """Readiness gate down without killing liveness: /healthz turns
+        503 + Retry-After while the engine keeps finishing accepted
+        work (drain windows, planned restarts)."""
+        self._httpd.ready = False
+
     def start(self) -> "ServingServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="serving-http", daemon=True)
@@ -353,6 +418,7 @@ class ServingServer:
         self._httpd.serve_forever()
 
     def close(self) -> None:
+        self._httpd.ready = False
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
@@ -391,11 +457,22 @@ class Client:
     counts physical connects across all threads (the reuse gate's
     observable).
 
+    **Restart ride-through.**  When the FRESH connection also fails
+    (refused/reset — the replica is mid-restart under a supervisor,
+    not merely holding a stale socket), the client backs off once with
+    jitter — honoring the server's last ``Retry-After`` hint — and
+    retries on another fresh connection.  Each such recovery counts as
+    ``client.reconnects`` (``self.reconnects`` + the monitor stat), so
+    a supervised restart window costs a bounded delay instead of a
+    hard failure.  Requests are idempotent (inference is pure,
+    generation deterministic), so the replay is safe.
+
     503/504 responses are raised as the matching engine exceptions
     (:class:`QueueFull` / :class:`DeadlineExceeded` / ...), so a caller
     can back off on shed exactly as an in-process caller would."""
 
-    def __init__(self, base_url: str, timeout: float = 60.0):
+    def __init__(self, base_url: str, timeout: float = 60.0,
+                 reconnect_backoff_s: float = 0.2):
         self.base_url = base_url.rstrip("/")
         u = urlsplit(self.base_url)
         if u.scheme not in ("http", ""):
@@ -403,9 +480,12 @@ class Client:
         self._host = u.hostname or "127.0.0.1"
         self._port = u.port or 80
         self.timeout = timeout
+        self.reconnect_backoff_s = float(reconnect_backoff_s)
         self._local = threading.local()
         self._count_lock = threading.Lock()
         self.connections_opened = 0
+        self.reconnects = 0
+        self._retry_after = 0.0     # last Retry-After the server sent
 
     # -- connection pool (one per thread) ----------------------------------
     def _conn(self) -> httpclient.HTTPConnection:
@@ -443,15 +523,18 @@ class Client:
                  = None, headers: Optional[dict] = None
                  ) -> httpclient.HTTPResponse:
         """One round trip on the pooled connection; retries once on a
-        stale keep-alive socket.  (Serving requests are idempotent —
-        inference is pure and generation is deterministic — so the
-        replay is safe.)  A *timeout* is never replayed: the server is
-        slow, not gone, and a replay would double its work while
-        masking the real condition.  The caller must fully read the
-        response."""
+        stale keep-alive socket, and once more — after a jittered
+        backoff that honors the server's last ``Retry-After`` — when
+        the fresh connection also failed (a supervised replica
+        restart; see the class docstring).  (Serving requests are
+        idempotent — inference is pure and generation is deterministic
+        — so the replay is safe.)  A *timeout* is never replayed: the
+        server is slow, not gone, and a replay would double its work
+        while masking the real condition.  The caller must fully read
+        the response."""
         headers = dict(headers or {})
         last: Optional[BaseException] = None
-        for attempt in (0, 1):
+        for attempt in (0, 1, 2):
             c = self._conn()
             try:
                 c.request(method, path, body=body, headers=headers)
@@ -462,12 +545,30 @@ class Client:
                 if isinstance(e, TimeoutError):
                     raise               # slow server: surface, don't replay
                 last = e
+                if attempt == 1:
+                    # attempt 0 may have been a stale pooled socket, but
+                    # attempt 1 was a FRESH connection: the replica is
+                    # down (restart window) — back off once, jittered,
+                    # before the final try
+                    delay = max(self._retry_after,
+                                self.reconnect_backoff_s)
+                    time.sleep(delay * (0.5 + random.random()))
+                    with self._count_lock:
+                        self.reconnects += 1
+                    monitor.stat_add("client.reconnects")
         raise ServingError(f"connection to {self.base_url} failed: "
                            f"{type(last).__name__}: {last}") from last
 
     def _finish(self, r: httpclient.HTTPResponse) -> None:
         """Keep the connection reusable — or drop it when the server
-        asked to close."""
+        asked to close.  Also notes any ``Retry-After`` hint (it
+        floors the reconnect backoff)."""
+        ra = r.getheader("Retry-After")
+        if ra is not None:
+            try:
+                self._retry_after = float(ra)
+            except (TypeError, ValueError):
+                pass
         if r.will_close:
             self._drop_conn()
 

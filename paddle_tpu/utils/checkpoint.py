@@ -42,7 +42,7 @@ import signal
 import threading
 import time
 import warnings
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Sequence
 
 from . import fs as _fsmod
 from . import monitor
@@ -408,6 +408,53 @@ class SnapshotStore:
             f"checkpoint dir '{self.dir}' has a published meta but no "
             f"intact snapshot (tried {attempts}); refusing to resume "
             f"half-initialized — delete the dir to restart from scratch")
+
+    # -- polling consumers (serving weight hot swap) -----------------------
+    def latest_snapshot(self) -> Optional[dict]:
+        """Newest published snapshot's meta entry (dict with ``dir`` /
+        ``epoch`` / ``step`` / ``digests``), or None when nothing has
+        been published — the cheap poll a serving-side
+        :class:`~paddle_tpu.serving.hotswap.WeightWatcher` issues to
+        notice new weights without reading any payload."""
+        meta = self.load_meta()
+        if meta is None or not meta.get("snapshots"):
+            return None
+        return dict(meta["snapshots"][-1])
+
+    def load_payloads(self, names: Sequence[str],
+                      snap: Optional[dict] = None) -> Optional[dict]:
+        """Read + sha256-verify + decode the named payloads of one
+        snapshot (default: the newest) WITHOUT applying them to any
+        object — the serving half of a weight hot swap loads here, off
+        the dispatch thread, and only commits what verified.
+
+        Returns ``{name: decoded state-dict}``, or None when the
+        snapshot is missing/corrupt/partial (a warning names what
+        failed) — rejection, not exception, so a polling consumer can
+        keep serving the version it already has.  Sharded payloads are
+        refused (serving replicas load replicated weights)."""
+        if snap is None:
+            snap = self.latest_snapshot()
+            if snap is None:
+                return None
+        payloads = self._read_verified(snap, {n: None for n in names})
+        if payloads is None:
+            return None
+        out = {}
+        for name, p in payloads.items():
+            if isinstance(p, tuple) and p[0] == "__sharded__":
+                warnings.warn(
+                    f"checkpoint {snap.get('dir')}: payload '{name}' is "
+                    f"sharded; load_payloads serves replicated weights "
+                    f"only")
+                return None
+            try:
+                out[name] = _loads(p, source=f"{snap.get('dir')}/{name}")
+            except Exception as e:      # decode failure == corruption
+                warnings.warn(f"checkpoint {snap.get('dir')}: payload "
+                              f"'{name}' failed to decode: {e}")
+                return None
+        return out
 
 
 class TrainEpochRange:

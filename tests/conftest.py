@@ -41,3 +41,11 @@ def _seed_all():
     paddle_tpu.seed(2024)
     np.random.seed(2024)
     yield
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: mark tests that duplicate a tools/
+    # smoke gate (chaos_smoke, serve_smoke) so they stay runnable
+    # without charging the tier-1 time budget twice.
+    config.addinivalue_line(
+        "markers", "slow: excluded from tier-1; covered by a tools/ gate")

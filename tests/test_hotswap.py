@@ -1,0 +1,348 @@
+"""Zero-downtime weight hot-swap tests (ISSUE 18): the SnapshotStore
+publish/verify roundtrip, WeightWatcher apply / reject / rollback
+semantics on live engines, readiness + ``weights_version`` surfacing on
+``/healthz`` and Prometheus, and the disabled-path cost contracts
+(swap machinery must never touch the per-batch / per-step hot paths).
+"""
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, jit, serving
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.serving.hotswap import (ARTIFACT_PAYLOAD, PARAMS_PAYLOAD,
+                                        WeightWatcher, publish_weights)
+from paddle_tpu.testing.chaos import (_scaled_artifact, make_dyadic_lm,
+                                      make_dyadic_model)
+from paddle_tpu.utils import monitor
+from paddle_tpu.utils.checkpoint import SnapshotStore
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """Two jit.save artifacts of the dyadic model: v2's weights are
+    v1's scaled by 0.5 (power of two: outputs stay bitwise-exact), so
+    every response is attributable to exactly one version."""
+    d = str(tmp_path_factory.mktemp("hotswap_artifacts"))
+    return {1: _scaled_artifact(1.0, d, "v1"),
+            2: _scaled_artifact(0.5, d, "v2")}
+
+
+def _engine(prefix, **kw):
+    pred = inference.create_predictor(inference.Config(prefix))
+    kw.setdefault("max_batch_size", 8)
+    kw.setdefault("batch_timeout_ms", 5.0)
+    eng = serving.InferenceEngine(pred, **kw)
+    eng.warmup()
+    return eng, pred
+
+
+def _gen_params(scale=1.0):
+    base = make_dyadic_lm().params
+    return {k: (np.asarray(a) * scale).astype(np.asarray(a).dtype)
+            for k, a in base.items()}
+
+
+def _dyadic(rng, n=4, rows=2):
+    return [(rng.randint(-8, 9, (rows, 8)) / 4.0).astype(np.float32)
+            for _ in range(n)]
+
+
+def _flip_byte(path, offset=20):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+# ----------------------------------------------------- publish side --
+def test_publish_roundtrip(tmp_path, artifacts):
+    store = SnapshotStore(str(tmp_path))
+    params = _gen_params(0.5)
+    meta = publish_weights(store, 7, artifact_prefix=artifacts[1],
+                           params=params)
+    assert int(meta["step"]) == 7
+    digs = meta["digests"]
+    assert f"{ARTIFACT_PAYLOAD}.pdparams" in digs
+    assert f"{PARAMS_PAYLOAD}.pdparams" in digs
+    loaded = store.load_payloads([ARTIFACT_PAYLOAD, PARAMS_PAYLOAD], meta)
+    assert loaded is not None
+    got = loaded[PARAMS_PAYLOAD]
+    assert set(got) == set(params)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(got[k]), params[k])
+    with open(artifacts[1] + ".pdmodel", "rb") as f:
+        raw = f.read()
+    assert np.asarray(loaded[ARTIFACT_PAYLOAD]["pdmodel"],
+                      np.uint8).tobytes() == raw
+
+
+def test_publish_needs_a_payload(tmp_path):
+    with pytest.raises(ValueError, match="artifact_prefix"):
+        publish_weights(SnapshotStore(str(tmp_path)), 1)
+
+
+# ------------------------------------------------------- apply path --
+def test_watcher_applies_both_engines(tmp_path, artifacts):
+    monitor.stat_reset()
+    eng, pred1 = _engine(artifacts[1])
+    pred2 = inference.create_predictor(inference.Config(artifacts[2]))
+    gen = serving.GenerationEngine(make_dyadic_lm(), num_slots=2,
+                                   page_size=4, max_context=16,
+                                   prompt_buckets=[4])
+    gen.warmup()
+    # the bitwise reference for the swapped generation weights: a model
+    # BORN with the scaled params must emit the same tokens (compiles
+    # lazily — no recompile assertion is made against it)
+    m2 = make_dyadic_lm()
+    m2.params = _gen_params(0.5)
+    ref_gen = serving.GenerationEngine(m2, num_slots=2, page_size=4,
+                                       max_context=16, prompt_buckets=[4])
+    try:
+        xs = _dyadic(np.random.RandomState(0))
+        refs2 = [np.asarray(pred2.run([x])[0]) for x in xs]
+        ref_toks = ref_gen.generate_sync([1, 2, 3], timeout=60,
+                                         max_new_tokens=6,
+                                         temperature=0.7, seed=5)
+        store = SnapshotStore(str(tmp_path))
+        w = WeightWatcher(store, engine=eng, generation=gen)
+        assert w.check_once() is None           # empty store: nothing
+        publish_weights(store, 2, artifact_prefix=artifacts[2],
+                        params=_gen_params(0.5))
+        assert w.check_once() == 2
+        assert eng.weights_version == 2
+        assert gen.weights_version == 2
+        for x, r in zip(xs, refs2):
+            np.testing.assert_array_equal(
+                eng.infer_sync([x], timeout=30)[0], r)
+        toks = gen.generate_sync([1, 2, 3], timeout=60, max_new_tokens=6,
+                                 temperature=0.7, seed=5)
+        assert toks == ref_toks
+        st = eng.stats()
+        assert st["recompiles_after_warmup"] == 0
+        assert st["counters"]["weight_swaps"] == 1
+        assert st["weights_version"] == 2
+        gs = gen.stats()
+        assert gs["recompiles_after_warmup"] == 0
+        assert gs["counters"]["weight_swaps"] == 1
+        assert monitor.get_stat("serving.swap.applied") == 1
+        assert w.check_once() is None           # already applied: no-op
+        assert monitor.get_stat("serving.swap.applied") == 1
+    finally:
+        eng.close()
+        gen.close()
+        ref_gen.close()
+
+
+def test_background_watcher_applies(tmp_path, artifacts):
+    eng, _ = _engine(artifacts[1])
+    w = None
+    try:
+        store = SnapshotStore(str(tmp_path))
+        w = WeightWatcher(store, engine=eng, poll_s=0.02).start()
+        publish_weights(store, 2, artifact_prefix=artifacts[2])
+        deadline = time.monotonic() + 60
+        while w.version != 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert w.version == 2
+        assert eng.weights_version == 2
+    finally:
+        if w is not None:
+            w.stop()
+        eng.close()
+
+
+# --------------------------------------------------- rejection path --
+def test_corrupt_snapshot_rejected_and_pinned(tmp_path, artifacts):
+    monitor.stat_reset()
+    eng, pred1 = _engine(artifacts[1])
+    try:
+        store = SnapshotStore(str(tmp_path))
+        w = WeightWatcher(store, engine=eng)
+        snap = publish_weights(store, 2, artifact_prefix=artifacts[2])
+        _flip_byte(os.path.join(store.dir, snap["dir"],
+                                f"{ARTIFACT_PAYLOAD}.pdparams"))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert w.check_once() is None
+        assert w.last_rejected == 2
+        assert eng.weights_version == 0
+        assert monitor.get_stat("serving.swap.rejected") == 1
+        # pinned: the next poll does not re-attempt the bad version
+        assert w.check_once() is None
+        assert monitor.get_stat("serving.swap.rejected") == 1
+        x = (np.ones((2, 8)) / 4.0).astype(np.float32)
+        np.testing.assert_array_equal(
+            eng.infer_sync([x], timeout=30)[0],
+            np.asarray(pred1.run([x])[0]))      # still serving v0
+    finally:
+        eng.close()
+
+
+def test_partial_and_foreign_snapshots(tmp_path, artifacts):
+    monitor.stat_reset()
+    eng, _ = _engine(artifacts[1])
+    gen = serving.GenerationEngine(make_dyadic_lm(), num_slots=2,
+                                   page_size=4, max_context=64)
+    try:
+        store = SnapshotStore(str(tmp_path))
+        # params-only snapshot, inference-only replica: not a payload
+        # this watcher serves — skipped quietly (a training checkpoint
+        # sharing the store must not poison the swap loop)
+        w_inf = WeightWatcher(store, engine=eng)
+        publish_weights(store, 2, params=_gen_params(0.5))
+        assert w_inf.check_once() is None
+        assert w_inf.last_rejected is None
+        assert monitor.get_stat("serving.swap.rejected") == 0
+        # same snapshot, replica serving BOTH engines: partial → reject
+        w_both = WeightWatcher(store, engine=eng, generation=gen)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert w_both.check_once() is None
+        assert w_both.last_rejected == 2
+        assert "partial snapshot" in w_both.last_error
+        assert eng.weights_version == 0
+        assert gen.weights_version == 0
+    finally:
+        eng.close()
+        gen.close()
+
+
+def test_mismatched_artifact_rejected_before_commit(tmp_path, artifacts):
+    """A replacement whose shapes disagree with the serving signature
+    must fail in prewarm — off the dispatch path, before any commit."""
+    paddle.seed(9)
+    m = make_dyadic_model(in_dim=4, hidden=8, out_dim=2)
+    prefix = os.path.join(str(tmp_path), "narrow")
+    jit.save(m, prefix, input_spec=[InputSpec([None, 4], "float32")])
+    eng, pred1 = _engine(artifacts[1])
+    try:
+        store = SnapshotStore(os.path.join(str(tmp_path), "s"))
+        w = WeightWatcher(store, engine=eng)
+        publish_weights(store, 2, artifact_prefix=prefix)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert w.check_once() is None
+        assert w.last_rejected == 2
+        assert "artifact rejected" in w.last_error
+        assert eng.weights_version == 0
+        x = (np.ones((1, 8)) / 4.0).astype(np.float32)
+        np.testing.assert_array_equal(
+            eng.infer_sync([x], timeout=30)[0],
+            np.asarray(pred1.run([x])[0]))
+    finally:
+        eng.close()
+
+
+def test_prewarm_rejects_input_name_mismatch(artifacts):
+    class WrongSignature:
+        def get_input_names(self):
+            return ["a", "b"]
+
+    eng, _ = _engine(artifacts[1])
+    try:
+        # the name gate fires before any feed is built or run
+        with pytest.raises(ValueError, match="replacement artifact"):
+            eng.prewarm_predictor(WrongSignature())
+    finally:
+        eng.close()
+
+
+def test_swap_on_closed_engine_raises(artifacts):
+    eng, pred = _engine(artifacts[1])
+    eng.close()
+    with pytest.raises(serving.EngineClosed):
+        eng.swap_predictor(pred, 1)
+
+
+# ----------------------------------------------------- rollback path --
+def test_rollback_when_generation_apply_fails(tmp_path, artifacts):
+    """Artifact verifies and commits to inference, then the generation
+    params are rejected (shape mismatch): the replica must never serve
+    two versions — the inference commit is rolled back, still warm."""
+    monitor.stat_reset()
+    eng, pred1 = _engine(artifacts[1])
+    gen = serving.GenerationEngine(make_dyadic_lm(), num_slots=2,
+                                   page_size=4, max_context=64)
+    try:
+        store = SnapshotStore(str(tmp_path))
+        w = WeightWatcher(store, engine=eng, generation=gen)
+        bad = _gen_params(0.5)
+        k0 = sorted(bad)[0]
+        bad[k0] = bad[k0].reshape(-1)           # wrong shape: rejected
+        publish_weights(store, 2, artifact_prefix=artifacts[2],
+                        params=bad)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert w.check_once() is None
+        assert w.last_rejected == 2
+        assert "generation apply failed" in w.last_error
+        assert eng.weights_version == 0         # rolled back
+        assert gen.weights_version == 0
+        assert monitor.get_stat("serving.swap.rolled_back") == 1
+        assert monitor.get_stat("serving.swap.applied") == 0
+        st = eng.stats()
+        assert st["counters"]["weight_swaps"] == 2  # commit + rollback
+        assert st["recompiles_after_warmup"] == 0   # old pred still warm
+        x = (np.ones((2, 8)) / 4.0).astype(np.float32)
+        np.testing.assert_array_equal(
+            eng.infer_sync([x], timeout=30)[0],
+            np.asarray(pred1.run([x])[0]))
+    finally:
+        eng.close()
+        gen.close()
+
+
+# ------------------------------------------------------ observability --
+def test_healthz_and_prometheus_surfaces(tmp_path, artifacts):
+    eng, _ = _engine(artifacts[1])
+    srv = serving.ServingServer(eng, port=0, ready=False).start()
+    try:
+        client = serving.Client(srv.url)
+        h = client.healthz()
+        assert h["status"] == "warming" and h["ready"] is False
+        assert client._retry_after > 0          # Retry-After honored
+        srv.mark_ready()
+        h = client.healthz()
+        assert h["ready"] is True and h["weights_version"] == 0
+        store = SnapshotStore(str(tmp_path))
+        w = WeightWatcher(store, engine=eng)
+        publish_weights(store, 5, artifact_prefix=artifacts[2])
+        assert w.check_once() == 5
+        assert client.healthz()["weights_version"] == 5
+        text = client.metrics_text()
+        assert "paddle_tpu_serving_weights_version 5" in text
+        assert "paddle_tpu_serving_ready 1" in text
+        srv.mark_unready()
+        assert client.healthz()["status"] == "warming"
+        assert "paddle_tpu_serving_ready 0" in client.metrics_text()
+    finally:
+        srv.close()
+        eng.close()
+
+
+# ------------------------------------------------- cost contracts ----
+def test_disabled_path_cost_contracts():
+    """Swap support must cost the steady state exactly one attribute
+    check in the scheduler loop and NOTHING on the per-batch / per-step
+    hot paths; supervised liveness is one heartbeat hook per dispatch."""
+    from paddle_tpu.serving.engine import InferenceEngine
+    from paddle_tpu.serving.generation import GenerationEngine
+    loop = GenerationEngine._loop.__code__.co_names
+    assert "_pending_swap" in loop
+    assert "_commit_swap_locked" in loop
+    for fn in (GenerationEngine._decode_step, GenerationEngine._prefill):
+        names = fn.__code__.co_names
+        assert "_pending_swap" not in names
+        assert "_commit_swap_locked" not in names
+        assert "swap_weights" not in names
+    exe = InferenceEngine._execute.__code__.co_names
+    assert "_pending_swap" not in exe
+    assert "swap_predictor" not in exe
+    assert "_heartbeat" in exe      # the one supervised-liveness hook
+    assert "_heartbeat" in GenerationEngine._decode_step.__code__.co_names

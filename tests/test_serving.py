@@ -2,8 +2,12 @@
 (bitwise batched-vs-unbatched equivalence, zero recompiles after warmup,
 deadlines, shedding, drain), the HTTP front-end under concurrent
 clients, the Predictor pad-to-bucket satellite, and monitor histograms.
+ISSUE 18 adds the self-healing rails: close() hard deadline under a
+wedged dispatcher, SIGTERM during warmup, the readiness split, and the
+client restart ride-through.
 """
 import os
+import signal
 import threading
 import time
 
@@ -14,7 +18,7 @@ import paddle_tpu as paddle
 from paddle_tpu import inference, jit, nn, serving
 from paddle_tpu.jit import InputSpec
 from paddle_tpu.testing import fault
-from paddle_tpu.testing.chaos import make_dyadic_model
+from paddle_tpu.testing.chaos import make_dyadic_lm, make_dyadic_model
 from paddle_tpu.utils import monitor
 
 
@@ -553,6 +557,175 @@ def test_engine_does_not_slice_unbatched_output(tmp_path):
         eng.close()
 
 
+# ------------------------------------------- self-healing rails ------
+def test_close_deadline_with_wedged_dispatcher(artifact):
+    """ISSUE 18 regression: a dispatcher wedged inside a faulted
+    dispatch must not hold close(timeout=) past its budget — the wedged
+    batch's futures fail in-band and nothing is stranded."""
+    eng, _ = _engine(artifact)
+    with fault.inject("serving.dispatch:action=sleep,secs=5,count=1"):
+        f = eng.infer([np.ones((1, 8), np.float32)])
+        time.sleep(0.2)             # dispatcher picks it up and wedges
+        t0 = time.monotonic()
+        eng.close(timeout=1.0)
+        elapsed = time.monotonic() - t0
+    assert elapsed < 4.0            # hard deadline, not the 5 s wedge
+    assert f.done()
+    with pytest.raises(serving.EngineClosed):
+        f.result(timeout=0)
+    assert eng.stats()["counters"]["closed_stranded"] == 1
+
+
+def _sigterm_raises():
+    """Install the serving CLI's SIGTERM semantics (raise to unwind);
+    returns the handler to restore."""
+    def handler(signum, frame):
+        raise KeyboardInterrupt
+    return signal.signal(signal.SIGTERM, handler)
+
+
+def test_sigterm_during_inference_warmup(artifact):
+    """SIGTERM landing inside warmup() (not just mid-stream): requests
+    accepted before the signal still serve through the standard
+    drain/close path — in-band, no stranded future."""
+    pred = inference.create_predictor(inference.Config(artifact))
+    eng = serving.InferenceEngine(pred, max_batch_size=8,
+                                  batch_timeout_ms=5.0)
+    orig_feeds = eng._bucket_feeds
+
+    def feeds_then_sigterm(rest_shapes):
+        it = orig_feeds(rest_shapes)
+        yield next(it)              # first bucket compiles...
+        os.kill(os.getpid(), signal.SIGTERM)    # ...then the signal
+        yield from it
+
+    eng._bucket_feeds = feeds_then_sigterm
+    x = (np.ones((2, 8)) / 4.0).astype(np.float32)
+    futs = [eng.infer([x]) for _ in range(4)]   # accepted pre-warmup
+    prev = _sigterm_raises()
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            eng.warmup()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    eng._bucket_feeds = orig_feeds
+    assert eng.drain(timeout=60)    # the serve.py shutdown sequence
+    ref = np.asarray(pred.run([x])[0])
+    for f in futs:
+        assert f.done()
+        np.testing.assert_array_equal(f.result(timeout=0)[0], ref)
+    eng.close()
+    assert eng.stats()["counters"]["closed_stranded"] == 0
+
+
+def test_sigterm_during_generation_warmup():
+    """The generation twin: sequences admitted before the signal finish
+    (or fail in-band) and the page pool is fully reclaimed."""
+    eng = serving.GenerationEngine(make_dyadic_lm(), num_slots=2,
+                                   page_size=4, max_context=64)
+    results = []
+
+    def client(i):
+        try:
+            results.append(eng.generate_sync(
+                [1, 2, 3, 4], timeout=120, max_new_tokens=4,
+                temperature=0.7, seed=i))
+        except serving.ServingError as e:
+            results.append(e)       # in-band is acceptable; silence not
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    orig_exec = eng._get_exec
+    fired = []
+
+    def exec_then_sigterm(kind, bucket):
+        r = orig_exec(kind, bucket)
+        if not fired and threading.current_thread() \
+                is threading.main_thread():
+            fired.append(1)         # only interrupt the warmup caller,
+            os.kill(os.getpid(), signal.SIGTERM)    # not the scheduler
+        return r
+
+    eng._get_exec = exec_then_sigterm
+    prev = _sigterm_raises()
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            eng.warmup()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    eng._get_exec = orig_exec
+    for t in threads:
+        t.join(120)
+    assert eng.drain(timeout=60)
+    eng.close()
+    st = eng.stats()
+    assert len(results) == 2
+    for r in results:
+        assert isinstance(r, (list, serving.ServingError))
+    assert st["page_pool"]["in_use"] == 0
+    assert st["counters"]["pages_allocated"] \
+        == st["counters"]["pages_freed"]
+
+
+def test_healthz_readiness_split(artifact):
+    """Liveness vs readiness: a live-but-warming replica answers 503 +
+    Retry-After (hold traffic, don't restart); mark_ready flips 200."""
+    eng, _ = _engine(artifact)
+    srv = serving.ServingServer(eng, port=0, ready=False).start()
+    try:
+        client = serving.Client(srv.url)
+        h = client.healthz()
+        assert h == {"status": "warming", "engine_state": "running",
+                     "ready": False, "weights_version": 0}
+        assert client._retry_after > 0      # Retry-After noted: it
+        # floors the reconnect backoff during a restart window
+        srv.mark_ready()
+        assert client.healthz()["ready"] is True
+        srv.mark_unready()          # drain window: down without dying
+        assert client.healthz()["ready"] is False
+        srv.mark_ready()
+        assert client.healthz()["ready"] is True
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_client_rides_through_replica_restart(artifact):
+    """Satellite b: connection-refused on an idempotent request retries
+    on a fresh connection with backoff — a supervised restart window is
+    a pause, not a hard failure — counted in client.reconnects."""
+    eng, pred = _engine(artifact)
+    srv = serving.ServingServer(eng, port=0).start()
+    port = srv.port
+    x = (np.ones((2, 8)) / 4.0).astype(np.float32)
+    ref = np.asarray(pred.run([x])[0])
+    srv.close()                         # the replica goes down
+    # a fresh client: both initial attempts hit the refused port, the
+    # jittered backoff (>= 0.5 s here) spans the restart, the final
+    # attempt lands on the reborn replica
+    client = serving.Client(srv.url)
+    client.reconnect_backoff_s = 1.0
+    box = {}
+
+    def restart():
+        time.sleep(0.1)                 # well inside the backoff window
+        box["srv"] = serving.ServingServer(eng, port=port).start()
+
+    t = threading.Thread(target=restart)
+    t.start()
+    try:
+        out = client.predict([x])
+        np.testing.assert_array_equal(out[0], ref)
+        assert client.reconnects >= 1
+        assert monitor.get_stat("client.reconnects") >= 1
+    finally:
+        t.join()
+        box["srv"].close()
+        eng.close()
+
+
 # ------------------------------------------------- monitor histograms --
 def test_stat_observe_and_quantile():
     monitor.stat_reset("t.lat")
@@ -607,3 +780,27 @@ def test_serve_smoke_in_process():
 def test_serving_chaos_in_process():
     from paddle_tpu.testing import chaos
     assert chaos.serving_main(requests=24, clients=3) == 0
+
+
+@pytest.mark.slow
+def test_serve_smoke_hotswap_in_process():
+    """Kept out of tier-1 for runtime; CI runs tools/serve_smoke.py."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import serve_smoke
+        failures = serve_smoke.run_hotswap_checks()
+    finally:
+        sys.path.pop(0)
+    assert failures == [], failures
+
+
+@pytest.mark.slow
+def test_swap_chaos_in_process(tmp_path):
+    """Swap-under-fire part one: three live swaps + a corrupted
+    snapshot under concurrent clients (the supervised-replica leg runs
+    in tools/chaos_smoke.py, which spawns real child processes).  Kept
+    out of tier-1 for runtime; CI runs chaos_smoke --scenario swap."""
+    from paddle_tpu.testing import chaos
+    assert chaos.swap_main(supervised=False, workdir=str(tmp_path)) == 0

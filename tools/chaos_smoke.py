@@ -35,6 +35,17 @@ Scenarios (``--scenario``, default ``all``):
   fault-free run with zero manual intervention and the kill, restart
   reasons and snapshot resumes are visible in ``supervisor.*`` stats,
   the exit history and the kill-time flight dump.
+- ``swap`` — :func:`paddle_tpu.testing.chaos.swap_main`: digest-verified
+  zero-downtime weight hot swap under fire — a WeightWatcher applies
+  three live swaps to an InferenceEngine and a GenerationEngine while
+  concurrent clients hammer both, then one deliberately corrupted
+  snapshot must be rejected with the old weights still serving, and a
+  ServingSupervisor-managed replica hard-crashes mid-traffic and is
+  restarted; fails unless every response is bitwise-correct for its
+  weights version, readiness stays green through every applied swap,
+  the hot paths never recompile, no future is stranded, the page pool
+  is reclaimed, and clients ride through the restart via the reconnect
+  path.
 - ``anomaly`` — :func:`paddle_tpu.testing.chaos.anomaly_main`: the
   data-plane counterpart on mesh ``{dp: 8}`` with int8+error-feedback
   grad_comm: injected NaN batches, a non-finite gradient bucket, one
@@ -48,7 +59,7 @@ Scenarios (``--scenario``, default ``all``):
 
 Usage::
 
-    python tools/chaos_smoke.py [--scenario all|training|serving|generation|reshard|supervise|anomaly]
+    python tools/chaos_smoke.py [--scenario all|training|serving|generation|swap|reshard|supervise|anomaly]
                                 [--epochs 4] [--verbose]
 
 CI treats a non-zero exit as a robustness regression.  The same flows
@@ -70,7 +81,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     ap.add_argument("--scenario", default="all",
                     choices=["all", "training", "serving", "generation",
-                             "reshard", "supervise", "anomaly"])
+                             "swap", "reshard", "supervise", "anomaly"])
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
@@ -93,6 +104,8 @@ def main(argv=None) -> int:
         rc |= chaos.serving_main(verbose=args.verbose)
     if args.scenario in ("all", "generation"):
         rc |= chaos.generation_main(verbose=args.verbose)
+    if args.scenario in ("all", "swap"):
+        rc |= chaos.swap_main(verbose=args.verbose)
     if args.scenario == "reshard":
         rc |= chaos.reshard_main(verbose=args.verbose)
     if args.scenario == "supervise":

@@ -17,6 +17,16 @@ the hot path never compiles, and serve:
 with a leading batch dim.  SIGINT/SIGTERM drain in-flight work before
 exit.  See README "Serving" for bucket/padding and backpressure
 semantics.
+
+The HTTP plane binds *before* warmup with readiness down: ``/healthz``
+answers 503 + ``Retry-After`` (``"warming"``) until the buckets are
+compiled, then flips to 200 — a supervisor or load balancer holds
+traffic instead of timing out on a compiling replica.  With
+``--weights-dir`` a :class:`~paddle_tpu.serving.WeightWatcher` polls
+that :class:`~paddle_tpu.utils.checkpoint.SnapshotStore` directory and
+hot-swaps newly published, digest-verified weights into the live
+engine with zero downtime and zero recompiles (see README "Serving
+operations").
 """
 from __future__ import annotations
 
@@ -53,6 +63,13 @@ def main(argv=None) -> int:
                          "dims are symbolic)")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip AOT warmup (first requests will compile)")
+    ap.add_argument("--weights-dir", default=None,
+                    help="SnapshotStore directory to watch for hot-swap "
+                         "weight snapshots (publish_weights); new "
+                         "digest-verified versions swap in with zero "
+                         "downtime")
+    ap.add_argument("--weights-poll-s", type=float, default=2.0,
+                    help="meta-poll cadence of the weight watcher")
     ap.add_argument("--verbose", action="store_true",
                     help="log every HTTP request")
     args = ap.parse_args(argv)
@@ -67,15 +84,27 @@ def main(argv=None) -> int:
         predictor, max_batch_size=args.max_batch_size,
         batch_timeout_ms=args.batch_timeout_ms, max_queue=args.max_queue,
         default_deadline_ms=args.deadline_ms, buckets=buckets)
+    # bind the HTTP plane first, not-ready: liveness probes answer (503
+    # "warming" + Retry-After) while the buckets compile, and readiness
+    # flips only when the hot path is warm
+    srv = serving.ServingServer(engine, host=args.host, port=args.port,
+                                verbose=args.verbose, ready=False).start()
+    rest = ([tuple(int(d) for d in s.split(","))
+             for s in args.rest_shape] if args.rest_shape else None)
     if not args.no_warmup:
-        rest = ([tuple(int(d) for d in s.split(","))
-                 for s in args.rest_shape] if args.rest_shape else None)
         n = engine.warmup(rest_shapes=rest)
         print(f"warmed {len(engine.buckets)} buckets "
               f"{engine.buckets} -> {n} compiled variants", flush=True)
+    srv.mark_ready()
 
-    srv = serving.ServingServer(engine, host=args.host, port=args.port,
-                                verbose=args.verbose)
+    watcher = None
+    if args.weights_dir:
+        watcher = serving.WeightWatcher(
+            args.weights_dir, engine=engine,
+            poll_s=args.weights_poll_s, rest_shapes=rest).start()
+        print(f"watching {args.weights_dir} for weight snapshots",
+              flush=True)
+
     stop = {"sig": None}
 
     def _on_signal(signum, frame):
@@ -86,18 +115,21 @@ def main(argv=None) -> int:
     print(f"serving {args.model} on {srv.url}  "
           f"(POST /predict, GET /healthz, GET /metrics)", flush=True)
     try:
-        srv.serve_forever()
+        signal.pause()
     except KeyboardInterrupt:
         pass
     finally:
         print("draining...", flush=True)
+        if watcher is not None:
+            watcher.stop()
         srv.close()
         engine.drain(timeout=30.0)
         engine.close()
         c = engine.stats()["counters"]
         print(f"served {c['responses']}/{c['requests']} requests in "
               f"{c['batches']} batches (shed={c['shed']}, "
-              f"expired={c['deadline_expired']})", flush=True)
+              f"expired={c['deadline_expired']}, "
+              f"weight_swaps={c['weight_swaps']})", flush=True)
     return 0
 
 

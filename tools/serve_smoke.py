@@ -32,6 +32,20 @@ The **decode gate** (``run_decode_checks``) covers the generative path
    reclaimed (``in_use == 0``, allocated == freed): a leaked page is a
    capacity regression a long-lived server would die from.
 
+The **hot-swap gate** (``run_hotswap_checks``) covers the zero-downtime
+weight swap path:
+
+8. **zero recompiles across a swap** — publishing a new weights
+   snapshot and committing it through the
+   :class:`~paddle_tpu.serving.WeightWatcher` must not compile
+   anything on either engine (the replacement predictor prewarms off
+   the dispatch thread; generation weights are executable *arguments*).
+9. **readiness green** — ``/healthz`` answers 200/ready before,
+   during, and after the swap, and its ``weights_version`` advances.
+10. **per-version bitwise** — responses before the swap match the old
+    artifact's single-request answers exactly; responses after match
+    the new artifact's.
+
 Usage:  python tools/serve_smoke.py [--requests N] [--clients C]
 """
 from __future__ import annotations
@@ -230,6 +244,98 @@ def run_decode_checks(requests: int = 20, clients: int = 5,
     return failures
 
 
+def run_hotswap_checks(verbose: bool = False) -> list:
+    """Hot-swap gate; returns failure strings (empty = healthy)."""
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu import inference, serving
+    from paddle_tpu.serving.hotswap import WeightWatcher, publish_weights
+    from paddle_tpu.testing.chaos import (_scaled_artifact,
+                                          make_dyadic_lm)
+    from paddle_tpu.utils.checkpoint import SnapshotStore
+
+    failures = []
+    workdir = tempfile.mkdtemp(prefix="serve_smoke_swap_")
+    prefixes = {v: _scaled_artifact(s, workdir, f"v{v}")
+                for v, s in ((1, 1.0), (2, 0.5))}
+    preds = {v: inference.create_predictor(inference.Config(p))
+             for v, p in prefixes.items()}
+    rng = np.random.RandomState(7)
+    reqs = [(rng.randint(-8, 9, (rng.randint(1, 5), 8)) / 4.0)
+            .astype(np.float32) for _ in range(8)]
+    refs = {v: [np.asarray(preds[v].run([x])[0]) for x in reqs]
+            for v in preds}
+
+    base = {k: np.asarray(a).copy()
+            for k, a in make_dyadic_lm().params.items()}
+    engine = serving.InferenceEngine(preds[1], max_batch_size=8,
+                                     batch_timeout_ms=5.0)
+    engine.warmup()
+    gen = serving.GenerationEngine(make_dyadic_lm(), num_slots=4,
+                                   page_size=4, max_context=64)
+    gen.warmup()
+    srv = serving.ServingServer(engine, generation=gen, port=0).start()
+    client = serving.Client(srv.url)
+
+    def healthz_green(when):
+        h = client.healthz()
+        if not h.get("ready") or h.get("status") != "running":
+            failures.append(f"readiness not green {when}: {h}")
+        return h
+
+    healthz_green("before the swap")
+    for i, x in enumerate(reqs):
+        out = engine.infer_sync([x], timeout=30)
+        if not np.array_equal(out[0], refs[1][i]):
+            failures.append(f"pre-swap response {i} not bitwise at "
+                            f"version 1")
+
+    store = SnapshotStore(f"{workdir}/weights")
+    watcher = WeightWatcher(store, engine=engine, generation=gen)
+    publish_weights(store, 2, artifact_prefix=prefixes[2],
+                    params={k: a * 0.5 for k, a in base.items()})
+    applied = watcher.check_once()
+    if applied != 2:
+        failures.append(f"swap not applied (got {applied}, last_error="
+                        f"{watcher.last_error})")
+    h = healthz_green("after the swap")
+    if h.get("weights_version") != 2:
+        failures.append(f"/healthz weights_version="
+                        f"{h.get('weights_version')} after the swap, "
+                        f"expected 2")
+    for i, x in enumerate(reqs):
+        out = engine.infer_sync([x], timeout=30)
+        if not np.array_equal(out[0], refs[2][i]):
+            failures.append(f"post-swap response {i} not bitwise at "
+                            f"version 2")
+    gen.generate_sync([1, 2, 3], timeout=60, max_new_tokens=4)
+
+    srv.close()
+    engine.drain(timeout=30)
+    gen.drain(timeout=30)
+    stats = engine.stats()
+    gen_stats = gen.stats()
+    engine.close()
+    gen.close()
+    if stats["recompiles_after_warmup"] != 0:
+        failures.append(f"inference recompiled "
+                        f"{stats['recompiles_after_warmup']}x across "
+                        f"the swap")
+    if gen_stats["recompiles_after_warmup"] != 0:
+        failures.append(f"decode recompiled "
+                        f"{gen_stats['recompiles_after_warmup']}x "
+                        f"across the swap")
+    if verbose:
+        print(f"hotswap: applied v{applied}, engine swaps="
+              f"{stats['counters']['weight_swaps']}, decode swaps="
+              f"{gen_stats['counters']['weight_swaps']}, recompiles=0")
+    import shutil
+    shutil.rmtree(workdir, ignore_errors=True)
+    return failures
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     ap.add_argument("--requests", type=int, default=64)
@@ -241,6 +347,8 @@ def main(argv=None) -> int:
                           verbose=args.verbose)
     failures += [f"decode: {f}" for f in run_decode_checks(
         verbose=args.verbose)]
+    failures += [f"hotswap: {f}" for f in run_hotswap_checks(
+        verbose=args.verbose)]
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)
@@ -248,7 +356,8 @@ def main(argv=None) -> int:
     print("serve_smoke: engine healthy (0 hot-path recompiles, coalesced "
           "batches, bitwise-correct responses, no stuck futures; decode: "
           "0 steady-state recompiles, slots backfilled, page pool "
-          "reclaimed)")
+          "reclaimed; hotswap: applied with 0 recompiles, readiness "
+          "green, bitwise per version)")
     return 0
 
 
