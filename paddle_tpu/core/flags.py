@@ -283,6 +283,24 @@ define_flag("anomaly_spike_factor", 10.0,
             "corruption — e.g. a bitflipped wire payload — that the "
             "non-finite sentry cannot flag).  <= 0 disables the "
             "spike detector.")
+define_flag("compile_cache_dir", "",
+            "Persistent AOT executable cache directory.  When set, the "
+            "compiling layers that serve traffic (inference Predictor "
+            "buckets, GenerationEngine decode/prefill variants, the "
+            "static Executor's single-device inference step) serialize "
+            "each compiled executable through core/compile_cache.py and "
+            "reload it on the next cold start — a respawned replica "
+            "skips XLA entirely for warm buckets (cold-start-to-first-"
+            "token cut >5x; serve_smoke gates it).  Entries are keyed "
+            "by the recompile-attribution signature plus a jax/jaxlib/"
+            "backend/topology stamp, so a version or device change "
+            "invalidates cleanly (compile_cache.rejects) instead of "
+            "loading a stale executable.  We serialize ourselves via "
+            "jax.experimental.serialize_executable — jax's own "
+            "persistent compilation cache is deliberately NOT enabled "
+            "(it heap-corrupts reloading NamedSharding executables on "
+            "jaxlib 0.4.37; see core/xla_env.py / PR 8).  Empty = "
+            "disabled (no filesystem traffic).")
 define_flag("pallas_attention_dropout_min_seqlen", 512,
             "Flash threshold when attention dropout is active: the XLA "
             "path must materialize [B,H,L,L] dropout masks in HBM, so "

@@ -12,7 +12,12 @@ current jax spellings.  Differences are absorbed here, in one place:
 - ``jax.lax.pvary`` falls back to identity (only the new varying-type
   checker needs the annotation; we run with it disabled);
 - ``jax.ffi`` (jax 0.5) falls back to ``jax.extend.ffi`` — same
-  surface (ffi_call / include_dir / register_ffi_target / pycapsule).
+  surface (ffi_call / include_dir / register_ffi_target / pycapsule);
+- AOT executable serialization lives behind
+  :func:`serialize_executable` / :func:`deserialize_executable`
+  (``jax.experimental.serialize_executable`` today) so the persistent
+  compile cache (core/compile_cache.py) has exactly one seam to absorb
+  the next module move.
 """
 from __future__ import annotations
 
@@ -64,6 +69,34 @@ def axis_size(name) -> int:
         return fn(name)
     frame = jax.core.axis_frame(name)  # older jax: frame or bare int
     return getattr(frame, "size", frame)
+
+
+def executable_serialization_available() -> bool:
+    """Whether this jax can round-trip compiled executables at all."""
+    try:
+        from jax.experimental import serialize_executable  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def serialize_executable(compiled):
+    """``(payload_bytes, in_tree, out_tree)`` for a ``lower().compile()``
+    result.  The trees are picklable pytree defs; donation and static
+    shapes ride the payload.  This is OUR serialization path — jax's
+    persistent compilation cache stays off (it heap-corrupts reloading
+    NamedSharding executables on jaxlib 0.4.37)."""
+    from jax.experimental.serialize_executable import serialize
+    return serialize(compiled)
+
+
+def deserialize_executable(payload, in_tree, out_tree):
+    """Rebuild a callable ``Compiled`` from :func:`serialize_executable`
+    output on the current backend.  Raises on any incompatibility —
+    callers (compile_cache) treat every failure as a cache reject and
+    fall back to a fresh compile."""
+    from jax.experimental.serialize_executable import deserialize_and_load
+    return deserialize_and_load(payload, in_tree, out_tree)
 
 
 def pvary(x, axis_name):

@@ -12,6 +12,7 @@ path (the analog of the reference's ZeroCopyTensor path).
 """
 from __future__ import annotations
 
+import hashlib
 import itertools
 import pickle
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -173,9 +174,16 @@ class Predictor:
                     "save_inference_model with the new weights (the "
                     "artifact bakes them at export)")
         with open(config.prog_file + SUFFIX_MODEL, "rb") as f:
-            n = int.from_bytes(f.read(8), "little")
-            self._meta = pickle.loads(f.read(n))
-            self._exported = jax.export.deserialize(f.read())
+            raw = f.read()
+        # content digest of the whole artifact (meta + StableHLO +
+        # baked weights): the persistent compile cache keys on it, so
+        # two processes serving the same artifact share executables
+        # while a re-exported model (new weights, new graph) can never
+        # collide with the old one
+        self._artifact_digest = hashlib.sha256(raw).hexdigest()
+        n = int.from_bytes(raw[:8], "little")
+        self._meta = pickle.loads(raw[8:8 + n])
+        self._exported = jax.export.deserialize(raw[8 + n:])
         m = self._meta
         self._input_names = list(
             m.get("feed_names")
@@ -257,9 +265,24 @@ class Predictor:
             donate = (tuple(i for i, n in enumerate(self._input_names)
                             if n not in no_donate)
                       if jax.default_backend() == "tpu" else ())
-            fn = jax.jit(lambda *a: call(*a), donate_argnums=donate)
-            avals = [jax.ShapeDtypeStruct(s, d) for s, d in shapes_dtypes]
-            fn = fn.lower(*avals).compile()  # AOT: no trace on serve path
+            def build():
+                f = jax.jit(lambda *a: call(*a), donate_argnums=donate)
+                avals = [jax.ShapeDtypeStruct(s, d)
+                         for s, d in shapes_dtypes]
+                return f.lower(*avals).compile()  # AOT: no serve trace
+
+            # persistent AOT cache (FLAGS_compile_cache_dir): keyed by
+            # the artifact's content digest + this bucket's signature —
+            # a warm cold start deserializes instead of compiling, and
+            # the provenance ("loaded"/"compiled") rides the compile
+            # record so explain_compiles() shows which happened
+            from ..core import compile_cache
+            fn, cache_prov = compile_cache.cached_compile("predictor", {
+                "artifact": self._artifact_digest,
+                "bucket": tuple((tuple(s), str(d))
+                                for s, d in shapes_dtypes),
+                "donate": donate,
+            }, build)
             self._compiled[key] = fn
             self._register_bucket(shapes_dtypes)
             # recompile attribution AFTER the lower/compile succeeded —
@@ -271,7 +294,8 @@ class Predictor:
             record_compile("predictor", self._serial, {
                 "bucket": tuple(shapes_dtypes),
                 "undonated_inputs": tuple(sorted(no_donate)),
-            }, note="serve-path miss" if from_run else "aot")
+            }, note="serve-path miss" if from_run else "aot",
+                cache=cache_prov)
         return fn
 
     def _aot_compile(self):

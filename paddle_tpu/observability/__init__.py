@@ -39,7 +39,8 @@ import contextlib
 from typing import Optional
 
 from ..core import obs_hook
-from .compiles import explain_compiles, record_compile, reset_compiles
+from .compiles import (annotate_compile, explain_compiles,
+                       record_compile, reset_compiles)
 from .flight import (dump_flight, flight_recorder_path,
                      install_flight_recorder, uninstall_flight_recorder)
 from .metrics import dump_metrics, metrics_snapshot, prometheus_text
@@ -55,6 +56,7 @@ __all__ = [
     "Tracer", "EVENT_KINDS", "enable", "disable", "enabled",
     "get_tracer", "emit", "span", "counter", "set_step",
     "record_compile", "explain_compiles", "reset_compiles",
+    "annotate_compile",
     "prometheus_text", "metrics_snapshot", "dump_metrics",
     "install_flight_recorder", "uninstall_flight_recorder",
     "dump_flight", "flight_recorder_path",

@@ -29,7 +29,8 @@ from typing import Dict, List, Optional, Tuple
 from ..core import obs_hook
 from ..utils import monitor
 
-__all__ = ["record_compile", "explain_compiles", "reset_compiles"]
+__all__ = ["record_compile", "explain_compiles", "reset_compiles",
+           "annotate_compile"]
 
 _MAX_RECORDS = 2048          # ring of full records; totals never drop
 
@@ -53,7 +54,8 @@ def _freeze(v):
 def record_compile(component: str, identity, signature: Dict[str, object],
                    note: str = "", predicted: Optional[dict] = None,
                    kernels: Optional[List[str]] = None,
-                   comm: Optional[dict] = None) -> dict:
+                   comm: Optional[dict] = None,
+                   cache: Optional[str] = None) -> dict:
     """Report one compile.
 
     ``component``: "executor" | "jit" | "predictor" | ... .
@@ -79,6 +81,13 @@ def record_compile(component: str, identity, signature: Dict[str, object],
     path) — on the record, OUT of the signature (knob flips recompile
     through the plan fingerprint's ``sharding`` field), so overlap
     decisions are auditable from ``explain_compiles()``.
+    ``cache``: persistent-compile-cache provenance — ``"loaded"`` (the
+    executable was deserialized from ``FLAGS_compile_cache_dir``,
+    no XLA compile happened), ``"compiled"`` (fresh compile, stored for
+    next time), or ``"rejected:<why>"`` (a cache entry existed but its
+    version/topology stamp or device fingerprint mismatched; fresh
+    compile).  OUT of the signature for the same reason as the others:
+    cache state must never masquerade as a recompile cause.
     """
     sig = {k: _freeze(v) for k, v in signature.items()}
     now = time.time()
@@ -111,6 +120,8 @@ def record_compile(component: str, identity, signature: Dict[str, object],
             rec["kernels"] = list(kernels)
         if comm:
             rec["comm"] = dict(comm)
+        if cache:
+            rec["cache"] = str(cache)
         _records.append(rec)
         _totals[(component, cause)] += 1
     monitor.stat_add(f"compiles.{component}.{cause}")
@@ -121,6 +132,22 @@ def record_compile(component: str, identity, signature: Dict[str, object],
                  args={"cause": cause, "identity": str(identity),
                        "changed": sorted(changed)})
     return rec
+
+
+def annotate_compile(component: str, identity, cache: str) -> bool:
+    """Attach cache provenance to the NEWEST record of ``(component,
+    identity)`` after the fact.  The lazily-compiling Executor records
+    its compile when the cache key misses but only learns loaded-vs-
+    compiled at the first dispatch — this closes that gap so
+    ``explain_compiles()`` shows provenance for every site.  Returns
+    False when no record matches (nothing to annotate)."""
+    with _lock:
+        for rec in reversed(_records):
+            if (rec["component"] == component
+                    and rec["identity"] == identity):
+                rec["cache"] = str(cache)
+                return True
+    return False
 
 
 def explain_compiles(component: Optional[str] = None) -> dict:

@@ -32,6 +32,12 @@ users"):
   weights into live engines at batch/step boundaries with zero
   recompiles and no drain (corrupt snapshots rejected, partial
   multi-engine applies rolled back).
+- :mod:`paddle_tpu.serving.registry` — the multi-model control plane:
+  :class:`ModelRegistry` loads/unloads/aliases models at runtime (each
+  with its own engine(s) + watcher), routes requests by name with
+  weighted fair queuing across models and per-tenant quotas, and
+  :class:`ElasticityController` turns SLO burn rates into per-model
+  replica scaling (:class:`ReplicaSet`) and shed decisions.
 """
 from .engine import (DeadlineExceeded, EngineClosed,  # noqa: F401
                      InferenceEngine, QueueFull, ServingError)
@@ -40,10 +46,15 @@ from .generation import (GenerationEngine, GenerationError,  # noqa: F401
 from .hotswap import WeightWatcher, publish_weights  # noqa: F401
 from .kv_cache import KVCacheConfig, PagePool  # noqa: F401
 from .models import PagedDecoderLM  # noqa: F401
+from .registry import (ElasticityController, ModelEntry,  # noqa: F401
+                       ModelRegistry, QuotaExceeded, ReplicaSet,
+                       UnknownModel)
 from .http import Client, ServingServer  # noqa: F401
 
 __all__ = ["InferenceEngine", "ServingError", "QueueFull",
            "DeadlineExceeded", "EngineClosed", "ServingServer", "Client",
            "GenerationEngine", "GenerationError", "GenerationStream",
            "KVCacheConfig", "PagePool", "PagedDecoderLM",
-           "WeightWatcher", "publish_weights"]
+           "WeightWatcher", "publish_weights",
+           "ModelRegistry", "ModelEntry", "UnknownModel",
+           "QuotaExceeded", "ElasticityController", "ReplicaSet"]

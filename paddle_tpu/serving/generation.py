@@ -57,6 +57,7 @@ a single attribute check at the top of the scheduler loop.
 from __future__ import annotations
 
 import collections
+import hashlib
 import queue
 import threading
 import time
@@ -464,18 +465,33 @@ class GenerationEngine:
                 # gather is O(live context), not O(max_context)
                 S = self._slots_n
                 fn = jax.jit(self._decode_step_fn, donate_argnums=donate)
-                ex = fn.lower(params_avals, pool_aval, pool_aval,
-                              aval((S,), i32),
-                              aval((S,), i32), aval((S, bucket), i32),
-                              aval((S,), f32),
-                              aval((S, 2), jnp.uint32)).compile()
+                lowered = fn.lower(params_avals, pool_aval, pool_aval,
+                                   aval((S,), i32),
+                                   aval((S,), i32),
+                                   aval((S, bucket), i32),
+                                   aval((S,), f32),
+                                   aval((S, 2), jnp.uint32))
             else:
                 fn = jax.jit(self._prefill_fn, donate_argnums=donate)
-                ex = fn.lower(params_avals, pool_aval, pool_aval,
-                              aval((bucket,), i32),
-                              aval((), i32), aval((self._P,), i32),
-                              aval((), f32),
-                              aval((2,), jnp.uint32)).compile()
+                lowered = fn.lower(params_avals, pool_aval, pool_aval,
+                                   aval((bucket,), i32),
+                                   aval((), i32), aval((self._P,), i32),
+                                   aval((), f32),
+                                   aval((2,), jnp.uint32))
+            # persistent AOT cache: the key is the hash of the lowered
+            # module itself — exact program content, so two models that
+            # trace identically share the executable while ANY model/
+            # geometry difference (head count, sampling change) misses.
+            # weights_version is deliberately NOT in the key: params
+            # ride as runtime arguments, a hot swap reuses the same
+            # executable.  The trace above is cheap; the .compile() is
+            # what a warm cold start skips.
+            from ..core import compile_cache
+            ex, cache_prov = compile_cache.cached_compile("generation", {
+                "kind": kind, "bucket": bucket, "donate": donate,
+                "module": hashlib.sha256(
+                    lowered.as_text().encode()).hexdigest(),
+            }, lowered.compile)
             self._execs[key] = ex
             self._compile_count += 1
             from ..observability import record_compile
@@ -485,7 +501,8 @@ class GenerationEngine:
                 "page_size": c.page_size,
                 "weights_version": self._weights_version,
             }, note="warmup" if self._warm_variants is None
-                    else "serve-path miss")
+                    else "serve-path miss",
+                cache=cache_prov)
         return ex
 
     def warmup(self) -> int:

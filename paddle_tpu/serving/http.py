@@ -47,8 +47,29 @@ dispatcher coalesces into micro-batches):
   exposition — every ``monitor`` stat and histogram in the process plus
   the engine's own gauges under ``paddle_tpu_serving_engine_*``.
 
+With a :class:`~paddle_tpu.serving.registry.ModelRegistry` attached
+(``ServingServer(..., registry=...)``) the server becomes the
+multi-model control plane:
+
+- ``/predict`` and ``/generate`` route by model name or alias — the
+  JSON ``"model"`` field (or ``X-Model`` header on npy bodies), with
+  the registry default when absent so single-model clients keep
+  working; tenant attribution rides the ``"tenant"`` field /
+  ``X-Tenant`` header and feeds per-tenant quotas.
+- ``GET /admin/models`` — every model's state, weights version,
+  engines, in-flight count and weight, plus aliases / default /
+  quotas (:meth:`ModelRegistry.describe`).
+- ``POST /admin/models`` — control actions: ``{"action": "load",
+  "name": ..., "artifact": ...}`` (plus optional ``weights_dir``,
+  ``aliases``, ``weight``, ``rest_shapes``), ``"unload"``,
+  ``"alias"``/``"unalias"``, ``"quota"`` (tenant/rate/burst),
+  ``"weight"``, ``"default"``.  Load warms the model before the name
+  becomes routable; unload drains through the engines' existing
+  contracts and reports page-pool reclamation.
+
 Error mapping: shed -> 503 (+Retry-After), deadline -> 504, malformed
--> 400, engine closed -> 503.
+-> 400, engine closed -> 503, unknown model -> 404, tenant over
+quota -> 429 (+Retry-After).
 """
 from __future__ import annotations
 
@@ -69,6 +90,7 @@ from ..observability import perf as _perf, slo as _slo
 from ..utils import monitor
 from .engine import (DeadlineExceeded, EngineClosed, InferenceEngine,
                      QueueFull, ServingError)
+from .registry import ModelRegistry, QuotaExceeded, UnknownModel
 
 __all__ = ["ServingServer", "Client", "serve"]
 
@@ -96,6 +118,10 @@ class _Handler(BaseHTTPRequestHandler):
     @property
     def generation(self):
         return getattr(self.server, "generation", None)
+
+    @property
+    def registry(self) -> Optional[ModelRegistry]:
+        return getattr(self.server, "registry", None)
 
     def log_message(self, fmt, *args):      # quiet by default
         if getattr(self.server, "verbose", False):
@@ -134,7 +160,13 @@ class _Handler(BaseHTTPRequestHandler):
     def _reply_error(self, exc: BaseException):
         kind = type(exc).__name__
         payload = {"error": kind, "message": str(exc)}
-        if isinstance(exc, QueueFull):
+        # ordering: UnknownModel/QuotaExceeded are ServingError
+        # subclasses too — match the specific routing errors first
+        if isinstance(exc, UnknownModel):
+            self._reply_json(404, payload)
+        elif isinstance(exc, QuotaExceeded):
+            self._reply_json(429, payload, [("Retry-After", "1")])
+        elif isinstance(exc, QueueFull):
             self._reply_json(503, payload, [("Retry-After", "0")])
         elif isinstance(exc, (DeadlineExceeded, TimeoutError,
                               concurrent.futures.TimeoutError)):
@@ -157,9 +189,21 @@ class _Handler(BaseHTTPRequestHandler):
     # -- routes ------------------------------------------------------------
     def do_GET(self):
         path = self.path.split("?", 1)[0]
+        if path == "/admin/models":
+            if self.registry is None:
+                self._reply_json(501, {"error": "NotImplemented",
+                                       "message": "no model registry "
+                                                  "attached"})
+            else:
+                self._reply_json(200, self.registry.describe())
+            return
         if path == "/healthz":
             src = self.engine if self.engine is not None else self.generation
-            st = src.stats()["state"] if src is not None else "empty"
+            if src is None and self.registry is not None:
+                # registry mode: alive while it routes to anything
+                st = "running" if self.registry.models() else "empty"
+            else:
+                st = src.stats()["state"] if src is not None else "empty"
             wv = self._weights_version()
             retry = [("Retry-After", str(getattr(
                 self.server, "retry_after_s", 1)))]
@@ -205,6 +249,8 @@ class _Handler(BaseHTTPRequestHandler):
             gen = self.generation
             if gen is not None:
                 stats["generation"] = gen.stats()
+            if self.registry is not None:
+                stats["registry"] = self.registry.stats()
             if ("text/plain" in accept or "openmetrics" in accept
                     or "prometheus" in accept):
                 from ..observability import prometheus_text
@@ -223,7 +269,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # replica serves and whether it should receive traffic
                 st = stats.get("state",
                                self.generation.stats()["state"]
-                               if self.engine is None else "empty")
+                               if self.engine is None and
+                               self.generation is not None else "empty")
+                if (self.engine is None and self.generation is None
+                        and self.registry is not None
+                        and self.registry.models()):
+                    st = "running"
                 ready = (getattr(self.server, "ready", True)
                          and st in ("running", "paused"))
                 gauges[f"serving_weights_version{lab}"] = \
@@ -253,11 +304,15 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/generate":
             self._do_generate()
             return
+        if path == "/admin/models":
+            self._do_admin()
+            return
         if path != "/predict":
             self._reply_json(404, {"error": "NotFound",
                                    "message": self.path})
             return
-        if self.engine is None:
+        reg = self.registry
+        if reg is None and self.engine is None:
             self._reply_json(501, {"error": "NotImplemented",
                                    "message": "no inference engine "
                                               "attached"})
@@ -266,6 +321,8 @@ class _Handler(BaseHTTPRequestHandler):
             n = int(self.headers.get("Content-Length", "0"))
             body = self.rfile.read(n)
             ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+            model = self.headers.get("X-Model")
+            tenant = self.headers.get("X-Tenant")
             if ctype == _NPY:
                 arr = np.load(io.BytesIO(body), allow_pickle=False)
                 inputs = [arr]
@@ -277,9 +334,23 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError('body must carry "inputs"')
                 inputs = payload["inputs"]
                 deadline_ms = payload.get("deadline_ms")
+                model = payload.get("model") or model
+                tenant = payload.get("tenant") or tenant
             timeout = self.server.request_timeout
-            outs = self.engine.infer_sync(inputs, deadline_ms=deadline_ms,
-                                          timeout=timeout)
+            if reg is not None:
+                # registry routing: model/alias resolution, shed flag,
+                # tenant quota and WFQ share all sit in front of the
+                # routed engine's own queue
+                eng = reg.resolve(model).engine
+                if eng is None:
+                    raise UnknownModel(
+                        f"model {model!r} has no inference engine")
+                outs = reg.infer(model, inputs, tenant=tenant,
+                                 deadline_ms=deadline_ms).result(timeout)
+            else:
+                eng = self.engine
+                outs = eng.infer_sync(inputs, deadline_ms=deadline_ms,
+                                      timeout=timeout)
         except Exception as e:              # noqa: BLE001 - mapped to HTTP
             self._reply_error(e)
             return
@@ -290,14 +361,15 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply_json(200, {
                 "outputs": [o.tolist() for o in outs],
-                "names": self.engine._pred.get_output_names(),
+                "names": eng._pred.get_output_names(),
                 "dtypes": [str(o.dtype) for o in outs],
             })
 
     def _do_generate(self):
         import queue as _queue
+        reg = self.registry
         gen = self.generation
-        if gen is None:
+        if gen is None and reg is None:
             self._reply_json(501, {"error": "NotImplemented",
                                    "message": "no generation engine "
                                               "attached"})
@@ -313,7 +385,15 @@ class _Handler(BaseHTTPRequestHandler):
                       "deadline_ms"):
                 if payload.get(k) is not None:
                     kw[k] = payload[k]
-            s = gen.generate(payload["prompt"], **kw)
+            if reg is not None:
+                model = (payload.get("model")
+                         or self.headers.get("X-Model"))
+                tenant = (payload.get("tenant")
+                          or self.headers.get("X-Tenant"))
+                s = reg.generate(model, payload["prompt"],
+                                 tenant=tenant, **kw)
+            else:
+                s = gen.generate(payload["prompt"], **kw)
         except Exception as e:          # noqa: BLE001 - mapped to HTTP
             self._reply_error(e)
             return
@@ -350,6 +430,58 @@ class _Handler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionError):
             pass                        # client went away mid-stream
 
+    def _do_admin(self):
+        """``POST /admin/models``: registry control actions.  Missing
+        fields map to 400 (KeyError), unknown names to 404, so a fat-
+        fingered admin call can never crash the data plane."""
+        reg = self.registry
+        if reg is None:
+            self._reply_json(501, {"error": "NotImplemented",
+                                   "message": "no model registry "
+                                              "attached"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            p = json.loads(self.rfile.read(n) or b"{}")
+            action = p.get("action")
+            if action == "load":
+                entry = reg.load(
+                    p["name"], p["artifact"],
+                    weights_dir=p.get("weights_dir"),
+                    aliases=p.get("aliases", ()),
+                    weight=float(p.get("weight", 1.0)),
+                    warmup=bool(p.get("warmup", True)),
+                    rest_shapes=p.get("rest_shapes"),
+                    engine_kwargs=p.get("engine_kwargs"))
+                self._reply_json(200, {"loaded": p["name"],
+                                       "state": entry.state})
+            elif action == "unload":
+                self._reply_json(200, reg.unload(
+                    p["name"], timeout=float(p.get("timeout", 30.0))))
+            elif action == "alias":
+                reg.alias(p["alias"], p["target"])
+                self._reply_json(200, {"alias": p["alias"],
+                                       "target": p["target"]})
+            elif action == "unalias":
+                reg.unalias(p["alias"])
+                self._reply_json(200, {"unalias": p["alias"]})
+            elif action == "quota":
+                reg.set_quota(p["tenant"], float(p["rate"]),
+                              p.get("burst"))
+                self._reply_json(200, {"tenant": p["tenant"],
+                                       "rate": float(p["rate"])})
+            elif action == "weight":
+                reg.set_weight(p["name"], float(p["weight"]))
+                self._reply_json(200, {"model": p["name"],
+                                       "weight": float(p["weight"])})
+            elif action == "default":
+                reg.set_default(p["name"])
+                self._reply_json(200, {"default": p["name"]})
+            else:
+                raise ValueError(f"unknown admin action {action!r}")
+        except Exception as e:          # noqa: BLE001 - mapped to HTTP
+            self._reply_error(e)
+
 
 class ServingServer:
     """Threaded HTTP server bound to one engine.
@@ -363,14 +495,20 @@ class ServingServer:
                  host: str = "127.0.0.1",
                  port: int = 8000, request_timeout: float = 60.0,
                  verbose: bool = False, generation=None,
-                 ready: bool = True, retry_after_s: float = 1.0):
-        if engine is None and generation is None:
+                 ready: bool = True, retry_after_s: float = 1.0,
+                 registry: Optional[ModelRegistry] = None):
+        if engine is None and generation is None and registry is None:
             raise ValueError("attach an InferenceEngine, a "
-                             "GenerationEngine, or both")
+                             "GenerationEngine, a ModelRegistry, or a "
+                             "combination")
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.engine = engine
         self._httpd.generation = generation
+        # a registry takes over /predict + /generate routing and
+        # enables the /admin/models control plane; a direct engine/
+        # generation may still be attached (it serves /metrics detail)
+        self._httpd.registry = registry
         self._httpd.request_timeout = request_timeout
         self._httpd.verbose = verbose
         # readiness split: ``ready=False`` lets a supervised replica
@@ -472,7 +610,15 @@ class Client:
     can back off on shed exactly as an in-process caller would."""
 
     def __init__(self, base_url: str, timeout: float = 60.0,
-                 reconnect_backoff_s: float = 0.2):
+                 reconnect_backoff_s: float = 0.2,
+                 model: Optional[str] = None,
+                 tenant: Optional[str] = None):
+        # multi-model routing: ``model`` pins every request from this
+        # client to one registry entry (per-call ``model=`` overrides);
+        # ``tenant`` attributes them to a quota bucket.  Both are None
+        # for single-model servers — the wire format is unchanged.
+        self.model = model
+        self.tenant = tenant
         self.base_url = base_url.rstrip("/")
         u = urlsplit(self.base_url)
         if u.scheme not in ("http", ""):
@@ -579,10 +725,23 @@ class Client:
             payload = {}
         kind = payload.get("error", "")
         msg = payload.get("message", "")
-        for cls in (QueueFull, DeadlineExceeded, EngineClosed):
+        for cls in (QueueFull, DeadlineExceeded, EngineClosed,
+                    UnknownModel, QuotaExceeded):
             if kind == cls.__name__:
                 raise cls(msg) from None
         raise ServingError(f"HTTP {status}: {kind or ''} {msg}")
+
+    def _route(self, body: dict, model: Optional[str],
+               tenant: Optional[str]) -> dict:
+        """Stamp multi-model routing fields (per-call override, then
+        the client defaults) into a JSON request body."""
+        m = model if model is not None else self.model
+        t = tenant if tenant is not None else self.tenant
+        if m is not None:
+            body["model"] = m
+        if t is not None:
+            body["tenant"] = t
+        return body
 
     def _post(self, path: str, body: bytes, headers: dict) -> bytes:
         r = self._request("POST", path, body=body, headers=headers)
@@ -605,8 +764,9 @@ class Client:
             self._raise_for(r.status, raw)
         return json.loads(raw.decode())
 
-    def predict(self, inputs, deadline_ms: Optional[float] = None
-                ) -> List[np.ndarray]:
+    def predict(self, inputs, deadline_ms: Optional[float] = None,
+                model: Optional[str] = None,
+                tenant: Optional[str] = None) -> List[np.ndarray]:
         """JSON round trip; returns host arrays with the server dtypes.
 
         Wire format (unambiguous by construction): ``inputs`` is ALWAYS
@@ -620,7 +780,7 @@ class Client:
                     inputs, (list, tuple)):
                 inputs = [inputs]
             payload = [np.asarray(a).tolist() for a in inputs]
-        body = {"inputs": payload}
+        body = self._route({"inputs": payload}, model, tenant)
         if deadline_ms is not None:
             body["deadline_ms"] = deadline_ms
         raw = self._post("/predict", json.dumps(body).encode(),
@@ -630,12 +790,21 @@ class Client:
                 for o, dt in zip(res["outputs"], res["dtypes"])]
 
     def predict_npy(self, arr: np.ndarray,
-                    deadline_ms: Optional[float] = None) -> np.ndarray:
+                    deadline_ms: Optional[float] = None,
+                    model: Optional[str] = None,
+                    tenant: Optional[str] = None) -> np.ndarray:
         buf = io.BytesIO()
         np.save(buf, np.asarray(arr), allow_pickle=False)
         headers = {"Content-Type": _NPY}
         if deadline_ms is not None:
             headers["X-Deadline-Ms"] = str(deadline_ms)
+        # npy bodies have no JSON envelope: routing rides the headers
+        m = model if model is not None else self.model
+        t = tenant if tenant is not None else self.tenant
+        if m is not None:
+            headers["X-Model"] = m
+        if t is not None:
+            headers["X-Tenant"] = t
         raw = self._post("/predict", buf.getvalue(), headers)
         return np.load(io.BytesIO(raw), allow_pickle=False)
 
@@ -660,28 +829,35 @@ class Client:
         return raw.decode()
 
     # -- generation --------------------------------------------------------
-    def _generate_body(self, prompt, stream: bool, kw: dict) -> bytes:
+    def _generate_body(self, prompt, stream: bool, kw: dict,
+                       model: Optional[str] = None,
+                       tenant: Optional[str] = None) -> bytes:
         body = {"prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
                 "stream": stream}
         body.update({k: v for k, v in kw.items() if v is not None})
-        return json.dumps(body).encode()
+        return json.dumps(self._route(body, model, tenant)).encode()
 
     def generate(self, prompt, max_new_tokens: int = 32,
                  eos_id: Optional[int] = None, temperature: float = 0.0,
                  seed: int = 0,
-                 deadline_ms: Optional[float] = None) -> List[int]:
+                 deadline_ms: Optional[float] = None,
+                 model: Optional[str] = None,
+                 tenant: Optional[str] = None) -> List[int]:
         """Blocking generation; returns the full token list."""
         raw = self._post("/generate", self._generate_body(
             prompt, False, {"max_new_tokens": max_new_tokens,
                             "eos_id": eos_id, "temperature": temperature,
-                            "seed": seed, "deadline_ms": deadline_ms}),
+                            "seed": seed, "deadline_ms": deadline_ms},
+            model, tenant),
             {"Content-Type": "application/json"})
         return list(json.loads(raw.decode())["tokens"])
 
     def generate_stream(self, prompt, max_new_tokens: int = 32,
                         eos_id: Optional[int] = None,
                         temperature: float = 0.0, seed: int = 0,
-                        deadline_ms: Optional[float] = None
+                        deadline_ms: Optional[float] = None,
+                        model: Optional[str] = None,
+                        tenant: Optional[str] = None
                         ) -> Iterator[int]:
         """Yield tokens as the server streams them (chunked NDJSON).
 
@@ -691,7 +867,8 @@ class Client:
         r = self._request("POST", "/generate", self._generate_body(
             prompt, True, {"max_new_tokens": max_new_tokens,
                            "eos_id": eos_id, "temperature": temperature,
-                           "seed": seed, "deadline_ms": deadline_ms}),
+                           "seed": seed, "deadline_ms": deadline_ms},
+            model, tenant),
             {"Content-Type": "application/json"})
         if r.status >= 400:
             raw = r.read()
@@ -719,3 +896,35 @@ class Client:
                 self._finish(r)
             else:           # abandoned/errored mid-stream: unread data
                 self._drop_conn()
+
+    # -- model registry admin ----------------------------------------------
+    def _admin(self, payload: dict) -> dict:
+        raw = self._post("/admin/models", json.dumps(payload).encode(),
+                         {"Content-Type": "application/json"})
+        return json.loads(raw.decode())
+
+    def admin_models(self) -> dict:
+        """``GET /admin/models``: states, versions, engines, aliases,
+        in-flight counts, quotas."""
+        return self._get_json("/admin/models")
+
+    def load_model(self, name: str, artifact: str, **kw) -> dict:
+        """Load + warm an artifact under ``name``; extra kwargs pass
+        through to :meth:`ModelRegistry.load` (``weights_dir``,
+        ``aliases``, ``weight``, ``rest_shapes``, ...)."""
+        return self._admin({"action": "load", "name": name,
+                            "artifact": artifact, **kw})
+
+    def unload_model(self, name: str, timeout: float = 30.0) -> dict:
+        """Unload ``name``; returns the drain/page-pool summary."""
+        return self._admin({"action": "unload", "name": name,
+                            "timeout": timeout})
+
+    def alias_model(self, alias: str, target: str) -> dict:
+        return self._admin({"action": "alias", "alias": alias,
+                            "target": target})
+
+    def set_quota(self, tenant: str, rate: float,
+                  burst: Optional[float] = None) -> dict:
+        return self._admin({"action": "quota", "tenant": tenant,
+                            "rate": rate, "burst": burst})

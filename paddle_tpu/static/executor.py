@@ -1490,9 +1490,39 @@ class Executor:
 
             if plan is None:
                 jitted = jax.jit(run_fn)
+                from ..core import compile_cache as _ccache
+                if _ccache.enabled():
+                    # persistent AOT cache for the single-device
+                    # inference step: key on the hash of the lowered
+                    # module (exact program content — process-local
+                    # serials never survive a respawn, so they can't
+                    # key anything).  The site compiles lazily on first
+                    # dispatch, so provenance is annotated onto the
+                    # already-written compile record after the fact.
+                    import hashlib as _hashlib
+                    serial = program._serial
+                    holder: dict = {}
 
-                def compiled(*args):
-                    return jitted(*args)
+                    def compiled(*args):
+                        ex = holder.get("ex")
+                        if ex is None:
+                            lowered = jitted.lower(*args)
+                            ex, prov = _ccache.cached_compile(
+                                "executor",
+                                {"module": _hashlib.sha256(
+                                    lowered.as_text().encode()
+                                ).hexdigest()},
+                                lowered.compile)
+                            holder["ex"] = ex
+                            if prov is not None:
+                                from ..observability import \
+                                    annotate_compile
+                                annotate_compile("executor", serial,
+                                                 prov)
+                        return ex(*args)
+                else:
+                    def compiled(*args):
+                        return jitted(*args)
 
                 compiled._pallas_kernels = realized_kernels
                 return compiled

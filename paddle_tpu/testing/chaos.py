@@ -1867,3 +1867,438 @@ def supervise_main(steps=14, save_every=2, hang_after=5, crash_after=4,
         _st.reset_default_programs()
         if own_tmp:
             shutil.rmtree(workdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Registry chaos (ISSUE 19): the multi-model control plane under fire —
+# a live weight swap on one model, an unload/reload of the other mid-
+# traffic, and a supervised two-model replica crash ride-through
+# ---------------------------------------------------------------------------
+
+def _registry_serving_entry(prefix_a, prefix_b, port, state_file,
+                            stop_file):
+    """Supervised two-model registry replica (module-level so spawn can
+    pickle it).  Binds the HTTP plane not-ready with a ModelRegistry,
+    loads + warms both models, marks ready.  The FIRST incarnation
+    hard-crashes about a second after going ready — with the parent's
+    clients routing to both models — so the supervisor must restart it
+    and the replacement must reload BOTH models before traffic
+    recovers."""
+    import threading
+    import time
+
+    from paddle_tpu import serving
+
+    reg = serving.ModelRegistry(max_inflight=64)
+    srv = serving.ServingServer(None, port=port, ready=False,
+                                registry=reg).start()
+    kw = {"max_batch_size": 8, "batch_timeout_ms": 5.0}
+    reg.load("modelA", prefix_a, engine_kwargs=dict(kw))
+    reg.load("modelB", prefix_b, engine_kwargs=dict(kw))
+    srv.mark_ready()
+    if not os.path.exists(state_file):
+        with open(state_file, "w") as f:
+            f.write("1")
+
+        def _die():
+            time.sleep(1.0)
+            os._exit(9)         # a hard replica crash, mid-traffic
+        threading.Thread(target=_die, daemon=True).start()
+    while not os.path.exists(stop_file):
+        time.sleep(0.05)
+    srv.close()
+    reg.close(timeout=10.0)
+
+
+def registry_main(requests=16, clients=2, verbose=False, workdir=None,
+                  supervised=True):
+    """Two-model control-plane gate; returns 0 on success, 1 on failure.
+
+    Part one (in-process, HTTP clients routing by model name): a
+    :class:`~paddle_tpu.serving.ModelRegistry` serves ``modelA``
+    (inference + generation engines) and ``modelB`` (inference) behind
+    one :class:`ServingServer` while client threads hammer both.
+    Under that fire: (1) a WeightWatcher hot-swaps modelA's inference
+    weights — every A response must be bitwise-correct for exactly one
+    published version and B's responses must never move; (2) modelB is
+    unloaded mid-traffic — in-flight B requests finish bitwise, later
+    ones get a clean :class:`UnknownModel` (the HTTP 404), never a hang
+    — then reloaded, after which B serves bitwise again.  Final gates:
+    zero hot-path recompiles across the swap, zero stranded futures,
+    modelA's unload reports its generation page pool fully reclaimed,
+    and the registry counters saw the unknown-model window.
+
+    Part two (``supervised=True``): a two-model registry replica under
+    a :class:`ServingSupervisor` hard-crashes mid-traffic; the
+    supervisor restarts it, the replacement reloads BOTH models, and
+    clients ride through on the reconnect path with post-restart
+    responses bitwise for each model."""
+    import threading
+    import time
+
+    from paddle_tpu import inference, serving
+    from paddle_tpu.serving.hotswap import WeightWatcher, publish_weights
+    from paddle_tpu.utils import monitor
+    from paddle_tpu.utils.checkpoint import SnapshotStore
+
+    own_tmp = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_registry_")
+    problems = []
+    monitor.stat_reset()
+
+    # -- per-(model, version) bitwise references ---------------------------
+    prefix_a0 = _scaled_artifact(1.0, workdir, "a0")
+    prefix_a1 = _scaled_artifact(0.25, workdir, "a1")
+    prefix_b = _scaled_artifact(0.5, workdir, "b")
+    preds = {k: inference.create_predictor(inference.Config(p))
+             for k, p in (("a0", prefix_a0), ("a1", prefix_a1),
+                          ("b", prefix_b))}
+    rng = np.random.RandomState(23)
+    reqs = [(rng.randint(-8, 9, (rng.randint(1, 5), 8)) / 4.0)
+            .astype(np.float32) for _ in range(requests)]
+    refs = {k: [np.asarray(p.run([x])[0]) for x in reqs]
+            for k, p in preds.items()}
+    prompts = [rng.randint(0, 32, rng.randint(1, 9)).tolist()
+               for _ in range(4)]
+    budgets = [int(rng.randint(3, 7)) for _ in prompts]
+    ref_gen = serving.GenerationEngine(make_dyadic_lm(), num_slots=4,
+                                       page_size=4, max_context=64)
+    ref_gen.warmup()
+    gen_refs = [ref_gen.generate_sync(prompts[i], timeout=60,
+                                      max_new_tokens=budgets[i],
+                                      temperature=0.7, seed=i)
+                for i in range(len(prompts))]
+    ref_gen.close()
+
+    # -- the registry under test -------------------------------------------
+    reg = serving.ModelRegistry(max_inflight=64)
+    eng_a = serving.InferenceEngine(preds["a0"], max_batch_size=8,
+                                    batch_timeout_ms=5.0,
+                                    max_queue=8 * requests, name="modelA")
+    eng_a.warmup()
+    gen_a = serving.GenerationEngine(make_dyadic_lm(), num_slots=4,
+                                     page_size=4, max_context=64,
+                                     max_queue=256, name="modelA")
+    gen_a.warmup()
+    store = SnapshotStore(os.path.join(workdir, "weights_a"))
+    watcher = WeightWatcher(store, engine=eng_a, poll_s=0.05).start()
+    reg.register("modelA", engine=eng_a, generation=gen_a,
+                 watcher=watcher, weight=2.0)
+    eng_b = serving.InferenceEngine(preds["b"], max_batch_size=8,
+                                    batch_timeout_ms=5.0,
+                                    max_queue=8 * requests, name="modelB")
+    eng_b.warmup()
+    reg.register("modelB", engine=eng_b)
+    srv = serving.ServingServer(None, port=0, registry=reg).start()
+
+    stop = threading.Event()
+    a_out, b_out, g_out = [], [], []
+
+    def a_client(idx):
+        c = serving.Client(srv.url, model="modelA", timeout=30)
+        k = idx
+        while not stop.is_set():
+            i = k % len(reqs)
+            k += clients
+            try:
+                got = c.predict([reqs[i]])
+                a_out.append((i, np.asarray(got[0], dtype=np.float32)))
+            except Exception as e:  # noqa: BLE001 - gated below
+                a_out.append((i, e))
+
+    def b_client(idx):
+        c = serving.Client(srv.url, model="modelB", timeout=30)
+        k = idx
+        while not stop.is_set():
+            i = k % len(reqs)
+            k += clients
+            try:
+                got = c.predict([reqs[i]])
+                b_out.append((i, np.asarray(got[0], dtype=np.float32)))
+            except Exception as e:  # noqa: BLE001 - gated below
+                b_out.append((i, e))
+            time.sleep(0.01)
+
+    def g_client(idx):
+        c = serving.Client(srv.url, model="modelA", timeout=60)
+        k = idx
+        while not stop.is_set():
+            i = k % len(prompts)
+            k += clients
+            try:
+                toks = c.generate(prompts[i],
+                                  max_new_tokens=budgets[i],
+                                  temperature=0.7, seed=i)
+                g_out.append((i, toks))
+            except Exception as e:  # noqa: BLE001 - gated below
+                g_out.append((i, e))
+
+    admin = serving.Client(srv.url, timeout=60)
+    threads = [threading.Thread(target=f, args=(c,), daemon=True)
+               for f in (a_client, b_client, g_client)
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    b_unknown_window = []
+    try:
+        time.sleep(0.4)                     # fire on (A=v0, B)
+
+        # -- (1) live weight swap on modelA, B must not move -------------
+        publish_weights(store, 1, artifact_prefix=prefix_a1)
+        deadline = time.monotonic() + 60
+        while watcher.version < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if watcher.version != 1:
+            problems.append(f"modelA swap not applied within 60s "
+                            f"(last_error={watcher.last_error})")
+        for i in range(3):
+            got = admin.predict([reqs[i]], model="modelA")
+            if not np.array_equal(np.asarray(got[0], np.float32),
+                                  refs["a1"][i]):
+                problems.append(f"modelA settled response {i} not "
+                                f"bitwise at version 1")
+            got = admin.predict([reqs[i]], model="modelB")
+            if not np.array_equal(np.asarray(got[0], np.float32),
+                                  refs["b"][i]):
+                problems.append(f"modelB response {i} moved during "
+                                f"modelA's swap")
+        time.sleep(0.3)                     # fire on (A=v1, B)
+
+        # -- (2) unload modelB mid-traffic, then reload ------------------
+        mark = len(b_out)
+        summary = admin.unload_model("modelB")
+        if not summary.get("engine_drained"):
+            problems.append(f"modelB unload did not drain cleanly: "
+                            f"{summary}")
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 1.0:
+            time.sleep(0.05)                # window where B is gone
+        b_unknown_window = [r for _, r in b_out[mark:]
+                            if isinstance(r, serving.UnknownModel)]
+        if not b_unknown_window:
+            problems.append("no B request saw a clean UnknownModel "
+                            "while the model was unloaded")
+        admin.load_model("modelB", prefix_b,
+                         engine_kwargs={"max_batch_size": 8,
+                                        "batch_timeout_ms": 5.0})
+        reload_mark = len(b_out)
+        time.sleep(0.4)                     # fire on the reloaded B
+        post = [(i, r) for i, r in b_out[reload_mark:]
+                if not isinstance(r, Exception)]
+        if not post:
+            problems.append("no B request succeeded after the reload")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30)
+        watcher.stop()
+        srv.close()
+
+    # -- part-one gates ----------------------------------------------------
+    for i, res in a_out:
+        if isinstance(res, Exception):
+            problems.append(f"modelA request {i} failed under fire: "
+                            f"{type(res).__name__}: {res}")
+        elif not (np.array_equal(res, refs["a0"][i])
+                  or np.array_equal(res, refs["a1"][i])):
+            problems.append(f"modelA request {i}: response matches "
+                            f"neither published version (a swap tore "
+                            f"a batch)")
+    clean_b = (serving.UnknownModel, serving.EngineClosed)
+    for i, res in b_out:
+        if isinstance(res, Exception):
+            if not isinstance(res, clean_b):
+                problems.append(f"modelB request {i} failed uncleanly "
+                                f"under churn: {type(res).__name__}: "
+                                f"{res}")
+        elif not np.array_equal(res, refs["b"][i]):
+            problems.append(f"modelB request {i} not bitwise")
+    for i, res in g_out:
+        if isinstance(res, Exception):
+            problems.append(f"generation request {i} failed under "
+                            f"fire: {type(res).__name__}: {res}")
+        elif list(res) != list(gen_refs[i]):
+            problems.append(f"generation request {i} tokens differ "
+                            f"from the serial reference")
+    if len(a_out) < 5 or len(g_out) < 2:
+        problems.append(f"fire too thin: {len(a_out)} A requests, "
+                        f"{len(g_out)} generations")
+
+    # final teardown through the registry: stranded futures and page
+    # reclamation are asserted from the unload summaries themselves
+    summary_a = reg.unload("modelA", timeout=60)
+    if not summary_a.get("pages_reclaimed", False):
+        problems.append(f"modelA unload leaked pages: "
+                        f"{summary_a.get('page_pool')}")
+    stats_a = eng_a.stats()
+    if stats_a["recompiles_after_warmup"] != 0:
+        problems.append(f"modelA hot path recompiled "
+                        f"{stats_a['recompiles_after_warmup']}x across "
+                        f"the swap")
+    if stats_a["counters"].get("closed_stranded", 0):
+        problems.append(f"{stats_a['counters']['closed_stranded']} "
+                        f"modelA futures stranded at close")
+    gen_stats = gen_a.stats()
+    if gen_stats["counters"]["pages_allocated"] \
+            != gen_stats["counters"]["pages_freed"]:
+        problems.append(
+            f"page accounting: "
+            f"{gen_stats['counters']['pages_allocated']} allocated vs "
+            f"{gen_stats['counters']['pages_freed']} freed")
+    if monitor.get_stat("registry.unknown_model") < 1:
+        problems.append("registry.unknown_model never counted the "
+                        "unload window")
+    reg.close(timeout=30.0)
+    if verbose:
+        print(f"registry fire: {len(a_out)} A + {len(b_out)} B + "
+              f"{len(g_out)} gen requests, "
+              f"{len(b_unknown_window)} clean 404s in the unload "
+              f"window, swap v{watcher.version}, "
+              f"counters={reg.stats()['counters']}")
+
+    # -- part two: supervised two-model replica crash ----------------------
+    if supervised and not problems:
+        problems.extend(_registry_supervised(prefix_a0, prefix_b,
+                                             refs, reqs, workdir,
+                                             verbose))
+
+    if own_tmp:
+        shutil.rmtree(workdir, ignore_errors=True)
+    if problems:
+        for p in problems:
+            print(f"FAIL: {p}", file=sys.stderr)
+        return 1
+    print("chaos registry OK: modelA hot-swapped under two-model fire "
+          "(bitwise per version, B unmoved), modelB unloaded mid-"
+          "traffic (clean 404s, drained, no stranded futures) and "
+          "reloaded, pages reclaimed, and a crashed two-model replica "
+          "restarted with clients riding through")
+    return 0
+
+
+def _registry_supervised(prefix_a, prefix_b, refs, reqs, workdir,
+                         verbose):
+    """Part two of :func:`registry_main`: the supervised two-model
+    replica crash.  Returns a list of failure strings."""
+    import socket
+    import threading
+    import time
+
+    from paddle_tpu import serving
+    from paddle_tpu.distributed import ServingSupervisor
+    from paddle_tpu.utils import monitor
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    url = f"http://127.0.0.1:{port}"
+    state_file = os.path.join(workdir, "reg_sv_state")
+    stop_file = os.path.join(workdir, "reg_sv_stop")
+
+    sv = ServingSupervisor(
+        _registry_serving_entry,
+        args=(prefix_a, prefix_b, port, state_file, stop_file),
+        name="regchaos", health_url=f"{url}/healthz",
+        ready_poll_s=0.1, probe_timeout_s=2.0, ready_fail_budget=50,
+        hang_deadline_s=300.0, startup_timeout_s=240.0, poll_s=0.1,
+        backoff_s=0.1, backoff_max_s=0.5,
+        crash_window_s=600.0, crash_budget=3,
+        child_env={"JAX_PLATFORMS": "cpu"}, workdir=workdir)
+    box = {}
+
+    def run_sv():
+        try:
+            box["result"] = sv.run()
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            box["error"] = e
+
+    svt = threading.Thread(target=run_sv, daemon=True)
+    svt.start()
+
+    def wait_ready(deadline_s):
+        deadline = time.monotonic() + deadline_s
+        c = serving.Client(url, timeout=5, reconnect_backoff_s=0.05)
+        while time.monotonic() < deadline:
+            try:
+                if c.healthz().get("ready"):
+                    return True
+            except Exception:  # noqa: BLE001 - replica not up yet
+                pass
+            time.sleep(0.1)
+        return False
+
+    successes, failures = [], []
+    b_stop = threading.Event()
+
+    def b_client(idx, model, ref_key):
+        c = serving.Client(url, model=model, timeout=10,
+                           reconnect_backoff_s=0.1)
+        k = idx
+        while not b_stop.is_set():
+            i = k % len(reqs)
+            k += 2
+            try:
+                got = c.predict([reqs[i]])
+                successes.append((model, ref_key, i,
+                                  np.asarray(got[0], np.float32)))
+            except Exception as e:  # noqa: BLE001 - gated below
+                failures.append((model, i, e))
+            time.sleep(0.01)
+
+    out = []
+    try:
+        if not wait_ready(240.0):
+            return ["supervised two-model replica never became ready"]
+        clients = [threading.Thread(target=b_client,
+                                    args=(n, m, rk), daemon=True)
+                   for n, (m, rk) in enumerate((("modelA", "a0"),
+                                                ("modelB", "b")))]
+        for t in clients:
+            t.start()
+        deadline = time.monotonic() + 120
+        while monitor.get_stat("supervisor.serving.restarts") < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        if monitor.get_stat("supervisor.serving.restarts") < 1:
+            b_stop.set()
+            return ["two-model replica crash never triggered a "
+                    "supervised restart"]
+        if not wait_ready(240.0):
+            b_stop.set()
+            return ["restarted two-model replica never became ready "
+                    "again"]
+        # post-restart: fresh client, serial bitwise pass on BOTH models
+        c = serving.Client(url, timeout=10)
+        for model, key in (("modelA", "a0"), ("modelB", "b")):
+            for i in range(3):
+                got = c.predict([reqs[i]], model=model)
+                arr = np.asarray(got[0], np.float32)
+                if not np.array_equal(arr, refs[key][i]):
+                    out.append(f"post-restart {model} response {i} "
+                               f"not bitwise")
+        try:
+            c.predict([reqs[0]], model="nope")
+            out.append("unknown model did not 404 on the restarted "
+                       "replica")
+        except serving.UnknownModel:
+            pass
+    finally:
+        b_stop.set()
+        with open(stop_file, "w") as f:
+            f.write("1")
+        sv.stop()
+        svt.join(60)
+
+    for model, key, i, arr in successes:
+        if not np.array_equal(arr, refs[key][i]):
+            out.append(f"{model} request {i} not bitwise during the "
+                       f"ride-through")
+    if not any(m == "modelA" for m, *_ in successes) \
+            or not any(m == "modelB" for m, *_ in successes):
+        out.append("ride-through traffic did not cover both models")
+    if verbose:
+        print(f"supervised ride-through: {len(successes)} successes, "
+              f"{len(failures)} transient failures, restarts="
+              f"{monitor.get_stat('supervisor.serving.restarts')}")
+    return out

@@ -1,0 +1,163 @@
+"""Persistent AOT compile cache tests (ISSUE 19): key discipline,
+store/load round trips, stamped invalidation, reject-never-crash on
+every load failure mode, and the Predictor integration (a second cold
+start warms from deserialized executables with ``cache`` provenance on
+its compile records)."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, jit, nn
+from paddle_tpu.core import compile_cache
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.jit import InputSpec
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    d = str(tmp_path / "xcache")
+    set_flags({"compile_cache_dir": d})
+    compile_cache.reset_stats()
+    yield d
+    set_flags({"compile_cache_dir": ""})
+    compile_cache.reset_stats()
+
+
+def _build_fn():
+    import jax
+
+    f = jax.jit(lambda x: x * 2.0 + 1.0)
+    aval = jax.ShapeDtypeStruct((4,), np.float32)
+    return f.lower(aval).compile()
+
+
+# ------------------------------------------------------------ keying --
+def test_disabled_is_a_no_op(tmp_path):
+    set_flags({"compile_cache_dir": ""})
+    assert not compile_cache.enabled()
+    calls = []
+
+    def build():
+        calls.append(1)
+        return _build_fn()
+
+    ex, prov = compile_cache.cached_compile("t", {"a": 1}, build)
+    assert prov is None and calls == [1]
+    # no filesystem traffic at all
+    assert compile_cache.stats() == {"hits": 0, "misses": 0,
+                                     "rejects": 0, "stores": 0,
+                                     "errors": 0}
+
+
+def test_cache_key_is_content_stable(cache_dir):
+    sig = {"artifact": "ab" * 32, "bucket": ((4, 8), "float32"),
+           "donate": (0,)}
+    k1 = compile_cache.cache_key("predictor", dict(sig))
+    k2 = compile_cache.cache_key("predictor", dict(sig))
+    assert k1 == k2
+    assert k1 != compile_cache.cache_key("generation", dict(sig))
+    sig2 = dict(sig, bucket=((8, 8), "float32"))
+    assert k1 != compile_cache.cache_key("predictor", sig2)
+    # bytes/dicts/sets freeze deterministically
+    deep = {"b": b"\x00\x01", "d": {"z": 1, "a": 2}, "s": {3, 1, 2}}
+    assert (compile_cache.cache_key("t", {"x": deep})
+            == compile_cache.cache_key("t", {"x": deep}))
+
+
+# ------------------------------------------------- store/load cycle --
+def test_round_trip_and_provenance(cache_dir):
+    x = np.arange(4, dtype=np.float32)
+    ex1, prov1 = compile_cache.cached_compile("t", {"k": 1}, _build_fn)
+    assert prov1 == "compiled"
+    ex2, prov2 = compile_cache.cached_compile("t", {"k": 1}, _build_fn)
+    assert prov2 == "loaded"
+    np.testing.assert_array_equal(np.asarray(ex1(x)), np.asarray(ex2(x)))
+    st = compile_cache.stats()
+    assert st["stores"] == 1 and st["hits"] == 1 and st["misses"] == 1
+    assert st["rejects"] == 0 and st["errors"] == 0
+    assert len(os.listdir(cache_dir)) == 1
+
+
+def test_stamp_mismatch_rejects_to_fresh_compile(cache_dir):
+    compile_cache.cached_compile("t", {"k": 2}, _build_fn)
+    (name,) = os.listdir(cache_dir)
+    path = os.path.join(cache_dir, name)
+    with open(path, "rb") as f:
+        entry = pickle.load(f)
+    entry["stamp"]["jaxlib"] = "99.99.99"      # an in-place upgrade
+    with open(path, "wb") as f:
+        pickle.dump(entry, f)
+    ex, prov = compile_cache.cached_compile("t", {"k": 2}, _build_fn)
+    assert prov == "compiled"                  # rejected, not crashed
+    assert compile_cache.stats()["rejects"] == 1
+
+
+def test_unreadable_entry_rejects_not_crashes(cache_dir):
+    compile_cache.cached_compile("t", {"k": 3}, _build_fn)
+    (name,) = os.listdir(cache_dir)
+    with open(os.path.join(cache_dir, name), "wb") as f:
+        f.write(b"not a pickle at all")
+    ex, prov = compile_cache.cached_compile("t", {"k": 3}, _build_fn)
+    assert prov == "compiled"
+    assert compile_cache.stats()["rejects"] == 1
+
+
+def test_device_fingerprint_gate(cache_dir, monkeypatch):
+    """A payload that deserializes onto the wrong device set must fall
+    back to a fresh compile counted as a reject — never a crash on
+    first dispatch."""
+    assert compile_cache._device_fingerprint_ok(_build_fn())
+    compile_cache.cached_compile("t", {"k": 4}, _build_fn)
+    monkeypatch.setattr(compile_cache, "_device_fingerprint_ok",
+                        lambda compiled: False)
+    ex, prov = compile_cache.cached_compile("t", {"k": 4}, _build_fn)
+    assert prov == "compiled"
+    assert compile_cache.stats()["rejects"] == 1
+    x = np.arange(4, dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(ex(x)), x * 2.0 + 1.0)
+
+
+def test_store_failure_is_nonfatal(cache_dir, monkeypatch):
+    from paddle_tpu.core import jax_compat
+
+    def boom(compiled):
+        raise RuntimeError("serialization gap")
+
+    monkeypatch.setattr(jax_compat, "serialize_executable", boom)
+    ex, prov = compile_cache.cached_compile("t", {"k": 5}, _build_fn)
+    assert prov == "compiled"                  # executable unaffected
+    assert compile_cache.stats()["errors"] == 1
+    x = np.arange(4, dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(ex(x)), x * 2.0 + 1.0)
+
+
+# ------------------------------------------------ predictor wiring --
+def test_predictor_warms_from_cache_with_provenance(cache_dir, tmp_path):
+    from paddle_tpu.observability import explain_compiles
+
+    paddle.seed(3)
+    model = nn.Linear(8, 4)
+    prefix = str(tmp_path / "m")
+    jit.save(model, prefix, input_spec=[InputSpec([None, 8], "float32")])
+    x = np.ones((2, 8), dtype=np.float32)
+
+    p1 = inference.create_predictor(inference.Config(prefix))
+    ref = np.asarray(p1.run([x])[0])
+    st = compile_cache.stats()
+    assert st["stores"] >= 1 and st["hits"] == 0
+
+    # a second cold start (fresh Predictor == what a respawned replica
+    # builds): the bucket executable loads instead of compiling
+    p2 = inference.create_predictor(inference.Config(prefix))
+    out = np.asarray(p2.run([x])[0])
+    np.testing.assert_array_equal(out, ref)
+    st = compile_cache.stats()
+    assert st["hits"] >= 1
+    assert st["rejects"] == 0 and st["errors"] == 0
+
+    recs = explain_compiles("predictor")["records"]
+    provs = [r.get("cache") for r in recs[-2:]]
+    assert "loaded" in provs and "compiled" in provs

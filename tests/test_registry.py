@@ -1,0 +1,357 @@
+"""Multi-model control plane tests (ISSUE 19): routing/alias/quota/WFQ
+semantics on stub engines, deterministic SLO-driven elasticity with an
+injected clock, and concurrent registry mutation under live traffic on
+real dyadic artifacts (bitwise results, no stranded futures, pages
+reclaimed)."""
+import concurrent.futures as cf
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, jit, serving
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.serving import (ElasticityController, EngineClosed,
+                                ModelRegistry, QueueFull, QuotaExceeded,
+                                UnknownModel)
+from paddle_tpu.testing.chaos import make_dyadic_lm, make_dyadic_model
+from paddle_tpu.utils import monitor
+
+
+class StubEngine:
+    """Duck-typed InferenceEngine: futures the test resolves itself, so
+    WFQ occupancy is fully controlled."""
+
+    def __init__(self):
+        self.weights_version = 1
+        self.pending = []
+        self.closed = False
+
+    def infer(self, inputs, deadline_ms=None):
+        f = cf.Future()
+        self.pending.append(f)
+        return f
+
+    def release_all(self):
+        for f in self.pending:
+            if not f.done():
+                f.set_result("ok")
+        self.pending = []
+
+    def drain(self, timeout=None):
+        self.release_all()
+        return True
+
+    def close(self, timeout=10.0):
+        self.release_all()
+        self.closed = True
+
+
+# ------------------------------------------------------------ routing --
+def test_routing_aliases_default_unknown():
+    reg = ModelRegistry()
+    reg.register("alpha", engine=StubEngine())
+    reg.register("beta", engine=StubEngine(), aliases=["prod"])
+    try:
+        assert reg.default_model == "alpha"     # first ready model
+        assert reg.resolve(None).name == "alpha"
+        assert reg.resolve("beta").name == "beta"
+        assert reg.resolve("prod").name == "beta"
+        with pytest.raises(UnknownModel):
+            reg.resolve("nope")
+        # canary flip: re-point the alias, routing follows atomically
+        reg.alias("prod", "alpha")
+        assert reg.resolve("prod").name == "alpha"
+        reg.set_default("beta")
+        assert reg.resolve(None).name == "beta"
+    finally:
+        reg.close()
+
+
+def test_not_ready_model_is_unroutable_until_marked():
+    reg = ModelRegistry()
+    reg.register("gamma", engine=StubEngine(), ready=False)
+    try:
+        with pytest.raises(EngineClosed):       # 503, not 404
+            reg.resolve("gamma")
+        reg.mark_ready("gamma")
+        assert reg.resolve("gamma").state == "ready"
+    finally:
+        reg.close()
+
+
+def test_close_refuses_late_register():
+    reg = ModelRegistry()
+    reg.register("alpha", engine=StubEngine())
+    reg.close()
+    with pytest.raises(EngineClosed):
+        reg.register("late", engine=StubEngine())
+
+
+# ---------------------------------------------------------------- WFQ --
+def test_wfq_clamps_at_saturation_only():
+    shed0 = monitor.get_stat("registry.wfq_shed") or 0
+    reg = ModelRegistry(max_inflight=8)
+    a, b = StubEngine(), StubEngine()
+    reg.register("alpha", engine=a, weight=3.0)
+    reg.register("beta", engine=b, weight=1.0)
+    try:
+        # weights 3:1 over a pool of 8 -> shares 6 and 2
+        for _ in range(6):
+            reg.infer("alpha", [1])
+        for _ in range(2):
+            reg.infer("beta", [1])
+        # saturated: both models sit exactly at share -> both shed
+        with pytest.raises(QueueFull):
+            reg.infer("alpha", [1])
+        with pytest.raises(QueueFull):
+            reg.infer("beta", [1])
+        assert (monitor.get_stat("registry.wfq_shed") or 0) - shed0 == 2
+        # release one slot -> below saturation the share does NOT bind
+        # (work-conserving): beta admits beyond its share of 2
+        a.pending[0].set_result("ok")
+        _wait(lambda: reg.stats()["inflight"]["alpha"] == 5)
+        reg.infer("beta", [1])
+        assert reg.stats()["inflight"]["beta"] == 3
+        a.release_all()
+        b.release_all()
+        _wait(lambda: sum(reg.stats()["inflight"].values()) == 0)
+    finally:
+        reg.close()
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, "condition never settled"
+        time.sleep(0.01)
+
+
+# -------------------------------------------------------------- quota --
+def test_tenant_quota_token_bucket():
+    reg = ModelRegistry()
+    a = StubEngine()
+    reg.register("alpha", engine=a)
+    reg.set_quota("t1", rate=0.1, burst=2)
+    try:
+        reg.infer("alpha", [1], tenant="t1")
+        reg.infer("alpha", [1], tenant="t1")
+        with pytest.raises(QuotaExceeded, match="retry in"):
+            reg.infer("alpha", [1], tenant="t1")
+        # the quota is per-tenant, not per-model-wide
+        reg.infer("alpha", [1], tenant="t2")
+        reg.infer("alpha", [1])                  # anonymous unaffected
+        reg.clear_quota("t1")
+        reg.infer("alpha", [1], tenant="t1")
+        a.release_all()
+    finally:
+        reg.close()
+
+
+# ------------------------------------------------------------- unload --
+def test_unload_drains_resolves_futures_cleans_aliases():
+    reg = ModelRegistry()
+    b = StubEngine()
+    reg.register("beta", engine=b, aliases=["prod"])
+    try:
+        f = reg.infer("beta", [1])
+        summary = reg.unload("beta")
+        assert summary["engine_drained"] is True
+        assert f.result(1) == "ok"              # in-flight NOT stranded
+        assert b.closed
+        with pytest.raises(UnknownModel):
+            reg.resolve("beta")
+        with pytest.raises(UnknownModel):       # alias went with it
+            reg.resolve("prod")
+    finally:
+        reg.close()
+
+
+# --------------------------------------------------------- elasticity --
+def test_elasticity_deterministic_scale_shed_recover():
+    reg = ModelRegistry()
+    a = StubEngine()
+    reg.register("el-alpha", engine=a)
+    scales = []
+    ctl = ElasticityController(
+        reg, scaler=lambda name, n: scales.append((name, n)),
+        objective_ms=50.0, window=5.0, min_count=1,
+        max_replicas=2, breach_polls=2, clear_polls=2, cooldown_s=0.0)
+    stat = "serving.engine.el-alpha.latency_ms"
+    try:
+        now = 1000.0
+        for _ in range(40):
+            monitor.stat_observe(stat, 500.0)
+        ctl.poll(now=now)                        # baseline snapshot
+        for _ in range(5):                       # sustained burn
+            for _ in range(40):
+                monitor.stat_observe(stat, 500.0)
+            now += 5.0
+            r = ctl.poll(now=now)
+        entry = reg.resolve("el-alpha")
+        assert ("el-alpha", 2) in scales, (scales, r)
+        assert entry.shedding, r                 # at max and burning
+        with pytest.raises(QueueFull, match="shedding"):
+            reg.infer("el-alpha", [1])
+        for _ in range(6):                       # burn clears
+            for _ in range(40):
+                monitor.stat_observe(stat, 1.0)
+            now += 5.0
+            r = ctl.poll(now=now)
+        assert not entry.shedding, r
+        assert ("el-alpha", 1) in scales, scales
+        reg.infer("el-alpha", [1])               # admits again
+        a.release_all()
+        st = ctl.status()
+        assert st["el-alpha"]["desired"] == 1
+    finally:
+        reg.close()
+
+
+# ----------------------------------- concurrent mutation under fire --
+@pytest.fixture(scope="module")
+def dyadic_artifacts(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("registry_models")
+    prefixes = {}
+    for name, seed, scale in (("a", 7, 1.0), ("b", 11, 0.5)):
+        paddle.seed(seed)
+        model = make_dyadic_model(in_dim=8, hidden=16, out_dim=4)
+        for p in model.parameters():
+            p.set_value(p.numpy() * scale)
+        prefix = str(tmp / f"m_{name}")
+        jit.save(model, prefix,
+                 input_spec=[InputSpec([None, 8], "float32")])
+        prefixes[name] = prefix
+    return prefixes
+
+
+def test_concurrent_mutation_under_traffic(dyadic_artifacts):
+    """Satellite (c): unload/reload + alias flip while traffic is in
+    flight.  Dyadic weights make every successful response bitwise-
+    checkable; the drain contract means the only acceptable failures
+    are clean UnknownModel/EngineClosed in the mutation window."""
+    rng = np.random.RandomState(29)
+    reqs = [(rng.randint(-8, 9, (rng.randint(1, 4), 8)) / 4.0)
+            .astype(np.float32) for _ in range(8)]
+    preds = {k: inference.create_predictor(inference.Config(p))
+             for k, p in dyadic_artifacts.items()}
+    refs = {k: [np.asarray(p.run([x])[0]) for x in reqs]
+            for k, p in preds.items()}
+    prompts = [rng.randint(0, 32, rng.randint(1, 7)).tolist()
+               for _ in range(3)]
+    ref_gen = serving.GenerationEngine(make_dyadic_lm(), num_slots=4,
+                                       page_size=4, max_context=64)
+    ref_gen.warmup()
+    gen_refs = [ref_gen.generate_sync(prompts[i], timeout=60,
+                                      max_new_tokens=4,
+                                      temperature=0.7, seed=i)
+                for i in range(len(prompts))]
+    ref_gen.close()
+
+    reg = ModelRegistry(max_inflight=64)
+    eng_a = serving.InferenceEngine(preds["a"], max_batch_size=8,
+                                    batch_timeout_ms=2.0,
+                                    max_queue=256, name="mutA")
+    eng_a.warmup()
+    gen_a = serving.GenerationEngine(make_dyadic_lm(), num_slots=4,
+                                     page_size=4, max_context=64,
+                                     max_queue=256, name="mutA")
+    gen_a.warmup()
+    reg.register("mutA", engine=eng_a, generation=gen_a, weight=2.0)
+    eng_b = serving.InferenceEngine(preds["b"], max_batch_size=8,
+                                    batch_timeout_ms=2.0,
+                                    max_queue=256, name="mutB")
+    eng_b.warmup()
+    reg.register("mutB", engine=eng_b, aliases=["prod"])
+
+    stop = threading.Event()
+    a_out, b_out, g_out = [], [], []
+
+    def a_client():
+        k = 0
+        while not stop.is_set():
+            i = k % len(reqs)
+            k += 1
+            try:
+                got = reg.infer_sync("mutA", [reqs[i]], timeout=30)
+                a_out.append((i, np.asarray(got[0], np.float32)))
+            except Exception as e:  # noqa: BLE001 - gated below
+                a_out.append((i, e))
+
+    def b_client():
+        k = 0
+        while not stop.is_set():
+            i = k % len(reqs)
+            k += 1
+            try:
+                got = reg.infer_sync("mutB", [reqs[i]], timeout=30)
+                b_out.append((i, np.asarray(got[0], np.float32)))
+            except Exception as e:  # noqa: BLE001 - gated below
+                b_out.append((i, e))
+            time.sleep(0.005)
+
+    def g_client():
+        k = 0
+        while not stop.is_set():
+            i = k % len(prompts)
+            k += 1
+            try:
+                s = reg.generate("mutA", prompts[i], max_new_tokens=4,
+                                 temperature=0.7, seed=i)
+                g_out.append((i, s.result(timeout=60)))
+            except Exception as e:  # noqa: BLE001 - gated below
+                g_out.append((i, e))
+
+    threads = [threading.Thread(target=f, daemon=True)
+               for f in (a_client, b_client, g_client)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.3)
+        # mutation 1: canary alias flip under fire
+        reg.alias("prod", "mutA")
+        assert reg.resolve("prod").name == "mutA"
+        # mutation 2: unload mutB mid-traffic, then reload it
+        summary = reg.unload("mutB", timeout=30)
+        assert summary["engine_drained"] is True
+        window_end = len(b_out)
+        reg.load("mutB", dyadic_artifacts["b"], warmup=True,
+                 engine_kwargs={"max_batch_size": 8,
+                                "batch_timeout_ms": 2.0,
+                                "max_queue": 256})
+        time.sleep(0.3)                          # fire on the reload
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30)
+
+    assert len(a_out) >= 5 and len(g_out) >= 1, (len(a_out), len(g_out))
+    for i, res in a_out:
+        assert not isinstance(res, Exception), \
+            f"mutA request {i} failed under mutation: {res!r}"
+        np.testing.assert_array_equal(res, refs["a"][i])
+    clean = (UnknownModel, EngineClosed)
+    failures = [r for _, r in b_out if isinstance(r, Exception)]
+    assert all(isinstance(r, clean) for r in failures), failures[:3]
+    for i, res in b_out:
+        if not isinstance(res, Exception):
+            np.testing.assert_array_equal(res, refs["b"][i])
+    post_reload = [r for _, r in b_out[window_end:]
+                   if not isinstance(r, Exception)]
+    assert post_reload, "no successful mutB traffic after the reload"
+    for i, res in g_out:
+        assert not isinstance(res, Exception), \
+            f"generation {i} failed under mutation: {res!r}"
+        assert list(res) == list(gen_refs[i]), \
+            f"generation {i} not bitwise vs serial reference"
+
+    # teardown contracts: pages reclaimed, nothing stranded
+    summary_a = reg.unload("mutA", timeout=60)
+    assert summary_a["pages_reclaimed"] is True, summary_a
+    assert eng_a.stats()["counters"].get("closed_stranded", 0) == 0
+    gc = gen_a.stats()["counters"]
+    assert gc["pages_allocated"] == gc["pages_freed"], gc
+    assert eng_a.stats()["recompiles_after_warmup"] == 0
+    reg.close(timeout=30.0)

@@ -407,6 +407,52 @@ def test_http_error_mapping(artifact):
         eng.close()
 
 
+def test_http_registry_error_mapping(artifact):
+    """ISSUE 19: routing errors get their own status codes — unknown
+    model is a literal 404 mapped to UnknownModel, an exhausted tenant
+    quota is a literal 429 (+ Retry-After) mapped to QuotaExceeded, and
+    neither is confused with the 503 shed path."""
+    import http.client
+    import json
+
+    eng, _ = _engine(artifact)
+    reg = serving.ModelRegistry()
+    reg.register("solo", engine=eng)
+    reg.set_quota("capped", rate=0.001, burst=1)
+    srv = serving.ServingServer(None, port=0, registry=reg).start()
+    try:
+        client = serving.Client(srv.url)
+        x = np.ones((1, 8), np.float32)
+        client.predict([x], model="solo")        # sanity: routes fine
+        with pytest.raises(serving.UnknownModel):
+            client.predict([x], model="nope")
+        client.predict([x], model="solo", tenant="capped")  # burst spent
+        with pytest.raises(serving.QuotaExceeded):
+            client.predict([x], model="solo", tenant="capped")
+
+        # literal status codes on the wire, not just client exceptions
+        host, port = srv.url.split("//")[1].split(":")
+        body = json.dumps({"inputs": [x.tolist()], "model": "nope"})
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.request("POST", "/predict", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 404, resp.read()
+        assert json.loads(resp.read())["error"] == "UnknownModel"
+        body = json.dumps({"inputs": [x.tolist()], "model": "solo",
+                           "tenant": "capped"})
+        conn.request("POST", "/predict", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 429, resp.read()
+        assert resp.getheader("Retry-After") is not None
+        assert json.loads(resp.read())["error"] == "QuotaExceeded"
+        conn.close()
+    finally:
+        srv.close()
+        reg.close()
+
+
 # -------------------------------------------- predictor pad-to-bucket --
 def _save_plain(tmp_path, seed=0):
     paddle.seed(seed)

@@ -46,6 +46,14 @@ Scenarios (``--scenario``, default ``all``):
   the hot paths never recompile, no future is stranded, the page pool
   is reclaimed, and clients ride through the restart via the reconnect
   path.
+- ``registry`` — :func:`paddle_tpu.testing.chaos.registry_main`: the
+  multi-model control plane under fire — two models behind one
+  ModelRegistry/HTTP plane while clients route to both: a live weight
+  swap on model A (bitwise per version, B unmoved), model B unloaded
+  mid-traffic (clean 404s, drained, no stranded futures) then
+  reloaded, generation pages fully reclaimed at unload, and a
+  supervised two-model replica hard-crash with clients riding through
+  and both models bitwise after the restart.
 - ``anomaly`` — :func:`paddle_tpu.testing.chaos.anomaly_main`: the
   data-plane counterpart on mesh ``{dp: 8}`` with int8+error-feedback
   grad_comm: injected NaN batches, a non-finite gradient bucket, one
@@ -59,7 +67,7 @@ Scenarios (``--scenario``, default ``all``):
 
 Usage::
 
-    python tools/chaos_smoke.py [--scenario all|training|serving|generation|swap|reshard|supervise|anomaly]
+    python tools/chaos_smoke.py [--scenario all|training|serving|generation|swap|registry|reshard|supervise|anomaly]
                                 [--epochs 4] [--verbose]
 
 CI treats a non-zero exit as a robustness regression.  The same flows
@@ -81,7 +89,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     ap.add_argument("--scenario", default="all",
                     choices=["all", "training", "serving", "generation",
-                             "swap", "reshard", "supervise", "anomaly"])
+                             "swap", "registry", "reshard", "supervise",
+                             "anomaly"])
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
@@ -106,6 +115,8 @@ def main(argv=None) -> int:
         rc |= chaos.generation_main(verbose=args.verbose)
     if args.scenario in ("all", "swap"):
         rc |= chaos.swap_main(verbose=args.verbose)
+    if args.scenario in ("all", "registry"):
+        rc |= chaos.registry_main(verbose=args.verbose)
     if args.scenario == "reshard":
         rc |= chaos.reshard_main(verbose=args.verbose)
     if args.scenario == "supervise":

@@ -27,6 +27,29 @@ that :class:`~paddle_tpu.utils.checkpoint.SnapshotStore` directory and
 hot-swaps newly published, digest-verified weights into the live
 engine with zero downtime and zero recompiles (see README "Serving
 operations").
+
+**Multi-model mode**: ``--models manifest.json`` starts the full
+control plane instead — every entry in the manifest is loaded into a
+:class:`~paddle_tpu.serving.ModelRegistry` (each model warms before
+its name becomes routable; readiness flips when ALL manifest models
+are ready), requests route by the JSON ``"model"`` field / ``X-Model``
+header, and ``/admin/models`` loads/unloads/aliases more models at
+runtime.  Manifest shape::
+
+    {"models": {
+        "prod-resnet": {"artifact": "/path/prefix",
+                         "weights_dir": "/path/snapshots",
+                         "aliases": ["prod"], "weight": 2.0,
+                         "rest_shapes": [[3, 224, 224]]},
+        "canary":      {"artifact": "/other/prefix"}},
+     "default": "prod-resnet",
+     "max_inflight": 128,
+     "quotas": {"tenant-a": {"rate": 50, "burst": 100}}}
+
+``max_inflight`` is the weighted-fair-queuing pool; ``quotas`` are
+per-tenant token buckets.  With ``FLAGS_compile_cache_dir`` set the
+per-model warmups deserialize previously compiled buckets instead of
+paying XLA again (see README "Multi-model control plane").
 """
 from __future__ import annotations
 
@@ -44,8 +67,14 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__.splitlines()[1],
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
-    ap.add_argument("model", help="artifact path prefix (as passed to "
-                                  "jit.save / save_inference_model)")
+    ap.add_argument("model", nargs="?", default=None,
+                    help="artifact path prefix (as passed to jit.save / "
+                         "save_inference_model); omit with --models")
+    ap.add_argument("--models", default=None, metavar="MANIFEST.json",
+                    help="multi-model manifest (see module docstring): "
+                         "serve a ModelRegistry with per-model engines, "
+                         "admin endpoints, WFQ and quotas instead of a "
+                         "single engine")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--max-batch-size", type=int, default=32)
@@ -75,6 +104,11 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from paddle_tpu import inference, serving
+
+    if args.models:
+        return _serve_registry(args)
+    if not args.model:
+        ap.error("need an artifact prefix (or --models MANIFEST.json)")
 
     config = inference.Config(args.model)
     predictor = inference.create_predictor(config)
@@ -130,6 +164,79 @@ def main(argv=None) -> int:
               f"{c['batches']} batches (shed={c['shed']}, "
               f"expired={c['deadline_expired']}, "
               f"weight_swaps={c['weight_swaps']})", flush=True)
+    return 0
+
+
+def _serve_registry(args) -> int:
+    """--models mode: a ModelRegistry behind one HTTP plane."""
+    import json
+
+    from paddle_tpu import serving
+
+    with open(args.models) as f:
+        manifest = json.load(f)
+    models = manifest.get("models") or {}
+    if not models:
+        print(f"manifest {args.models} has no models", file=sys.stderr)
+        return 2
+
+    reg = serving.ModelRegistry(
+        max_inflight=manifest.get("max_inflight"),
+        default_model=manifest.get("default"))
+    for tenant, q in (manifest.get("quotas") or {}).items():
+        reg.set_quota(tenant, float(q["rate"]), q.get("burst"))
+
+    # bind first, not-ready: the readiness gate holds traffic while
+    # every manifest model loads + warms (each name becomes routable
+    # the moment ITS warmup finishes — a late model never blocks an
+    # early one from serving admin/metrics probes)
+    srv = serving.ServingServer(None, host=args.host, port=args.port,
+                                verbose=args.verbose, ready=False,
+                                registry=reg).start()
+    for name, spec in models.items():
+        rest = ([tuple(int(d) for d in s) for s in spec["rest_shapes"]]
+                if spec.get("rest_shapes") else None)
+        entry = reg.load(
+            name, spec["artifact"],
+            weights_dir=spec.get("weights_dir"),
+            weights_poll_s=float(spec.get("weights_poll_s", 2.0)),
+            aliases=spec.get("aliases", ()),
+            weight=float(spec.get("weight", 1.0)),
+            warmup=not args.no_warmup, rest_shapes=rest,
+            engine_kwargs={
+                "max_batch_size": args.max_batch_size,
+                "batch_timeout_ms": args.batch_timeout_ms,
+                "max_queue": args.max_queue,
+                "default_deadline_ms": args.deadline_ms,
+            })
+        print(f"loaded {name} <- {spec['artifact']} "
+              f"(weight={entry.weight}, "
+              f"aliases={list(spec.get('aliases', ()))})", flush=True)
+    srv.mark_ready()
+
+    stop = {"sig": None}
+
+    def _on_signal(signum, frame):
+        stop["sig"] = signum
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    print(f"serving {len(reg.models())} models {reg.models()} on "
+          f"{srv.url}  (POST /predict {{\"model\": ...}}, "
+          f"GET/POST /admin/models)", flush=True)
+    try:
+        signal.pause()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        print("draining...", flush=True)
+        srv.close()
+        reg.close(timeout=30.0)
+        c = reg.stats()["counters"]
+        print(f"routed {c['requests']} requests across "
+              f"{c['loads']} loads / {c['unloads']} unloads "
+              f"(wfq_shed={c['wfq_shed']}, quota_shed={c['quota_shed']}, "
+              f"unknown_model={c['unknown_model']})", flush=True)
     return 0
 
 

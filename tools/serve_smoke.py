@@ -46,6 +46,28 @@ weight swap path:
     artifact's single-request answers exactly; responses after match
     the new artifact's.
 
+The **compile-cache gate** (``run_compile_cache_checks``) covers the
+persistent AOT executable cache (``FLAGS_compile_cache_dir``):
+
+11. **zero fresh compiles on a warm cache** — two *subprocess* cold
+    starts against one cache dir; the second must warm up entirely
+    from deserialized executables (``compile_cache.hits`` only — no
+    misses, rejects, or stores).
+12. **>=5x faster warm start** — the second process's ``warmup()``
+    wall time must be at least 5x faster than the first's (XLA
+    compiles are seconds; deserializes are milliseconds).
+13. **bitwise across the cache** — a loaded executable answers exactly
+    like the freshly compiled one.
+
+The **WFQ gate** (``run_wfq_checks``) covers multi-model fair
+admission through the :class:`~paddle_tpu.serving.ModelRegistry`:
+
+14. **isolation under saturation** — a tenant flooding model A past
+    the shared in-flight pool must be clamped to A's weighted share
+    (``registry.wfq_shed`` > 0) while model B's p99 latency stays
+    within 1.5x of its solo baseline (+ a small absolute floor), with
+    every B response bitwise-correct.
+
 Usage:  python tools/serve_smoke.py [--requests N] [--clients C]
 """
 from __future__ import annotations
@@ -336,6 +358,209 @@ def run_hotswap_checks(verbose: bool = False) -> list:
     return failures
 
 
+# the child driver for the compile-cache gate: one cold start in a
+# fresh process — build the predictor, time warmup, answer one request,
+# report the cache counters.  Run twice against one cache dir; the
+# second incarnation must warm from deserialized executables only.
+_CACHE_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, sys.argv[1])
+import numpy as np
+from paddle_tpu import inference, serving
+from paddle_tpu.core import compile_cache
+
+pred = inference.create_predictor(inference.Config(sys.argv[2]))
+engine = serving.InferenceEngine(pred, max_batch_size=8,
+                                 batch_timeout_ms=5.0)
+t0 = time.perf_counter()
+n = engine.warmup()
+warmup_s = time.perf_counter() - t0
+x = (np.arange(64, dtype=np.float32).reshape(2, 32) / 16.0)
+out = engine.infer_sync([x], timeout=60)
+engine.close()
+print(json.dumps({"warmup_s": warmup_s, "variants": n,
+                  "stats": compile_cache.stats(),
+                  "out": np.asarray(out[0]).tolist()}))
+"""
+
+CACHE_SPEEDUP_FLOOR = 5.0
+
+
+def run_compile_cache_checks(verbose: bool = False) -> list:
+    """Compile-cache gate; returns failure strings (empty = healthy)."""
+    import json
+    import shutil
+    import subprocess
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, nn
+    from paddle_tpu.jit import InputSpec
+
+    failures = []
+    workdir = tempfile.mkdtemp(prefix="serve_smoke_cache_")
+    paddle.seed(11)
+    # deep enough that XLA compile time dominates warmup — the ratio
+    # this gate measures is compile-vs-deserialize, and a one-layer toy
+    # would hide a cache regression inside fixed engine overhead
+    layers = []
+    for _ in range(8):
+        layers += [nn.Linear(32, 32), nn.ReLU()]
+    layers.append(nn.Linear(32, 4))
+    model = nn.Sequential(*layers)
+    prefix = os.path.join(workdir, "m")
+    jit.save(model, prefix, input_spec=[InputSpec([None, 32], "float32")])
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FLAGS_compile_cache_dir"] = os.path.join(workdir, "xcache")
+    runs = []
+    for i in range(2):
+        r = subprocess.run([sys.executable, "-c", _CACHE_CHILD, REPO,
+                            prefix], env=env, capture_output=True,
+                           text=True, timeout=600)
+        if r.returncode != 0:
+            failures.append(f"cold start {i} crashed (rc={r.returncode}):"
+                            f" {r.stderr.strip()[-500:]}")
+            shutil.rmtree(workdir, ignore_errors=True)
+            return failures
+        runs.append(json.loads(r.stdout.strip().splitlines()[-1]))
+    first, second = runs
+
+    if first["stats"]["stores"] < 1:
+        failures.append(f"first cold start stored nothing: "
+                        f"{first['stats']}")
+    s2 = second["stats"]
+    if s2["misses"] or s2["rejects"] or s2["stores"]:
+        failures.append(
+            f"second cold start paid fresh compiles with a warm cache: "
+            f"{s2} (every bucket must load)")
+    if s2["hits"] < second["variants"]:
+        failures.append(f"only {s2['hits']} cache hits for "
+                        f"{second['variants']} warmed variants")
+    speedup = (first["warmup_s"] / second["warmup_s"]
+               if second["warmup_s"] > 0 else float("inf"))
+    if speedup < CACHE_SPEEDUP_FLOOR:
+        failures.append(
+            f"warm-cache warmup only {speedup:.1f}x faster "
+            f"({first['warmup_s']:.3f}s -> {second['warmup_s']:.3f}s; "
+            f"floor {CACHE_SPEEDUP_FLOOR}x)")
+    if first["out"] != second["out"]:
+        failures.append("loaded executable's response is not bitwise-"
+                        "identical to the freshly compiled one")
+    if verbose:
+        print(f"compile cache: cold {first['warmup_s']:.3f}s "
+              f"({first['stats']['stores']} stored) -> warm "
+              f"{second['warmup_s']:.3f}s ({s2['hits']} hits, "
+              f"{speedup:.1f}x)")
+    shutil.rmtree(workdir, ignore_errors=True)
+    return failures
+
+
+WFQ_P99_RATIO = 1.5
+WFQ_P99_FLOOR_MS = 25.0
+
+
+def run_wfq_checks(verbose: bool = False) -> list:
+    """WFQ isolation gate; returns failure strings (empty = healthy)."""
+    import tempfile
+    import threading
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, jit, serving
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.testing.chaos import make_dyadic_model
+    from paddle_tpu.utils import monitor
+
+    failures = []
+    workdir = tempfile.mkdtemp(prefix="serve_smoke_wfq_")
+    paddle.seed(11)
+    model = make_dyadic_model(in_dim=8, hidden=16, out_dim=4)
+    prefix = os.path.join(workdir, "m")
+    jit.save(model, prefix, input_spec=[InputSpec([None, 8], "float32")])
+
+    def engine(name):
+        pred = inference.create_predictor(inference.Config(prefix))
+        e = serving.InferenceEngine(pred, max_batch_size=8,
+                                    batch_timeout_ms=1.0, max_queue=512,
+                                    name=name)
+        e.warmup()
+        return e
+
+    monitor.stat_reset("registry.wfq_shed")
+    reg = serving.ModelRegistry(max_inflight=16)
+    reg.register("hot", engine=engine("hot"))
+    reg.register("quiet", engine=engine("quiet"))
+
+    x = (np.arange(16, dtype=np.float32).reshape(2, 8) / 4.0)
+    ref = np.asarray(reg.infer_sync("quiet", [x], timeout=30)[0])
+
+    def quiet_p99(samples=60):
+        lat = []
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            out = reg.infer_sync("quiet", [x], timeout=30)
+            lat.append((time.perf_counter() - t0) * 1e3)
+            if not np.array_equal(np.asarray(out[0]), ref):
+                failures.append("quiet-model response not bitwise "
+                                "under load")
+        return float(np.percentile(lat, 99))
+
+    solo = quiet_p99()
+
+    stop = threading.Event()
+    shed = [0]
+
+    def flooder():
+        pending = []
+        while not stop.is_set():
+            try:
+                pending.append(reg.infer("hot", [x]))
+            except serving.QueueFull:
+                shed[0] += 1
+                time.sleep(0.0005)
+            pending = [f for f in pending if not f.done()]
+        for f in pending:
+            try:
+                f.result(30)
+            except Exception:  # noqa: BLE001 - teardown only
+                pass
+
+    threads = [threading.Thread(target=flooder, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)             # let the flood saturate the pool
+    loaded = quiet_p99()
+    stop.set()
+    for t in threads:
+        t.join(60)
+
+    if shed[0] < 1 or monitor.get_stat("registry.wfq_shed") < 1:
+        failures.append(
+            f"the saturating tenant was never clamped to its weighted "
+            f"share (shed={shed[0]}) — the pool did not saturate, so "
+            f"the isolation measurement is vacuous")
+    bound = max(solo * WFQ_P99_RATIO, solo + WFQ_P99_FLOOR_MS)
+    if loaded > bound:
+        failures.append(
+            f"quiet model's p99 {loaded:.1f}ms under a saturating "
+            f"co-tenant exceeds {bound:.1f}ms (solo {solo:.1f}ms x "
+            f"{WFQ_P99_RATIO} + {WFQ_P99_FLOOR_MS}ms floor): WFQ is "
+            f"not isolating models")
+    if verbose:
+        print(f"wfq: quiet p99 {solo:.1f}ms solo -> {loaded:.1f}ms "
+              f"under flood (bound {bound:.1f}ms), hot shed "
+              f"{shed[0]}x")
+    reg.close(timeout=30)
+    import shutil
+    shutil.rmtree(workdir, ignore_errors=True)
+    return failures
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     ap.add_argument("--requests", type=int, default=64)
@@ -349,6 +574,10 @@ def main(argv=None) -> int:
         verbose=args.verbose)]
     failures += [f"hotswap: {f}" for f in run_hotswap_checks(
         verbose=args.verbose)]
+    failures += [f"compile-cache: {f}" for f in run_compile_cache_checks(
+        verbose=args.verbose)]
+    failures += [f"wfq: {f}" for f in run_wfq_checks(
+        verbose=args.verbose)]
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)
@@ -357,7 +586,9 @@ def main(argv=None) -> int:
           "batches, bitwise-correct responses, no stuck futures; decode: "
           "0 steady-state recompiles, slots backfilled, page pool "
           "reclaimed; hotswap: applied with 0 recompiles, readiness "
-          "green, bitwise per version)")
+          "green, bitwise per version; compile cache: warm start >=5x "
+          "with 0 fresh compiles, bitwise; wfq: quiet model isolated "
+          "from a saturating co-tenant)")
     return 0
 
 
