@@ -116,6 +116,18 @@ from .framework_compat import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa
                                in_dygraph_mode, set_cuda_rng_state,
                                set_printoptions)
 from .hapi import callbacks  # noqa: E402,F401
+
+# fleet telemetry: when the environment stages a spool dir (supervisors
+# forward FLAGS_obs_spool_dir + a per-incarnation FLAGS_obs_role into
+# every child they spawn), the exporter installs at import — a
+# supervised child exports with zero code changes.  Unset (the normal
+# case), this is one flag read.
+from .core import flags as _flags  # noqa: E402
+
+if _flags.get_flag("obs_spool_dir"):
+    from .observability import export as _obs_export  # noqa: E402
+
+    _obs_export.install_exporter()
 from .ops.linalg import cholesky, histogram, inverse  # noqa: E402,F401
 from .ops.manipulation import (crop_tensor, scatter_, shard_index,  # noqa
                                slice, squeeze_, strided_slice, unsqueeze_)

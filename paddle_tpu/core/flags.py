@@ -301,6 +301,41 @@ define_flag("compile_cache_dir", "",
             "(it heap-corrupts reloading NamedSharding executables on "
             "jaxlib 0.4.37; see core/xla_env.py / PR 8).  Empty = "
             "disabled (no filesystem traffic).")
+define_flag("metrics_dump_max_mb", 0.0,
+            "Size-based rotation threshold for the FLAGS_metrics_dump_"
+            "path JSONL file: before each append, a file at/above this "
+            "many MiB is atomically renamed to <path>.1 (existing "
+            "rotated files shift up, the oldest beyond "
+            "FLAGS_metrics_dump_keep is deleted) so long-lived replicas "
+            "never grow one unbounded flight file.  <= 0 disables "
+            "rotation (legacy unbounded append).")
+define_flag("metrics_dump_keep", 3,
+            "Rotated metrics-dump files retained (<path>.1 .. <path>.N) "
+            "when FLAGS_metrics_dump_max_mb rotation triggers.")
+define_flag("obs_spool_dir", "",
+            "Fleet telemetry spool directory.  When set, this process "
+            "installs the per-process telemetry exporter "
+            "(observability.export) at import: checksummed metrics "
+            "snapshots and tracer-ring segments are spooled atomically "
+            "to <dir>/<role>-<pid>/ for the fleet aggregator "
+            "(observability.fleet) to merge into one timeline / one "
+            "Prometheus view.  Supervisors stage this into child "
+            "environments automatically, so supervised children and "
+            "serving replicas export with zero code changes.  Empty = "
+            "off: instrumented sites pay one module-attribute "
+            "None-check (the core.obs_hook contract).")
+define_flag("obs_role", "",
+            "Role label for this process's telemetry spool "
+            "(<role>-<pid> directory name and the {proc=...} Prometheus "
+            "label).  Supervisors stage '<name>-a<attempt>' for each "
+            "child incarnation; empty = 'proc'.")
+define_flag("obs_export_interval_s", 5.0,
+            "Seconds between telemetry spool flushes.  The exporter's "
+            "daemon thread flushes on this cadence; instrumented hot "
+            "paths (Executor._run, the serving dispatchers) also tick "
+            "it so a busy process that dies between timer fires still "
+            "leaves a recent spool.  Ticks inside the interval are "
+            "rate-limited to one time check.")
 define_flag("pallas_attention_dropout_min_seqlen", 512,
             "Flash threshold when attention dropout is active: the XLA "
             "path must materialize [B,H,L,L] dropout masks in HBM, so "

@@ -20,6 +20,9 @@ _heartbeat = None   # paddle_tpu.distributed.supervisor.HeartbeatWriter
                     # when this process runs under a TrainingSupervisor
 _anomaly = None     # paddle_tpu.distributed.anomaly.AnomalyPolicy when
                     # a data-plane anomaly policy is installed
+_export = None      # paddle_tpu.observability.export.TelemetryExporter
+                    # when this process spools telemetry for the fleet
+                    # aggregator (FLAGS_obs_spool_dir)
 
 
 def set_tracer(tracer) -> None:
@@ -65,3 +68,12 @@ def set_anomaly_policy(policy) -> None:
 
 def current_anomaly_policy():
     return _anomaly
+
+
+def set_export(exporter) -> None:
+    global _export
+    _export = exporter
+
+
+def current_export():
+    return _export

@@ -76,7 +76,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, List, NamedTuple, Optional, Sequence, Union
 
-from ..core import obs_hook
+from ..core import flags, obs_hook
 from ..utils import monitor
 
 __all__ = ["Heartbeat", "HeartbeatReader", "HeartbeatWriter",
@@ -387,6 +387,7 @@ class ProcessSupervisor:
         self._own_workdir: Optional[str] = None
         self.dump_flight_on_kill = dump_flight_on_kill
         self.exit_history: List[dict] = []
+        self.last_heartbeat: Optional[Heartbeat] = None
         self._stop = threading.Event()
         self._proc = None
 
@@ -404,10 +405,21 @@ class ProcessSupervisor:
     def _env_for(self, attempt: int) -> dict:
         env = self._child_env
         if env is None:
-            return {}
-        if callable(env):
-            return dict(env(attempt) or {})
-        return dict(env)
+            out = {}
+        elif callable(env):
+            out = dict(env(attempt) or {})
+        else:
+            out = dict(env)
+        # fleet telemetry staging: when this process spools, children
+        # spool too — flags seed from FLAGS_* env at define time, so a
+        # spawn child's fresh interpreter picks these up with zero code
+        # changes in the entrypoint.  setdefault keeps an explicit
+        # child_env override (or a disable via None) authoritative.
+        spool = flags.get_flag("obs_spool_dir")
+        if spool:
+            out.setdefault("FLAGS_obs_spool_dir", spool)
+            out.setdefault("FLAGS_obs_role", f"{self.name}-a{attempt}")
+        return out
 
     def _dir(self) -> str:
         if self._workdir is None:
@@ -446,12 +458,25 @@ class ProcessSupervisor:
             import warnings
             warnings.warn(f"supervisor: kill-time flight dump failed: {e}")
 
+    def _child_dump_paths(self) -> List[str]:
+        """Every per-attempt black box the dead children left in the
+        workdir (kill-time flight dumps, the children's own crash
+        dumps): the give-up record points at all of them so the
+        post-mortem needs no directory spelunking."""
+        import glob as _glob
+        out: List[str] = []
+        for pat in ("supervisor_kill_a*.json", "flight_record*.json",
+                    "*_flight.json"):
+            out.extend(_glob.glob(os.path.join(self._dir(), pat)))
+        return sorted(set(out))
+
     def _dump_giveup_flight(self, attempts: int,
                             recent_failures: int) -> None:
         if not self.dump_flight_on_kill:
             return
         from ..observability.flight import dump_flight
         path = os.path.join(self._dir(), "supervisor_giveup.json")
+        hb = self.last_heartbeat
         try:
             dump_flight(path, reason="supervisor.give_up", extra={
                 "supervisor": self.name,
@@ -461,11 +486,38 @@ class ProcessSupervisor:
                 "crash_budget": self.crash_budget,
                 "max_restarts": self.max_restarts,
                 "exit_history": list(self.exit_history),
+                "child_dumps": self._child_dump_paths(),
+                # inlined, not just pointed at: the heartbeat file is
+                # a binary record that an operator reading one JSON
+                # dump should not have to decode
+                "last_heartbeat": None if hb is None else {
+                    "time": hb.time,
+                    "step": hb.step,
+                    "predicted_step_s": hb.predicted_step_s,
+                    "interval_s": hb.interval_s,
+                    "age_s": round(time.time() - hb.time, 3),
+                },
             })
         except Exception as e:  # noqa: BLE001 - give-up must proceed
             import warnings
             warnings.warn(
                 f"supervisor: give-up flight dump failed: {e}")
+        # when the fleet is spooling, a give-up is a fleet incident:
+        # collect every process's telemetry (this parent's lane
+        # included) next to the give-up dump
+        if flags.get_flag("obs_spool_dir"):
+            try:
+                from ..observability import fleet
+                fleet.collect_fleet_bundle(
+                    os.path.join(self._dir(), "fleet_bundle"),
+                    extra_paths=self._child_dump_paths() + [path],
+                    reason=f"supervisor.give_up:{self.name}",
+                    extra={"attempts": attempts,
+                           "recent_failures": recent_failures})
+            except Exception as e:  # noqa: BLE001
+                import warnings
+                warnings.warn(
+                    f"supervisor: fleet bundle collection failed: {e}")
 
     def _kill(self, proc, reason: str, attempt: int,
               hb: Optional[Heartbeat], deadline: float) -> None:
@@ -590,6 +642,7 @@ class ProcessSupervisor:
                 self.watchdog.observe(final_hb)
                 hb = final_hb
             reader.close()
+            self.last_heartbeat = hb
             self._proc = None
             code = proc.exitcode
             rec = {

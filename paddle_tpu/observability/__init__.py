@@ -32,6 +32,13 @@ The reproduction's four telemetry islands (profiler host spans,
   :class:`SLORule` rolling-window burn-rate rules over the monitor
   registry; :func:`slo_status` drives ``/healthz`` degradation and the
   ``paddle_tpu_slo_*`` Prometheus gauges.
+- :func:`install_exporter` (:mod:`.export`) spools this process's
+  metrics + trace segments under ``FLAGS_obs_spool_dir`` for the fleet
+  aggregator (:mod:`.fleet`): :func:`fleet_snapshot`,
+  :func:`fleet_prometheus_text` (one exposition with ``proc`` labels),
+  :func:`merged_chrome_trace` (one timeline, a lane per process),
+  :func:`assemble_trace` (one distributed request's span tree) and
+  :class:`FleetView` behind ``GET /admin/fleet``.
 """
 from __future__ import annotations
 
@@ -41,9 +48,15 @@ from typing import Optional
 from ..core import obs_hook
 from .compiles import (annotate_compile, explain_compiles,
                        record_compile, reset_compiles)
+from .export import (TelemetryExporter, get_exporter, install_exporter,
+                     uninstall_exporter)
+from .fleet import (FleetView, assemble_trace, collect_fleet_bundle,
+                    fleet_prometheus_text, fleet_snapshot,
+                    merged_chrome_trace, read_spool)
 from .flight import (dump_flight, flight_recorder_path,
                      install_flight_recorder, uninstall_flight_recorder)
-from .metrics import dump_metrics, metrics_snapshot, prometheus_text
+from .metrics import (build_info, dump_metrics, metrics_snapshot,
+                      prometheus_text)
 from .perf import (PerfObservatory, device_memory, disable_perf,
                    enable_perf, get_perf, perf_enabled, perf_report,
                    render_perf_report)
@@ -57,9 +70,13 @@ __all__ = [
     "get_tracer", "emit", "span", "counter", "set_step",
     "record_compile", "explain_compiles", "reset_compiles",
     "annotate_compile",
-    "prometheus_text", "metrics_snapshot", "dump_metrics",
+    "prometheus_text", "metrics_snapshot", "dump_metrics", "build_info",
     "install_flight_recorder", "uninstall_flight_recorder",
     "dump_flight", "flight_recorder_path",
+    "TelemetryExporter", "install_exporter", "uninstall_exporter",
+    "get_exporter",
+    "FleetView", "read_spool", "fleet_snapshot", "fleet_prometheus_text",
+    "merged_chrome_trace", "assemble_trace", "collect_fleet_bundle",
     "PerfObservatory", "enable_perf", "disable_perf", "perf_enabled",
     "get_perf", "perf_report", "render_perf_report", "device_memory",
     "SLORule", "SLOMonitor", "install_slo_monitor",

@@ -85,11 +85,13 @@ def dump_flight(path: Optional[str] = None, reason: str = "manual",
             trc.emit("crash", reason,
                      args={"exc": type(exc).__name__ if exc else None})
         from .compiles import explain_compiles
+        from .metrics import build_info
         comp = explain_compiles()
         payload = {
             "reason": reason,
             "time": time.time(),
             "pid": os.getpid(),
+            "build": build_info(),
             "exception": _dump_exc_info(exc) if exc is not None else None,
             "events": events,
             # drop accounting rides every dump: a black box whose ring

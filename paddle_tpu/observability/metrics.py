@@ -18,6 +18,7 @@ render as
 from __future__ import annotations
 
 import json
+import os
 import re
 import time
 from typing import Dict, Optional
@@ -25,10 +26,41 @@ from typing import Dict, Optional
 from ..core import flags, obs_hook
 from ..utils import monitor
 
-__all__ = ["prometheus_text", "metrics_snapshot", "dump_metrics"]
+__all__ = ["prometheus_text", "metrics_snapshot", "dump_metrics",
+           "build_info"]
 
 _BAD = re.compile(r"[^a-zA-Z0-9_:]")
 _PREFIX = "paddle_tpu_"
+
+_build_info_cache: Optional[dict] = None
+
+
+def build_info() -> dict:
+    """Version/backend identity of this process — the fleet view diffs
+    it across replicas to detect version skew (a hot-swapped weight
+    snapshot landing on a replica running different jax/jaxlib is a
+    real failure mode).  Cached after the first call; never initializes
+    a backend the process has not already touched (device count falls
+    back to 0 if jax has no initialized backend yet and counting would
+    have to create one)."""
+    global _build_info_cache
+    if _build_info_cache is None:
+        import jax
+        import jaxlib
+        from .. import __version__
+        try:
+            backend = jax.default_backend()
+            devices = jax.device_count()
+        except Exception:       # no usable backend: identity still dumps
+            backend, devices = "unknown", 0
+        _build_info_cache = {
+            "framework": __version__,
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "backend": backend,
+            "device_count": int(devices),
+        }
+    return dict(_build_info_cache)
 
 
 def _prom_name(name: str) -> str:
@@ -42,6 +74,10 @@ def _fmt(v) -> str:
     if isinstance(v, int):
         return str(v)
     return repr(float(v))
+
+
+def _esc_label(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
 
 
 def prometheus_text(extra_gauges: Optional[Dict[str, float]] = None
@@ -99,6 +135,11 @@ def prometheus_text(extra_gauges: Optional[Dict[str, float]] = None
             continue
         seen.add(key)
         smp.append(f"{m}{key} {_fmt(extra_gauges[name])}")
+    bi = build_info()
+    labels = ",".join(f'{k}="{_esc_label(v)}"'
+                      for k, v in sorted(bi.items()))
+    _, smp, _ = fam(_PREFIX + "build_info", "gauge")
+    smp.append(f"{_PREFIX}build_info{{{labels}}} 1")
     lines = []
     for m, (typ, smp, _) in families.items():
         lines.append(f"# TYPE {m} {typ}")
@@ -119,6 +160,7 @@ def metrics_snapshot(extra: Optional[dict] = None) -> dict:
         "time": time.time(),
         "stats": monitor.all_stats(),
         "histograms": monitor.all_histograms(),
+        "build": build_info(),
     }
     if ring is not None:
         snap["obs"] = ring
@@ -133,15 +175,45 @@ def metrics_snapshot(extra: Optional[dict] = None) -> dict:
     return snap
 
 
+def _rotate_dump(path: str) -> None:
+    """Size-based rotation for the JSONL flight file: at/above
+    ``FLAGS_metrics_dump_max_mb`` MiB, shift ``path.i`` -> ``path.i+1``
+    (dropping the one past ``FLAGS_metrics_dump_keep``) and move the
+    live file to ``path.1`` via atomic rename, so a long-lived replica
+    never grows one unbounded file and a crash mid-rotation never loses
+    the live file (rename is the last step)."""
+    max_mb = float(flags.get_flag("metrics_dump_max_mb"))
+    if max_mb <= 0:
+        return
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return
+    if size < max_mb * (1 << 20):
+        return
+    keep = max(1, int(flags.get_flag("metrics_dump_keep")))
+    oldest = f"{path}.{keep}"
+    if os.path.exists(oldest):
+        os.remove(oldest)
+    for i in range(keep - 1, 0, -1):
+        src = f"{path}.{i}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{i + 1}")
+    os.replace(path, f"{path}.1")
+
+
 def dump_metrics(path: Optional[str] = None,
                  extra: Optional[dict] = None) -> str:
     """Append one :func:`metrics_snapshot` line to the JSONL flight
-    file at ``path`` (default ``FLAGS_metrics_dump_path``)."""
+    file at ``path`` (default ``FLAGS_metrics_dump_path``); rotates the
+    file first when ``FLAGS_metrics_dump_max_mb`` is set and the file
+    has outgrown it."""
     path = path or flags.get_flag("metrics_dump_path")
     if not path:
         raise ValueError(
             "no metrics dump path: pass path= or set "
             "FLAGS_metrics_dump_path")
+    _rotate_dump(path)
     with open(path, "a") as f:
         f.write(json.dumps(metrics_snapshot(extra)) + "\n")
     return path

@@ -83,6 +83,30 @@ class Tracer:
     def step(self) -> Optional[int]:
         return self._step
 
+    # -- distributed trace context -----------------------------------------
+    def set_trace(self, trace_id: Optional[str],
+                  parent_span: Optional[str] = None) -> None:
+        """Bind a distributed trace context to this thread: every event
+        emitted here until :meth:`clear_trace` carries ``trace`` (and
+        ``remote_parent`` when the caller handed us a parent span id
+        from another process).  The serving HTTP front-end binds the
+        adopted/minted ``X-Trace-Id`` around request handling; the
+        engines copy the context onto queued requests so the scheduler
+        threads' events inherit it via rid/sid correlation."""
+        if trace_id is None:
+            self.clear_trace()
+            return
+        self._tls.trace = (str(trace_id),
+                           str(parent_span) if parent_span else None)
+
+    def clear_trace(self) -> None:
+        self._tls.trace = None
+
+    def current_trace(self) -> Optional[str]:
+        """This thread's bound trace id, or None."""
+        ctx = getattr(self._tls, "trace", None)
+        return ctx[0] if ctx else None
+
     # -- emission ----------------------------------------------------------
     def emit(self, kind: str, name: str, args: Optional[dict] = None,
              ts: Optional[float] = None, dur: Optional[float] = None,
@@ -90,7 +114,9 @@ class Tracer:
              ) -> int:
         """Append one event; returns its id.  ``ts`` is a
         ``time.perf_counter()`` stamp (defaults to now), ``dur`` is in
-        seconds."""
+        seconds.  ``parent`` defaults to this thread's innermost open
+        span, so any event emitted inside a span tree attaches to it
+        without the caller threading ids through."""
         ev: Dict[str, object] = {
             "id": next(self._ids) if sid is None else sid,
             "ts": time.perf_counter() if ts is None else ts,
@@ -102,8 +128,19 @@ class Tracer:
             ev["step"] = self._step
         if dur is not None:
             ev["dur"] = dur
+        if parent is None:
+            stack = getattr(self._tls, "stack", None)
+            if stack:
+                parent = stack[-1][0]
         if parent is not None:
             ev["parent"] = parent
+        ctx = getattr(self._tls, "trace", None)
+        if ctx is not None:
+            ev["trace"] = ctx[0]
+            if ctx[1] is not None and parent is None:
+                # cross-process attribution: the root of this process's
+                # subtree names the caller's span id
+                ev["remote_parent"] = ctx[1]
         if args:
             ev["args"] = args
         self._emitted += 1
@@ -246,6 +283,10 @@ class Tracer:
                 args["step"] = ev["step"]
             if "parent" in ev:
                 args["parent_span"] = ev["parent"]
+            if "trace" in ev:
+                args["trace"] = ev["trace"]
+            if "remote_parent" in ev:
+                args["remote_parent"] = ev["remote_parent"]
             base = {
                 "name": str(ev["name"]),
                 "cat": str(ev["kind"]),

@@ -67,7 +67,8 @@ _REQUEST_IDS = itertools.count(1)   # process-wide request correlation ids
 
 
 class _Request:
-    __slots__ = ("arrays", "rows", "future", "deadline", "t_enq", "rid")
+    __slots__ = ("arrays", "rows", "future", "deadline", "t_enq", "rid",
+                 "trace")
 
     def __init__(self, arrays, rows, deadline):
         self.arrays = arrays
@@ -76,6 +77,8 @@ class _Request:
         self.deadline = deadline            # monotonic seconds, or None
         self.t_enq = time.monotonic()
         self.rid = next(_REQUEST_IDS)
+        self.trace: Optional[str] = None    # distributed trace id, if the
+                                            # admitting thread carried one
 
 
 def _safe_set_result(fut: Future, value) -> None:
@@ -319,6 +322,10 @@ class InferenceEngine:
             self._cv.notify_all()
         trc = obs_hook._tracer
         if trc is not None:
+            # the admitting thread's distributed trace context (bound by
+            # the HTTP front-end) sticks to the request so the scheduler
+            # thread's dispatch event can carry it too
+            req.trace = trc.current_trace()
             trc.emit("serving", "enqueue",
                      args=self._ev(rid=req.rid, rows=n))
         return req.future
@@ -456,16 +463,23 @@ class InferenceEngine:
         hb = obs_hook._heartbeat
         if hb is not None:
             hb.beat(int(self._c["batches"]) + 1)
+        exp = obs_hook._export
+        if exp is not None:
+            exp.tick()
         trc = obs_hook._tracer
         if trc is not None:
             # one typed event per coalesced dispatch, correlated to the
-            # member requests by id
+            # member requests by id (and to their distributed traces,
+            # when the admitting threads carried any)
+            traces = sorted({r.trace for r in batch if r.trace})
             trc.emit("serving", "dispatch", ts=t_disp,
                      dur=t_done - t_disp,
                      args=self._ev(rids=[r.rid for r in batch],
                                    rows=rows, bucket=target,
                                    attempts=attempt + 1,
-                                   ok=last_exc is None))
+                                   ok=last_exc is None,
+                                   **({"traces": traces} if traces
+                                      else {})))
         if last_exc is not None:
             for r in batch:
                 _safe_set_exception(r.future, last_exc)

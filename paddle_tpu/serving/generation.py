@@ -141,7 +141,7 @@ class _Sequence:
 
     __slots__ = ("prompt", "max_new", "eos_id", "temperature", "seed",
                  "deadline", "t_enq", "t_first", "sid", "stream", "pages",
-                 "slot", "tokens", "last_token", "position")
+                 "slot", "tokens", "last_token", "position", "trace")
 
     def __init__(self, prompt, max_new, eos_id, temperature, seed,
                  deadline):
@@ -160,6 +160,8 @@ class _Sequence:
         self.tokens: List[int] = []           # generated (no prompt)
         self.last_token = 0
         self.position = 0                     # total tokens in cache
+        self.trace: Optional[str] = None      # distributed trace id, if
+                                              # the admitting thread had one
 
 
 class GenerationEngine:
@@ -283,7 +285,14 @@ class GenerationEngine:
         self._paused = False
         self._stepping = False          # a decode/prefill is in flight
 
-        # compiled executables: (kind, bucket) -> AOT executable
+        # compiled executables: (kind, bucket) -> AOT executable.
+        # _trace_lock serialises lower()+compile(): the traced step fns
+        # rebind self._model.params for the duration of the trace, so
+        # two concurrent traces (warmup on the caller's thread vs a
+        # serve-path miss on the scheduler) would clobber each other's
+        # binding and bake concrete weights into the jaxpr as constants
+        # — a corrupt executable with the wrong input arity.
+        self._trace_lock = threading.Lock()
         self._execs: Dict[tuple, object] = {}
         self._compile_count = 0
         self._warm_variants: Optional[int] = None
@@ -345,6 +354,12 @@ class GenerationEngine:
             deadline = time.monotonic() + dl_s
         seq = _Sequence(prompt, max_new, eos_id, float(temperature),
                         int(seed), deadline)
+        trc = obs_hook._tracer
+        if trc is not None:
+            # the admitting thread's distributed trace context (bound
+            # by the HTTP front-end) sticks to the sequence so the
+            # scheduler thread's prefill/decode events correlate to it
+            seq.trace = trc.current_trace()
         with self._cv:
             if self._closing or self._closed or self._draining:
                 raise EngineClosed("engine is draining or closed")
@@ -446,7 +461,12 @@ class GenerationEngine:
     def _get_exec(self, kind: str, bucket: int):
         key = (kind, bucket)
         ex = self._execs.get(key)
-        if ex is None:
+        if ex is not None:
+            return ex
+        with self._trace_lock:
+            ex = self._execs.get(key)
+            if ex is not None:
+                return ex
             c = self.config
             f32, i32 = jnp.float32, jnp.int32
             pool_aval = jax.ShapeDtypeStruct(self._pool.kv[0].shape, f32)
@@ -503,7 +523,7 @@ class GenerationEngine:
             }, note="warmup" if self._warm_variants is None
                     else "serve-path miss",
                 cache=cache_prov)
-        return ex
+            return ex
 
     def warmup(self) -> int:
         """AOT-compile every decode context bucket and prompt bucket.
@@ -854,6 +874,20 @@ class GenerationEngine:
         hb = obs_hook._heartbeat
         if hb is not None:
             hb.beat(int(self._c["decode_steps"]))
+        # fleet telemetry: ride the same cadence (one None-check when
+        # not spooling, a time comparison when no interval has passed)
+        exp = obs_hook._export
+        if exp is not None:
+            exp.tick()
+        # one typed event per decode step, correlated to every slotted
+        # sequence (and their distributed traces) — the step a request's
+        # token came from is findable on the fleet timeline
+        if obs_hook._tracer is not None:
+            traces = sorted({s.trace for s in active if s.trace})
+            self._emit("gen_decode_step", sids=[s.sid for s in active],
+                       n=int(self._c["decode_steps"]),
+                       dur_ms=step_s * 1000.0,
+                       **({"traces": traces} if traces else {}))
         # perf observatory: decode anatomy + memory sampler cadence
         p = obs_hook._perf
         if p is not None:

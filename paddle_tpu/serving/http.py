@@ -78,14 +78,17 @@ import http.client as httpclient
 import io
 import json
 import random
+import re
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterator, List, Optional
-from urllib.parse import urlsplit
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
+from ..core import obs_hook
 from ..observability import perf as _perf, slo as _slo
 from ..utils import monitor
 from .engine import (DeadlineExceeded, EngineClosed, InferenceEngine,
@@ -95,6 +98,16 @@ from .registry import ModelRegistry, QuotaExceeded, UnknownModel
 __all__ = ["ServingServer", "Client", "serve"]
 
 _NPY = "application/x-npy"
+
+# distributed trace ids on the wire: 1-64 chars, alnum plus ./_/-.
+# Anything else — oversized, control chars, empty — is treated as
+# ABSENT (a fresh id is minted), never as an error: a hostile or
+# buggy X-Trace-Id header must not be able to fail a request.
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._\-]{0,63}$")
+
+
+def _mint_trace_id() -> str:
+    return uuid.uuid4().hex
 
 
 def _engine_label(name) -> str:
@@ -127,11 +140,38 @@ class _Handler(BaseHTTPRequestHandler):
         if getattr(self.server, "verbose", False):
             super().log_message(fmt, *args)
 
+    # -- distributed trace context -----------------------------------------
+    def _bind_trace(self) -> str:
+        """Adopt the caller's ``X-Trace-Id`` (mint a fresh one when the
+        header is absent, malformed or oversized — never an error) and
+        bind it to this handler thread, so every event emitted while
+        handling — admission, enqueue, the engines' stamped copies —
+        carries the id.  ``X-Parent-Span`` (the caller's span id)
+        becomes the cross-process parent of this process's subtree."""
+        raw = self.headers.get("X-Trace-Id")
+        tid = raw if (raw and _TRACE_ID_RE.match(raw)) else _mint_trace_id()
+        self._trace_id = tid
+        parent = self.headers.get("X-Parent-Span")
+        if parent is not None and not parent.isdigit():
+            parent = None           # span ids are ints; drop garbage
+        trc = obs_hook._tracer
+        if trc is not None:
+            trc.set_trace(tid, parent)
+        return tid
+
+    def _unbind_trace(self) -> None:
+        trc = obs_hook._tracer
+        if trc is not None:
+            trc.clear_trace()
+
     def _reply(self, code: int, body: bytes, ctype: str = "application/json",
                extra_headers=()):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        tid = getattr(self, "_trace_id", None)
+        if tid is not None:         # echo so the caller learns minted ids
+            self.send_header("X-Trace-Id", tid)
         for k, v in extra_headers:
             self.send_header(k, v)
         self.end_headers()
@@ -146,6 +186,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Transfer-Encoding", "chunked")
+        tid = getattr(self, "_trace_id", None)
+        if tid is not None:
+            self.send_header("X-Trace-Id", tid)
         self.end_headers()
 
     def _write_chunk(self, payload: bytes) -> None:
@@ -188,7 +231,17 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes ------------------------------------------------------------
     def do_GET(self):
+        self._bind_trace()
+        try:
+            self._route_get()
+        finally:
+            self._unbind_trace()
+
+    def _route_get(self):
         path = self.path.split("?", 1)[0]
+        if path == "/admin/fleet":
+            self._do_fleet()
+            return
         if path == "/admin/models":
             if self.registry is None:
                 self._reply_json(501, {"error": "NotImplemented",
@@ -300,12 +353,33 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_json(404, {"error": "NotFound", "message": self.path})
 
     def do_POST(self):
+        tid = self._bind_trace()
+        path = self.path.split("?", 1)[0]
+        trc = obs_hook._tracer
+        sid = None
+        if trc is not None and path in ("/generate", "/predict"):
+            # the HTTP-accept span: the root of this process's subtree
+            # for the request — closed when the response (streaming
+            # included) is fully written
+            sid = trc.begin_span("http" + path.replace("/", "."),
+                                 method="POST", trace=tid)
+        try:
+            self._route_post()
+        finally:
+            if sid is not None:
+                trc.end_span(sid)
+            self._unbind_trace()
+
+    def _route_post(self):
         path = self.path.split("?", 1)[0]
         if path == "/generate":
             self._do_generate()
             return
         if path == "/admin/models":
             self._do_admin()
+            return
+        if path == "/admin/trace":
+            self._do_trace()
             return
         if path != "/predict":
             self._reply_json(404, {"error": "NotFound",
@@ -430,6 +504,53 @@ class _Handler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionError):
             pass                        # client went away mid-stream
 
+    def _do_fleet(self):
+        """``GET /admin/fleet``: the aggregated per-replica view.  With
+        a :class:`~paddle_tpu.observability.fleet.FleetView` attached
+        (``ServingServer(..., fleet=...)``), its live scrape of every
+        registered replica set; otherwise the spool-level summary, so
+        a lone replica with spooling on still answers usefully."""
+        try:
+            fv = getattr(self.server, "fleet", None)
+            if fv is not None:
+                self._reply_json(200, fv.snapshot())
+                return
+            from ..observability import fleet as _fleet
+            snap = _fleet.fleet_snapshot()
+            self._reply_json(200, {
+                "time": snap["time"], "fleet": {},
+                "spool": {"procs": sorted(snap["procs"]),
+                          "build_skew": snap["build_skew"]}})
+        except Exception as e:          # noqa: BLE001 - mapped to HTTP
+            self._reply_error(e)
+
+    def _do_trace(self):
+        """``POST /admin/trace?secs=N``: capture ``N`` seconds of fleet
+        activity (bounded; 0 = everything currently buffered/spooled)
+        and return the merged chrome-trace JSON — one lane per process,
+        loadable straight into Perfetto."""
+        try:
+            q = parse_qs(self.path.partition("?")[2])
+            secs = float(q.get("secs", ["0"])[0])
+        except (TypeError, ValueError):
+            self._reply_json(400, {"error": "ValueError",
+                                   "message": "secs must be a number"})
+            return
+        secs = max(0.0, min(secs, 60.0))
+        t0 = time.time()
+        if secs > 0:
+            time.sleep(secs)
+        try:
+            exp = obs_hook._export
+            if exp is not None:
+                exp.flush()         # this process's lane must be current
+            from ..observability import fleet as _fleet
+            trace = _fleet.merged_chrome_trace(
+                since_time=t0 if secs > 0 else None)
+            self._reply_json(200, trace)
+        except Exception as e:          # noqa: BLE001 - mapped to HTTP
+            self._reply_error(e)
+
     def _do_admin(self):
         """``POST /admin/models``: registry control actions.  Missing
         fields map to 400 (KeyError), unknown names to 404, so a fat-
@@ -496,7 +617,8 @@ class ServingServer:
                  port: int = 8000, request_timeout: float = 60.0,
                  verbose: bool = False, generation=None,
                  ready: bool = True, retry_after_s: float = 1.0,
-                 registry: Optional[ModelRegistry] = None):
+                 registry: Optional[ModelRegistry] = None,
+                 fleet=None):
         if engine is None and generation is None and registry is None:
             raise ValueError("attach an InferenceEngine, a "
                              "GenerationEngine, a ModelRegistry, or a "
@@ -509,6 +631,10 @@ class ServingServer:
         # enables the /admin/models control plane; a direct engine/
         # generation may still be attached (it serves /metrics detail)
         self._httpd.registry = registry
+        # a FleetView (observability.fleet) turns on GET /admin/fleet's
+        # live per-replica aggregation; without one the route degrades
+        # to the spool-level summary
+        self._httpd.fleet = fleet
         self._httpd.request_timeout = request_timeout
         self._httpd.verbose = verbose
         # readiness split: ``ready=False`` lets a supervised replica
@@ -534,6 +660,15 @@ class ServingServer:
     @property
     def ready(self) -> bool:
         return self._httpd.ready
+
+    @property
+    def fleet(self):
+        return self._httpd.fleet
+
+    def attach_fleet(self, fleet) -> None:
+        """Attach/replace the :class:`FleetView` behind
+        ``GET /admin/fleet`` (None detaches)."""
+        self._httpd.fleet = fleet
 
     def mark_ready(self) -> None:
         """Readiness gate up: warmup (or re-warm after a supervised
@@ -612,13 +747,21 @@ class Client:
     def __init__(self, base_url: str, timeout: float = 60.0,
                  reconnect_backoff_s: float = 0.2,
                  model: Optional[str] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 trace_id: Optional[str] = None):
         # multi-model routing: ``model`` pins every request from this
         # client to one registry entry (per-call ``model=`` overrides);
         # ``tenant`` attributes them to a quota bucket.  Both are None
         # for single-model servers — the wire format is unchanged.
         self.model = model
         self.tenant = tenant
+        # distributed tracing: every request carries an ``X-Trace-Id``
+        # — ``trace_id`` pins one id to every request from this client;
+        # None (default) mints one per request.  The id used by the
+        # most recent call is kept on ``last_trace_id`` so a caller can
+        # correlate its request with the fleet timeline.
+        self.trace_id = trace_id
+        self.last_trace_id: Optional[str] = None
         self.base_url = base_url.rstrip("/")
         u = urlsplit(self.base_url)
         if u.scheme not in ("http", ""):
@@ -743,18 +886,55 @@ class Client:
             body["tenant"] = t
         return body
 
+    def _trace_begin(self, path: str, headers: dict):
+        """Stamp distributed-trace headers onto one logical request —
+        BEFORE :meth:`_request`'s retry loop, so a reconnect replay
+        (supervised replica restart) carries the SAME trace id and the
+        ride-through renders as one request on the fleet timeline.
+        When tracing is on in this process, a ``client<path>`` span
+        opens and its id rides ``X-Parent-Span`` — the server's subtree
+        hangs off it across the process hop.  Returns ``(tracer, span
+        id)`` for :meth:`_trace_end` (both None when tracing is off)."""
+        tid = self.trace_id or _mint_trace_id()
+        self.last_trace_id = tid
+        headers["X-Trace-Id"] = tid
+        trc = obs_hook._tracer
+        if trc is None:
+            return None, None
+        sid = trc.begin_span("client" + path.replace("/", "."),
+                             trace=tid)
+        headers["X-Parent-Span"] = str(sid)
+        trc.set_trace(tid)
+        return trc, sid
+
+    @staticmethod
+    def _trace_end(trc, sid) -> None:
+        if trc is not None:
+            trc.end_span(sid)
+            trc.clear_trace()
+
     def _post(self, path: str, body: bytes, headers: dict) -> bytes:
-        r = self._request("POST", path, body=body, headers=headers)
-        raw = r.read()
-        self._finish(r)
+        headers = dict(headers)
+        trc, sid = self._trace_begin(path, headers)
+        try:
+            r = self._request("POST", path, body=body, headers=headers)
+            raw = r.read()
+            self._finish(r)
+        finally:
+            self._trace_end(trc, sid)
         if r.status >= 400:
             self._raise_for(r.status, raw)
         return raw
 
     def _get_json(self, path: str, headers: Optional[dict] = None):
-        r = self._request("GET", path, headers=headers)
-        raw = r.read()
-        self._finish(r)
+        headers = dict(headers or {})
+        trc, sid = self._trace_begin(path, headers)
+        try:
+            r = self._request("GET", path, headers=headers)
+            raw = r.read()
+            self._finish(r)
+        finally:
+            self._trace_end(trc, sid)
         if r.status >= 400:
             if path == "/healthz":      # 503 healthz still carries status
                 try:
@@ -820,10 +1000,14 @@ class Client:
 
     def metrics_text(self) -> str:
         """Prometheus text exposition (the scraper's view of /metrics)."""
-        r = self._request("GET", "/metrics",
-                          headers={"Accept": "text/plain"})
-        raw = r.read()
-        self._finish(r)
+        headers = {"Accept": "text/plain"}
+        trc, sid = self._trace_begin("/metrics", headers)
+        try:
+            r = self._request("GET", "/metrics", headers=headers)
+            raw = r.read()
+            self._finish(r)
+        finally:
+            self._trace_end(trc, sid)
         if r.status >= 400:
             self._raise_for(r.status, raw)
         return raw.decode()
@@ -864,38 +1048,44 @@ class Client:
         In-band server errors re-raise as the matching engine
         exceptions.  Abandoning the iterator mid-stream drops the
         pooled connection (it would otherwise carry unread chunks)."""
-        r = self._request("POST", "/generate", self._generate_body(
-            prompt, True, {"max_new_tokens": max_new_tokens,
-                           "eos_id": eos_id, "temperature": temperature,
-                           "seed": seed, "deadline_ms": deadline_ms},
-            model, tenant),
-            {"Content-Type": "application/json"})
-        if r.status >= 400:
-            raw = r.read()
-            self._finish(r)
-            self._raise_for(r.status, raw)
-        done = False
+        headers = {"Content-Type": "application/json"}
+        trc, sid = self._trace_begin("/generate", headers)
         try:
-            while True:
-                line = r.readline()
-                if not line:
-                    break
-                msg = json.loads(line.decode())
-                if "token" in msg:
-                    yield int(msg["token"])
-                elif "error" in msg:
-                    self._raise_for(200, line)
-                if msg.get("done"):
-                    break
-            # drain the terminating chunk so the socket is clean
-            while r.readline():
-                pass
-            done = True
-        finally:
-            if done:
+            r = self._request("POST", "/generate", self._generate_body(
+                prompt, True, {"max_new_tokens": max_new_tokens,
+                               "eos_id": eos_id,
+                               "temperature": temperature,
+                               "seed": seed, "deadline_ms": deadline_ms},
+                model, tenant),
+                headers)
+            if r.status >= 400:
+                raw = r.read()
                 self._finish(r)
-            else:           # abandoned/errored mid-stream: unread data
-                self._drop_conn()
+                self._raise_for(r.status, raw)
+            done = False
+            try:
+                while True:
+                    line = r.readline()
+                    if not line:
+                        break
+                    msg = json.loads(line.decode())
+                    if "token" in msg:
+                        yield int(msg["token"])
+                    elif "error" in msg:
+                        self._raise_for(200, line)
+                    if msg.get("done"):
+                        break
+                # drain the terminating chunk so the socket is clean
+                while r.readline():
+                    pass
+                done = True
+            finally:
+                if done:
+                    self._finish(r)
+                else:       # abandoned/errored mid-stream: unread data
+                    self._drop_conn()
+        finally:
+            self._trace_end(trc, sid)
 
     # -- model registry admin ----------------------------------------------
     def _admin(self, payload: dict) -> dict:

@@ -44,11 +44,12 @@ See README "Multi-model control plane" for operational semantics.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
-from ..core import obs_hook
+from ..core import flags, obs_hook
 from ..utils import monitor
 from .engine import EngineClosed, InferenceEngine, QueueFull, ServingError
 
@@ -409,6 +410,12 @@ class ModelRegistry:
         self._inflight[entry.name] += 1
         monitor.stat_set(f"registry.inflight.{entry.name}",
                          self._inflight[entry.name])
+        # emitted on the admitting (HTTP handler) thread, so it carries
+        # the bound distributed trace id — the admission decision is a
+        # node in the request's cross-process span tree
+        _emit("admit", model=entry.name,
+              tenant=None if tenant is None else str(tenant),
+              inflight=self._inflight[entry.name])
 
     def _release(self, name: str) -> None:
         with self._mu:
@@ -563,6 +570,32 @@ class ReplicaSet:
     def stop(self) -> None:
         self.scale_to(0)
 
+    def describe(self) -> dict:
+        """Per-replica control-plane view: supervisor readiness, the
+        replica's base URL (derived from its health probe URL) and its
+        restart count — ``GET /admin/fleet`` merges this with a live
+        scrape of each URL."""
+        from urllib.parse import urlparse
+        with self._mu:
+            replicas = []
+            for i, (sup, th) in enumerate(self._replicas):
+                info = {
+                    "index": i,
+                    "supervisor": getattr(sup, "name", None),
+                    "alive": th.is_alive(),
+                    "ready": getattr(sup, "ready", None),
+                    "restarts": len(getattr(sup, "exit_history", ())
+                                    or ()),
+                    "url": None,
+                }
+                hu = getattr(sup, "health_url", None)
+                if hu:
+                    u = urlparse(hu)
+                    info["url"] = f"{u.scheme or 'http'}://{u.netloc}"
+                replicas.append(info)
+            return {"name": self.name, "count": len(replicas),
+                    "replicas": replicas}
+
 
 class ElasticityController:
     """SLO burn rates -> per-model replica counts and shed decisions.
@@ -629,6 +662,19 @@ class ElasticityController:
         if trc is not None:
             trc.emit("elasticity", event, args=args)
 
+    def _collect_incident(self, name: str, burn: float) -> None:
+        spool = flags.get_flag("obs_spool_dir")
+        if not spool:
+            return
+        try:
+            from ..observability import fleet as _fleet
+            _fleet.collect_fleet_bundle(
+                os.path.join(spool, f"incident_shed_{name}"),
+                reason=f"registry.shed:{name}",
+                extra={"model": name, "burn": round(burn, 3)})
+        except Exception:   # telemetry must never break the control loop
+            pass
+
     def _model_state(self, name: str) -> dict:
         return self._state.setdefault(name, {
             "desired": self.min_replicas, "breach": 0, "clear": 0,
@@ -692,6 +738,10 @@ class ElasticityController:
                             monitor.stat_add("elasticity.shed")
                             self._emit("shed", model=name,
                                        burn=round(burn, 3))
+                            # a shed decision is a registry incident:
+                            # when the fleet is spooling, capture every
+                            # process's black box for the post-mortem
+                            self._collect_incident(name, burn)
                 elif burn <= self.scale_down_burn:
                     st["clear"] += 1
                     st["breach"] = 0

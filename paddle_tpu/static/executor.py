@@ -975,6 +975,13 @@ class Executor:
             hb.beat(run_i, getattr(compiled, "_predicted", None),
                     fresh_compile=compiled_this_run)
 
+        # fleet telemetry: ride the same per-step cadence (one
+        # None-check when not spooling; a time comparison otherwise —
+        # the exporter flushes at most once per interval)
+        exp = obs_hook._export
+        if exp is not None:
+            exp.tick()
+
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor(f) for f in fetches]

@@ -2302,3 +2302,262 @@ def _registry_supervised(prefix_a, prefix_b, refs, reqs, workdir,
               f"{len(failures)} transient failures, restarts="
               f"{monitor.get_stat('supervisor.serving.restarts')}")
     return out
+
+
+# ---------------------------------------------------------------------------
+# Fleet observability (ISSUE 20): cross-process telemetry aggregation
+# and one distributed /generate trace riding through a replica restart
+# ---------------------------------------------------------------------------
+
+def _fleet_gen_entry(port, state_file, stop_file):
+    """Supervised generation replica for the fleet-observability gate
+    (module-level so spawn can pickle it).  The spawn environment
+    carries ``FLAGS_obs_spool_dir``/``FLAGS_obs_role`` staged by the
+    supervisor, so this entrypoint spools telemetry with zero
+    observability code of its own — which is exactly the property the
+    gate exists to prove.  The FIRST incarnation hard-crashes
+    (``os._exit``, no atexit: only already-spooled segments survive)
+    about a second after going ready; the replacement serves until
+    ``stop_file`` appears."""
+    import threading
+    import time
+
+    from paddle_tpu import serving
+
+    model = make_dyadic_lm()
+    engine = serving.GenerationEngine(model, num_slots=4, page_size=4,
+                                      max_context=64)
+    srv = serving.ServingServer(None, port=port, generation=engine,
+                                ready=False).start()
+    engine.warmup()
+    srv.mark_ready()
+    if not os.path.exists(state_file):
+        with open(state_file, "w") as f:
+            f.write("1")
+
+        def _die():
+            time.sleep(1.0)
+            os._exit(9)         # a hard replica crash, mid-traffic
+
+        threading.Thread(target=_die, daemon=True).start()
+    while not os.path.exists(stop_file):
+        time.sleep(0.05)
+    srv.close()
+    engine.close()
+
+
+def fleet_main(verbose=False, workdir=None):
+    """Fleet-observability gate; returns 0 on success, 1 on failure.
+
+    A :class:`ServingSupervisor`-managed generation replica (spooling
+    telemetry via the staged ``FLAGS_obs_spool_dir``) hard-crashes
+    mid-traffic and is restarted; a traffic thread with a PINNED trace
+    id keeps issuing ``/generate`` requests through the outage.  Gates:
+
+    * the spool holds per-process records for the parent AND both
+      child incarnations (roles ``fleet-a0``/``fleet-a1``);
+    * :func:`~paddle_tpu.observability.fleet.merged_chrome_trace`
+      yields named, wall-time-aligned lanes for all of them, and the
+      parent lane carries the supervisor ``restart`` event with the
+      crash reason;
+    * :func:`~paddle_tpu.observability.fleet.fleet_prometheus_text`
+      labels every sample with ``{proc=...}``;
+    * :func:`~paddle_tpu.observability.fleet.assemble_trace` stitches
+      the pinned trace into ONE connected component spanning the
+      parent pid and at least one server pid — the distributed span
+      tree survives the process hop.
+    """
+    import json  # noqa: F401 - symmetry with sibling gates
+    import socket
+    import threading
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.core import flags
+    from paddle_tpu.distributed import ServingSupervisor
+    from paddle_tpu.observability import export as obs_export
+    from paddle_tpu.observability import fleet as obs_fleet
+    from paddle_tpu.utils import monitor
+
+    own_tmp = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_fleet_")
+    spool = os.path.join(workdir, "spool")
+    problems = []
+    old_flags = {k: flags.get_flag(k)
+                 for k in ("obs_spool_dir", "obs_role",
+                           "obs_export_interval_s")}
+    paddle.set_flags({"obs_spool_dir": spool, "obs_role": "parent",
+                      "obs_export_interval_s": 0.2})
+    from paddle_tpu.core import obs_hook
+    had_tracer = obs_hook._tracer is not None
+    obs_export.install_exporter()
+    monitor.stat_reset()
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    url = f"http://127.0.0.1:{port}"
+    state_file = os.path.join(workdir, "fleet_state")
+    stop_file = os.path.join(workdir, "fleet_stop")
+
+    sv = ServingSupervisor(
+        _fleet_gen_entry, args=(port, state_file, stop_file),
+        name="fleet", health_url=f"{url}/healthz",
+        ready_poll_s=0.1, probe_timeout_s=2.0, ready_fail_budget=50,
+        hang_deadline_s=300.0, startup_timeout_s=240.0, poll_s=0.1,
+        backoff_s=0.1, backoff_max_s=0.5,
+        crash_window_s=600.0, crash_budget=3,
+        child_env={"JAX_PLATFORMS": "cpu",
+                   "FLAGS_obs_export_interval_s": "0.2"},
+        workdir=workdir)
+    box = {}
+
+    def run_sv():
+        try:
+            box["result"] = sv.run()
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            box["error"] = e
+
+    svt = threading.Thread(target=run_sv, daemon=True)
+    svt.start()
+
+    def wait_ready(deadline_s):
+        deadline = time.monotonic() + deadline_s
+        c = serving.Client(url, timeout=5, reconnect_backoff_s=0.05)
+        while time.monotonic() < deadline:
+            try:
+                if c.healthz().get("ready"):
+                    return True
+            except Exception:  # noqa: BLE001 - replica not up yet
+                pass
+            time.sleep(0.1)
+        return False
+
+    tid = "fleetgate"
+    ok_counts = []
+    b_stop = threading.Event()
+
+    def traffic():
+        c = serving.Client(url, timeout=10, reconnect_backoff_s=0.1,
+                           trace_id=tid)
+        while not b_stop.is_set():
+            try:
+                toks = c.generate([3, 5], max_new_tokens=3)
+                ok_counts.append(len(toks))
+            except Exception:  # noqa: BLE001 - outage window
+                pass
+            time.sleep(0.05)
+
+    try:
+        if not wait_ready(240.0):
+            return _fleet_report(["supervised replica never became "
+                                  "ready"], verbose)
+        tt = threading.Thread(target=traffic, daemon=True)
+        tt.start()
+        deadline = time.monotonic() + 120
+        while monitor.get_stat("supervisor.serving.restarts") < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        if monitor.get_stat("supervisor.serving.restarts") < 1:
+            b_stop.set()
+            return _fleet_report(["replica crash never triggered a "
+                                  "supervised restart"], verbose)
+        if not wait_ready(240.0):
+            b_stop.set()
+            return _fleet_report(["restarted replica never became "
+                                  "ready again"], verbose)
+        # at least one traced request must land on the NEW incarnation
+        pre = len(ok_counts)
+        deadline = time.monotonic() + 60
+        while len(ok_counts) <= pre and time.monotonic() < deadline:
+            time.sleep(0.1)
+        b_stop.set()
+        tt.join(30)
+        if len(ok_counts) <= pre:
+            problems.append("no /generate succeeded after the restart")
+        with open(stop_file, "w") as f:
+            f.write("1")
+        svt.join(60)
+        if "error" in box:
+            problems.append(f"supervisor errored: {box['error']}")
+
+        # -- spool: parent + BOTH child incarnations ----------------------
+        exp = obs_export.get_exporter()
+        if exp is not None:
+            exp.flush()
+        procs = obs_fleet.read_spool(spool)
+        roles = {p["role"] for p in procs}
+        for want in ("parent", "fleet-a0", "fleet-a1"):
+            if want not in roles:
+                problems.append(f"spool lacks a record for {want!r} "
+                                f"(roles: {sorted(roles)})")
+        corrupt = sum(p["corrupt"] for p in procs)
+        if corrupt:
+            problems.append(f"{corrupt} corrupt spool document(s)")
+
+        # -- merged chrome trace: named aligned lanes + restart reason ----
+        merged = obs_fleet.merged_chrome_trace(spool)
+        evs = merged.get("traceEvents") or []
+        lanes = {e["args"]["name"] for e in evs
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+        for want in ("parent", "fleet-a0", "fleet-a1"):
+            if not any(ln.startswith(want + "-") for ln in lanes):
+                problems.append(f"merged trace lacks a lane for "
+                                f"{want!r} (lanes: {sorted(lanes)})")
+        restarts = [e for e in evs if e.get("name") == "restart"
+                    and "crash" in str((e.get("args") or {})
+                                       .get("reason", ""))]
+        if not restarts:
+            problems.append("merged trace lacks the supervisor restart "
+                            "event with the crash reason")
+        if any(e.get("ts", 0) < 0 for e in evs):
+            problems.append("merged trace has negative timestamps "
+                            "(lane alignment broke)")
+
+        # -- fleet Prometheus: every sample proc-labelled -----------------
+        text = obs_fleet.fleet_prometheus_text(spool)
+        bad = [ln for ln in text.splitlines()
+               if ln and not ln.startswith("#") and 'proc="' not in ln]
+        if bad:
+            problems.append(f"fleet Prometheus samples without a proc "
+                            f"label: {bad[:3]}")
+
+        # -- the pinned trace is ONE component across the process hop -----
+        asm = obs_fleet.assemble_trace(
+            obs_fleet._merge_self(list(procs)), tid)
+        if not asm["connected"]:
+            problems.append(f"distributed trace not connected: {asm}")
+        if len(asm["pids"]) < 2:
+            problems.append(f"distributed trace never crossed a "
+                            f"process boundary: pids={asm['pids']}")
+    finally:
+        b_stop.set()
+        with open(stop_file, "w") as f:
+            f.write("1")
+        sv.stop()
+        svt.join(60)
+        obs_export.uninstall_exporter()
+        if not had_tracer:          # install_exporter enabled it for us
+            from paddle_tpu import observability as _obs
+            _obs.disable()
+        paddle.set_flags(old_flags)
+        if own_tmp:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    if verbose and not problems:
+        print(f"fleet gate: {len(ok_counts)} traced generates, "
+              f"restarts={monitor.get_stat('supervisor.serving.restarts')}, "
+              f"procs={sorted(roles)}, trace pids={asm['pids']}")
+    return _fleet_report(problems, verbose)
+
+
+def _fleet_report(problems, verbose):
+    for p in problems:
+        print(f"FAIL: {p}", file=sys.stderr)
+    if not problems:
+        print("chaos fleet: parent + both incarnations spooled, lanes "
+              "aligned, restart reason visible, pinned /generate trace "
+              "connected across the replica restart")
+    return 1 if problems else 0

@@ -934,6 +934,38 @@ def test_supervisor_crash_loop_gives_up_with_history(tmp_path):
     assert monitor.get_stat("supervisor.gave_up") == 1
 
 
+def test_supervisor_giveup_writes_incident_flight(tmp_path):
+    """Satellite (ISSUE 20): a give-up is an incident — the supervisor
+    leaves supervisor_giveup.json with the exit history, pointers to
+    every child flight dump, and the last heartbeat INLINED (an
+    operator reading one JSON must not have to decode the binary
+    heartbeat file)."""
+    import json as _json
+
+    from paddle_tpu.distributed.supervisor import (SupervisorGaveUp,
+                                                   TrainingSupervisor)
+    from paddle_tpu.testing.chaos import _sv_flaky_entry
+    sv = TrainingSupervisor(
+        _sv_flaky_entry, args=(str(tmp_path / "state"), 10 ** 9, 3),
+        backoff_s=0.01, crash_window_s=600.0, crash_budget=1,
+        workdir=str(tmp_path))
+    with pytest.raises(SupervisorGaveUp):
+        sv.run()
+    box = _json.load(open(str(tmp_path / "supervisor_giveup.json")))
+    assert box["reason"] == "supervisor.give_up"
+    extra = box["extra"]
+    assert extra["attempts"] == 2 and extra["crash_budget"] == 1
+    assert [r["exit_code"] for r in extra["exit_history"]] == [3, 3]
+    assert all(r["reason"] == "crash(exit=3)"
+               for r in extra["exit_history"])
+    assert isinstance(extra["child_dumps"], list)
+    # the entry never beats, so the inlined heartbeat is None — but
+    # the key must be present (the operator contract)
+    assert "last_heartbeat" in extra
+    # the dump carries a full metrics snapshot like every flight box
+    assert box.get("stats") is not None
+
+
 def test_supervisor_watchdog_kills_hang_and_dumps_flight(tmp_path):
     import json as _json
 

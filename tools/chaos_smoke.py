@@ -65,9 +65,20 @@ Scenarios (``--scenario``, default ``all``):
   skips/quarantines/rollbacks are all asserted from ``anomaly.*``
   stats and the annotated rollback flight dump.
 
+- ``fleet`` — :func:`paddle_tpu.testing.chaos.fleet_main`: fleet
+  observability under fire — a supervised generation replica spooling
+  telemetry (``FLAGS_obs_spool_dir`` staged into the child env by the
+  supervisor) hard-crashes mid-traffic while a client with a pinned
+  trace id keeps hitting ``/generate``; fails unless the spool holds
+  parent + BOTH child incarnations, the merged chrome-trace has
+  aligned named lanes for all three plus the supervisor restart event
+  with the crash reason, every fleet-Prometheus sample carries a
+  ``{proc=...}`` label, and the pinned request's span tree assembles
+  into ONE connected component across the process hop.
+
 Usage::
 
-    python tools/chaos_smoke.py [--scenario all|training|serving|generation|swap|registry|reshard|supervise|anomaly]
+    python tools/chaos_smoke.py [--scenario all|training|serving|generation|swap|registry|reshard|supervise|anomaly|fleet]
                                 [--epochs 4] [--verbose]
 
 CI treats a non-zero exit as a robustness regression.  The same flows
@@ -90,7 +101,7 @@ def main(argv=None) -> int:
     ap.add_argument("--scenario", default="all",
                     choices=["all", "training", "serving", "generation",
                              "swap", "registry", "reshard", "supervise",
-                             "anomaly"])
+                             "anomaly", "fleet"])
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
@@ -123,9 +134,11 @@ def main(argv=None) -> int:
         rc |= chaos.supervise_main(verbose=args.verbose)
     if args.scenario == "anomaly":
         rc |= chaos.anomaly_main(verbose=args.verbose)
+    if args.scenario == "fleet":
+        rc |= chaos.fleet_main(verbose=args.verbose)
     if args.scenario == "all":
         import subprocess
-        for sub_scenario in ("reshard", "supervise", "anomaly"):
+        for sub_scenario in ("reshard", "supervise", "anomaly", "fleet"):
             sub = [sys.executable, os.path.abspath(__file__),
                    "--scenario", sub_scenario]
             if args.verbose:

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Fleet observatory report: one view of every process's telemetry.
+
+Reads a telemetry spool directory (``FLAGS_obs_spool_dir`` — written by
+per-process exporters, staged into supervised children automatically)
+OR asks a live serving replica over HTTP, then renders:
+
+- a human summary: one line per process (role, pid, segments, event
+  count, corruption), plus fleet-wide build-skew detection;
+- ``--prometheus``: the merged text exposition, every sample labelled
+  ``{proc="<role>-<pid>"}`` (parseable by the PR-9 grammar gate);
+- ``--trace OUT.json``: the merged chrome-trace — one named lane per
+  process, wall-time aligned, loadable straight into Perfetto;
+- ``--trace-id ID``: assemble one distributed request's span tree
+  across every process in the spool and report whether it is
+  connected.
+
+Usage::
+
+    python tools/fleet_report.py --spool /var/run/paddle-obs
+    python tools/fleet_report.py --spool DIR --trace merged.json
+    python tools/fleet_report.py --spool DIR --trace-id 7f3a...
+    python tools/fleet_report.py --spool DIR --prometheus
+    python tools/fleet_report.py --url http://127.0.0.1:8080
+
+``--url`` hits ``GET /admin/fleet`` (and ``POST /admin/trace`` when
+``--trace`` is also given) — useful against a replica whose spool dir
+is not mounted locally.  Exits non-zero when the spool is empty/
+unreadable, any document is corrupt, or a requested trace id does not
+assemble into one connected tree.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _report_spool(args) -> int:
+    from paddle_tpu.observability import fleet
+
+    procs = fleet.read_spool(args.spool)
+    if not procs:
+        print(f"fleet_report: no telemetry under {args.spool!r} "
+              f"(is FLAGS_obs_spool_dir set on the fleet?)",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    snap = fleet.fleet_snapshot(procs=procs)
+    print(f"fleet: {len(snap['procs'])} process(es)")
+    for label in sorted(snap["procs"]):
+        p = snap["procs"][label]
+        line = (f"  {label:<24} role={p['role']} pid={p['pid']} "
+                f"segments={p['segments']} events={p['events']}")
+        if p["corrupt"]:
+            line += f"  CORRUPT={p['corrupt']}"
+            rc = 1
+        print(line)
+    if snap["build_skew"]:
+        rc = 1
+        print(f"BUILD SKEW: {snap['build_skew']}", file=sys.stderr)
+
+    if args.prometheus:
+        sys.stdout.write(fleet.fleet_prometheus_text(procs=procs))
+    if args.trace:
+        merged = fleet.merged_chrome_trace(procs=procs)
+        with open(args.trace, "w") as f:
+            json.dump(merged, f)
+        print(f"merged chrome-trace: {len(merged['traceEvents'])} "
+              f"events -> {args.trace}")
+    if args.trace_id:
+        asm = fleet.assemble_trace(procs, args.trace_id)
+        print(f"trace {args.trace_id}: {asm['events']} span(s) across "
+              f"{len(asm['pids'])} process(es), "
+              f"{asm['components']} component(s), "
+              f"connected={asm['connected']}")
+        if not asm["connected"] or not asm["events"]:
+            rc = 1
+    return rc
+
+
+def _report_url(args) -> int:
+    from paddle_tpu.serving.http import Client
+
+    client = Client(args.url, timeout=args.timeout)
+    snap = client._get_json("/admin/fleet")
+    print(json.dumps(snap, indent=2, sort_keys=True))
+    if args.trace:
+        merged = json.loads(client._post(
+            "/admin/trace?secs=0", b"",
+            {"Content-Type": "application/json"}))
+        with open(args.trace, "w") as f:
+            json.dump(merged, f)
+        print(f"merged chrome-trace: "
+              f"{len(merged.get('traceEvents', []))} events "
+              f"-> {args.trace}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--spool", help="telemetry spool directory "
+                     "(FLAGS_obs_spool_dir)")
+    src.add_argument("--url", help="live replica base URL "
+                     "(GET /admin/fleet)")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="print the merged {proc=...} exposition")
+    ap.add_argument("--trace", metavar="OUT.json",
+                    help="write the merged chrome-trace here")
+    ap.add_argument("--trace-id", help="assemble this request's "
+                    "cross-process span tree")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    if args.url and (args.trace_id or args.prometheus):
+        ap.error("--trace-id/--prometheus need --spool (the raw "
+                 "segments); --url serves the aggregated JSON view")
+    return _report_url(args) if args.url else _report_spool(args)
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

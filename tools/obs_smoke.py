@@ -36,7 +36,16 @@ non-zero when the observability contract regresses:
 7. **disabled-path contract** — every new emitting site (Executor.run,
    the serving dispatch/decode steps) reaches the observatory through
    ``core.obs_hook`` module attributes only — no per-call
-   ``observability`` import anywhere in the hot path.
+   ``observability`` import anywhere in the hot path; the fleet
+   exporter tick rides the same contract (``obs_hook._export``
+   None-check in Executor._run, InferenceEngine._execute and
+   GenerationEngine._decode_step).
+8. **fleet gate** — ``chaos_smoke --scenario fleet`` in a subprocess:
+   a supervised generation replica spooling telemetry hard-crashes
+   mid-traffic; the merged chrome-trace must carry aligned lanes for
+   the parent and BOTH child incarnations plus the restart reason, and
+   a pinned ``/generate`` trace must assemble into one connected span
+   tree across the process hop.
 
 Usage:  python tools/obs_smoke.py [--verbose]
 """
@@ -102,6 +111,12 @@ def _check_disabled_contract(failures: list) -> None:
         if "observability" in names:
             failures.append(f"{fn.__qualname__} imports observability "
                             f"on the hot path: {names}")
+    # the fleet exporter tick is a hot-path site too: one _export
+    # attribute None-check per dispatch/decode step when not spooling
+    for fn in (InferenceEngine._execute, GenerationEngine._decode_step):
+        if "_export" not in fn.__code__.co_names:
+            failures.append(f"{fn.__qualname__} lost its obs_hook."
+                            f"_export disabled-path check")
     # the perf anatomy lives in Executor._run (run is a thin span
     # wrapper) — it must reach the observatory through the obs_hook
     # attribute, not an import.  _run legitimately imports
@@ -116,6 +131,10 @@ def _check_disabled_contract(failures: list) -> None:
     # module-attribute check per step, nothing more, when unsupervised
     if "_heartbeat" not in run_names:
         failures.append("Executor._run lost its obs_hook._heartbeat "
+                        "disabled-path check")
+    # ... and so does the fleet exporter's per-step tick
+    if "_export" not in run_names:
+        failures.append("Executor._run lost its obs_hook._export "
                         "disabled-path check")
 
 
@@ -380,6 +399,21 @@ def run_checks(verbose: bool = False) -> list:
                 failures.append(f"tracer recorded no '{want}' events "
                                 f"(kinds: {kinds})")
         _check_disabled_contract(failures)
+
+        # -- fleet gate: cross-process spool + trace, own interpreter -----
+        # (the drill supervises real child processes and stages obs
+        # flags into their env, so it gets a subprocess of its own
+        # rather than fighting this process's live tracer)
+        import subprocess
+        fleet = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "chaos_smoke.py"),
+             "--scenario", "fleet"],
+            capture_output=True, text=True, timeout=600)
+        if fleet.returncode != 0:
+            tail = (fleet.stdout + fleet.stderr).strip().splitlines()
+            failures.append(f"fleet observability gate failed: "
+                            f"{tail[-6:]}")
         if verbose:
             print(f"events={len(tracer.events())} kinds={sorted(kinds)} "
                   f"compiles={total['by_cause']} "
@@ -406,7 +440,8 @@ def main(argv=None) -> int:
     print("obs_smoke: observability healthy (crash black box written, "
           "100% of compiles attributed, Prometheus + JSON /metrics "
           "served, trace schema valid, drift loop closed, SLO breach "
-          "degraded + recovered /healthz, disabled path one-check)")
+          "degraded + recovered /healthz, disabled path one-check, "
+          "fleet spool + cross-process trace gate green)")
     return 0
 
 
